@@ -1,0 +1,19 @@
+"""kubebrain_tpu — a TPU-native, etcd3-API-compatible MVCC metadata store.
+
+A ground-up rebuild of the capabilities of kubewharf/kubebrain (reference:
+/root/reference, a pure-Go stateless etcd3-compatible storage server for
+Kubernetes) designed TPU-first:
+
+- The MVCC hot loops (revision-encoded range scan, compaction/GC merge,
+  watch-event fan-out) run as vectorized JAX/Pallas kernels over HBM-resident
+  sorted key blocks, sharded across a ``jax.sharding.Mesh`` with shard_map
+  (reference hot loop: pkg/backend/scanner/scanner.go:389-516).
+- The control plane (gRPC servers, leader election, revision sync, event
+  sequencing, uncertain-write retry) stays on host, mirroring the reference's
+  top layers (pkg/endpoint, pkg/server, pkg/backend).
+- The storage engine abstraction (reference pkg/storage/interface.go) is kept,
+  with engines selected at runtime: ``memkv`` (in-memory, tests), ``native``
+  (C++ host block manager), ``tpu`` (device-mirrored block store).
+"""
+
+__version__ = "0.1.0"

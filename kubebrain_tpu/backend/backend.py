@@ -1,0 +1,498 @@
+"""The MVCC backend core — revision allocation, conditional writes, snapshot
+reads, compaction, and the single-sequencer event pipeline.
+
+Reference: pkg/backend/backend.go (Backend iface :44-84, NewBackend :145,
+collectStorageWriteEvents :208), txn.go, range.go, watch.go, compact.go.
+
+Threading model (mirrors the reference's goroutines, backend.go:178-183):
+
+- any number of writer threads: deal a revision, run the engine batch, then
+  post exactly one WatchEvent into the revision-indexed ring
+  (``_notify``; reference txn.go:267-293). Every dealt revision is notified —
+  valid, failed, or uncertain — or the sequencer would stall;
+- ONE sequencer thread consumes ring slots strictly in revision order
+  (``_collect_events``): commits the revision to the TSO, routes uncertain
+  results to the async retry queue, and appends valid events to the watch
+  cache + fan-out hub in batches of <= EVENT_BATCH;
+- the async retry daemon repairs uncertain writes (retry.py);
+- watch fan-out happens inline in the sequencer via WatcherHub.stream.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Iterator
+
+from .. import coder
+from ..storage import CASFailedError, KvStorage, Partition, UncertainResultError
+from ..storage.errors import KeyNotFoundError
+from . import creator
+from .common import COMPACT_KEY, TOMBSTONE, KeyValue, RangeResult, Verb, WatchEvent
+from .errors import (
+    CASRevisionMismatchError,
+    CompactedError,
+    FutureRevisionError,
+    KeyExistsError,
+    WatchExpiredError,
+)
+from .retry import AsyncFifoRetry
+from .ring import Ring
+from .scanner import CompactHistory, Scanner
+from .tso import TSO
+from .watcherhub import WatcherHub
+
+# Reference constants, backend.go:39-42
+WATCH_CACHE_CAPACITY = 200_000
+EVENT_RING_CAPACITY = 100_000
+EVENT_BATCH = 300
+
+
+@dataclass
+class BackendConfig:
+    prefix: bytes = b"/"
+    skip_prefixes: list[bytes] = field(default_factory=list)
+    watch_cache_capacity: int = WATCH_CACHE_CAPACITY
+    event_ring_capacity: int = EVENT_RING_CAPACITY
+    enable_etcd_compatibility: bool = True  # gates Count (reference range.go:188)
+    fanout_matcher: object | None = None  # vectorized watch matcher (ops.fanout)
+    scanner_workers: int = 8
+
+
+class Backend:
+    def __init__(self, store: KvStorage, config: BackendConfig | None = None):
+        self.config = config or BackendConfig()
+        self.store = store
+        self.tso = TSO()
+        self.watch_cache = Ring(self.config.watch_cache_capacity)
+        self.watcher_hub = WatcherHub(fanout_matcher=self.config.fanout_matcher)
+        self.retry = AsyncFifoRetry(self._read_rev_record, self._retry_rewrite)
+        self.scanner = Scanner(
+            store,
+            get_compact_revision=lambda _snap: self._compact_revision_cached(),
+            retry_min_revision=self.retry.min_revision,
+            compact_history=CompactHistory(),
+            max_workers=self.config.scanner_workers,
+        )
+        # compact watermark cache: -1 unknown; refreshed at most once per
+        # COMPACT_CACHE_TTL so hot reads don't pay an engine round-trip
+        # (local compactions update it synchronously; the TTL bounds follower
+        # staleness against a remote leader's compaction)
+        self._compact_rev_cache = -1
+        self._compact_cache_time = 0.0
+        self._compact_lock = threading.Lock()
+
+        # revision-indexed event ring (reference backend.go:111; txn.go:291)
+        self._ring_cap = self.config.event_ring_capacity
+        self._ring: list[WatchEvent | None] = [None] * self._ring_cap
+        self._ring_cond = threading.Condition()
+        self._next_rev = 1  # next revision the sequencer expects
+        self._closed = False
+
+        self._seq_thread = threading.Thread(
+            target=self._collect_events, name="kb-sequencer", daemon=True
+        )
+        self._seq_thread.start()
+        self.retry.run()
+
+    # =================================================================== writes
+    def create(self, user_key: bytes, value: bytes) -> int:
+        """Insert; returns the new revision. KeyExistsError carries the live
+        revision on conflict. Reference txn.go:33 + creator/naive.go:53."""
+        rev = self.tso.deal()
+        event = WatchEvent(revision=rev, verb=Verb.CREATE, key=user_key, value=value, valid=False)
+        try:
+            creator.create(self.store, user_key, value, rev)
+            event.valid = True
+            return rev
+        except UncertainResultError as e:
+            event.err = e
+            raise
+        finally:
+            self._notify(event)
+            self.tso.wait_committed(rev, timeout=5.0)
+
+    def update(self, user_key: bytes, value: bytes, expected_revision: int) -> int:
+        """Conditional overwrite: CAS(revision_key, expected→new) + Put(object).
+        Reference txn.go:193-265. On revision mismatch raises
+        CASRevisionMismatchError carrying the latest (revision, value) —
+        re-read via the conflict fast path (txn.go:225-241)."""
+        rev = self.tso.deal()
+        event = WatchEvent(
+            revision=rev, verb=Verb.PUT, key=user_key, value=value,
+            prev_revision=expected_revision, valid=False,
+        )
+        ttl = creator.ttl_for_key(user_key)
+        rev_key = coder.encode_revision_key(user_key)
+        try:
+            batch = self.store.begin_batch_write()
+            batch.cas(
+                rev_key,
+                coder.encode_rev_value(rev),
+                coder.encode_rev_value(expected_revision),
+                ttl,
+            )
+            batch.put(coder.encode_object_key(user_key, rev), value, ttl)
+            batch.commit()
+            event.valid = True
+            return rev
+        except CASFailedError as e:
+            observed = e.conflict.value if e.conflict else None
+            latest_rev, latest_val = 0, None
+            if observed is not None:
+                try:
+                    latest_rev, deleted = coder.decode_rev_value(observed)
+                    if not deleted:
+                        latest_val = self._read_object(user_key, latest_rev)
+                except coder.CodecError:
+                    pass
+            raise CASRevisionMismatchError(user_key, latest_rev, latest_val) from e
+        except UncertainResultError as e:
+            event.err = e
+            raise
+        finally:
+            self._notify(event)
+            self.tso.wait_committed(rev, timeout=5.0)
+
+    def delete(self, user_key: bytes, expected_revision: int = 0) -> tuple[int, KeyValue]:
+        """Tombstone write: CAS(revision_key → rev_value(new, deleted)) +
+        Put(object_key, TOMBSTONE). Reference txn.go:79-190 (read-before-delete
+        + CAS — the documented delete weakness, benchmark.md:56-61).
+        Returns (new_revision, previous KeyValue)."""
+        record = self._read_rev_record(user_key)
+        if record is None or record[1]:
+            raise KeyNotFoundError(user_key)
+        latest_rev, _ = record
+        if expected_revision and latest_rev != expected_revision:
+            val = self._read_object(user_key, latest_rev)
+            raise CASRevisionMismatchError(user_key, latest_rev, val)
+        prev_value = self._read_object(user_key, latest_rev)
+        rev = self.tso.deal()
+        event = WatchEvent(
+            revision=rev, verb=Verb.DELETE, key=user_key,
+            prev_revision=latest_rev, prev_value=prev_value, valid=False,
+        )
+        try:
+            if rev <= latest_rev:
+                # drift-back anomaly (txn.go:171-175) — raised inside the
+                # notify-protected region so the dealt revision is still
+                # sequenced and the pipeline never stalls
+                raise FutureRevisionError(rev, latest_rev)
+            batch = self.store.begin_batch_write()
+            batch.cas(
+                coder.encode_revision_key(user_key),
+                coder.encode_rev_value(rev, deleted=True),
+                coder.encode_rev_value(latest_rev),
+            )
+            batch.put(coder.encode_object_key(user_key, rev), TOMBSTONE)
+            batch.commit()
+            event.valid = True
+            return rev, KeyValue(user_key, prev_value or b"", latest_rev)
+        except CASFailedError as e:
+            observed = e.conflict.value if e.conflict else None
+            lr, lv = 0, None
+            if observed is not None:
+                try:
+                    lr, deleted = coder.decode_rev_value(observed)
+                    lv = None if deleted else self._read_object(user_key, lr)
+                except coder.CodecError:
+                    pass
+            raise CASRevisionMismatchError(user_key, lr, lv) from e
+        except UncertainResultError as e:
+            event.err = e
+            raise
+        finally:
+            self._notify(event)
+            self.tso.wait_committed(rev, timeout=5.0)
+
+    # ==================================================================== reads
+    def current_revision(self) -> int:
+        return self.tso.committed()
+
+    def set_current_revision(self, revision: int) -> None:
+        """Seed revision state (leader start / follower sync).
+        Reference: leader.go:96-107 → backend.SetCurrentRevision."""
+        self.tso.init(revision)
+        with self._ring_cond:
+            if revision + 1 > self._next_rev:
+                self._next_rev = revision + 1
+            self._ring_cond.notify_all()
+
+    def get(self, user_key: bytes, revision: int = 0) -> KeyValue:
+        """Point read at a snapshot: reverse-iterate the version chain from
+        (key, read_rev) down, take the first row, reject tombstones.
+        Reference range.go:34-121."""
+        read_rev = self._read_revision_checked(revision)
+        # reverse-iterate (key, read_rev) → (key, 0); highest version first,
+        # the rev-0 record sorts last so a rev-0 first hit means "no versions"
+        start = coder.encode_object_key(user_key, read_rev)
+        end = coder.encode_revision_key(user_key)
+        it = self.store.iter(start, end, snapshot_ts=self.store.get_timestamp_oracle(), limit=1)
+        for ikey, value in it:
+            _, rev = coder.decode(ikey)
+            if rev == 0 or value == TOMBSTONE:
+                break
+            return KeyValue(user_key, value, rev)
+        raise KeyNotFoundError(user_key)
+
+    def list_(
+        self, start: bytes, end: bytes, revision: int = 0, limit: int = 0
+    ) -> RangeResult:
+        """Range read at a snapshot; limit+1 detects More (range.go:124-171)."""
+        read_rev = self._read_revision_checked(revision)
+        kvs, more = self.scanner.range_(start, end, read_rev, limit)
+        return RangeResult(kvs=kvs, revision=read_rev, more=more, count=len(kvs))
+
+    def count(self, start: bytes, end: bytes, revision: int = 0) -> tuple[int, int]:
+        read_rev = self._read_revision_checked(revision)
+        return self.scanner.count(start, end, read_rev), read_rev
+
+    def list_by_stream(
+        self, start: bytes, end: bytes, revision: int = 0
+    ) -> tuple[int, Iterator[list[KeyValue]]]:
+        read_rev = self._read_revision_checked(revision)
+        return read_rev, self.scanner.range_stream(start, end, read_rev)
+
+    def get_partitions(self, start: bytes, end: bytes) -> list[Partition]:
+        """User-key partition borders for client-side partition-wise listing
+        (reference range.go:208-244, magic revision 1888 in etcd/kv.go:33)."""
+        lo, hi = coder.internal_range(start, end)
+        parts = self.store.get_partitions(lo, hi)
+        out: list[Partition] = []
+        left = start
+        for p in parts[:-1]:
+            if coder.is_internal_key(p.right):
+                user_key, _ = coder.decode(p.right)
+            else:
+                user_key = p.right
+            if user_key <= left or (end and user_key >= end):
+                continue
+            out.append(Partition(left, user_key))
+            left = user_key
+        out.append(Partition(left, end))
+        return out
+
+    # ================================================================== compact
+    def compact(self, revision: int) -> int:
+        """Compact to min(requested, committed, min-uncertain − 1); persist the
+        watermark (fences readers), then GC per border pair.
+        Reference compact.go:31-126."""
+        with self._compact_lock:
+            target = min(revision, self.tso.committed())
+            retry_min = self.retry.min_revision()
+            if retry_min:
+                target = min(target, retry_min - 1)
+            current = self._compact_revision_at(None)
+            if target <= current:
+                return current
+            self._set_compact_record(target, current)
+            self._compact_rev_cache = target
+            self._compact_cache_time = time.monotonic()
+            for left, right in self._compact_borders():
+                self.scanner.compact(left, right, target)
+            return target
+
+    def _compact_borders(self) -> list[tuple[bytes, bytes]]:
+        """Internal-key border pairs covering the configured prefix minus
+        skip-prefixes (reference compact.go:107-126)."""
+        prefix = self.config.prefix
+        lo, hi = coder.internal_range(prefix, coder.prefix_end(prefix) if prefix else b"")
+        borders: list[tuple[bytes, bytes]] = []
+        left = lo
+        for skip in sorted(self.config.skip_prefixes):
+            s_lo = coder.encode_revision_key(skip)
+            s_hi = coder.encode_revision_key(coder.prefix_end(skip))
+            if s_lo > left:
+                borders.append((left, s_lo))
+            left = s_hi
+        borders.append((left, hi))
+        return borders
+
+    def _set_compact_record(self, revision: int, old: int) -> None:
+        batch = self.store.begin_batch_write()
+        value = coder.encode_rev_value(revision)
+        if old == 0:
+            try:
+                batch.put_if_not_exist(COMPACT_KEY, value)
+                batch.commit()
+                return
+            except CASFailedError:
+                batch = self.store.begin_batch_write()
+                old = self._compact_revision_at(None)
+        batch.cas(COMPACT_KEY, value, coder.encode_rev_value(old))
+        batch.commit()
+
+    def _compact_revision_at(self, snapshot: int | None) -> int:
+        try:
+            raw = self.store.get(COMPACT_KEY, snapshot_ts=snapshot)
+        except KeyNotFoundError:
+            return 0
+        rev, _ = coder.decode_rev_value(raw)
+        return rev
+
+    def _compact_revision_cached(self) -> int:
+        now = time.monotonic()
+        if self._compact_rev_cache < 0 or now - self._compact_cache_time > 1.0:
+            self._compact_rev_cache = self._compact_revision_at(None)
+            self._compact_cache_time = now
+        return self._compact_rev_cache
+
+    def compact_revision(self) -> int:
+        return self._compact_revision_at(None)
+
+    # ==================================================================== watch
+    def watch(self, prefix: bytes = b"", revision: int = 0):
+        """Subscribe-then-replay watch registration (reference watch.go:37-96):
+        subscribe to the hub FIRST, then replay history from the cache for
+        events in (revision, hub-subscription point]; raise WatchExpiredError
+        when the requested revision pre-dates the cache so the client re-lists.
+        Returns (watcher_id, queue) — the queue yields event batches and a
+        None poison pill on close."""
+        def validate() -> None:
+            if not revision:
+                return
+            oldest = self.watch_cache.oldest_revision()
+            if len(self.watch_cache) == 0:
+                if revision < self.tso.committed():
+                    raise WatchExpiredError(f"cache empty, want {revision}")
+            elif revision < oldest - 1:
+                raise WatchExpiredError(f"want {revision}, cache oldest {oldest}")
+
+        wid, q, _replayed = self.watcher_hub.add_watcher_with_replay(
+            prefix, revision, self.watch_cache, validate=validate
+        )
+        return wid, q
+
+    def unwatch(self, wid: int) -> None:
+        self.watcher_hub.delete_watcher(wid)
+
+    # ========================================================== event pipeline
+    def _notify(self, event: WatchEvent) -> None:
+        """Post one event into the revision-indexed ring (txn.go:267-293).
+        Raises if the ring wraps — the invariant crash the reference keeps
+        (panic "watch push buffer full", txn.go:287-290)."""
+        idx = event.revision % self._ring_cap
+        with self._ring_cond:
+            if self._ring[idx] is not None:
+                raise RuntimeError("event ring wrapped: sequencer too far behind")
+            self._ring[idx] = event
+            self._ring_cond.notify_all()
+
+    def _collect_events(self) -> None:
+        """THE single sequencer (reference collectStorageWriteEvents,
+        backend.go:208-270): consume ring slots strictly in revision order."""
+        batch: list[WatchEvent] = []
+        while True:
+            with self._ring_cond:
+                idx = self._next_rev % self._ring_cap
+                while self._ring[idx] is None and not self._closed:
+                    if batch:
+                        break  # drain pending batch while the ring is quiet
+                    self._ring_cond.wait(timeout=0.5)
+                    idx = self._next_rev % self._ring_cap
+                if self._closed:
+                    return
+                event = self._ring[idx]
+                if event is not None:
+                    self._ring[idx] = None
+                    self._next_rev += 1
+            if event is None:
+                self._flush(batch)
+                batch = []
+                continue
+            self.tso.commit(event.revision)
+            if event.err is not None and isinstance(event.err, UncertainResultError):
+                self.retry.append(event)
+            elif event.valid:
+                batch.append(event)
+            if len(batch) >= EVENT_BATCH:
+                self._flush(batch)
+                batch = []
+
+    def _flush(self, batch: list[WatchEvent]) -> None:
+        if not batch:
+            return
+        for e in batch:
+            self.watch_cache.add(e)
+        self.watcher_hub.stream(batch)
+
+    # ============================================================ retry support
+    def _read_rev_record(self, user_key: bytes) -> tuple[int, bool] | None:
+        try:
+            raw = self.store.get(coder.encode_revision_key(user_key))
+        except KeyNotFoundError:
+            return None
+        try:
+            return coder.decode_rev_value(raw)
+        except coder.CodecError:
+            return None
+
+    def _read_object(self, user_key: bytes, revision: int) -> bytes | None:
+        try:
+            val = self.store.get(coder.encode_object_key(user_key, revision))
+        except KeyNotFoundError:
+            return None
+        return None if val == TOMBSTONE else val
+
+    def _retry_rewrite(self, event: WatchEvent, record: tuple[int, bool]) -> None:
+        """Idempotent overwrite at a fresh revision (retry.go:222-264): the
+        uncertain op DID land; emit a proper event via the normal write path."""
+        old_rev, deleted = record
+        rev = self.tso.deal()
+        new_event = WatchEvent(
+            revision=rev, verb=event.verb, key=event.key, value=event.value,
+            prev_revision=old_rev, valid=False,
+        )
+        try:
+            batch = self.store.begin_batch_write()
+            batch.cas(
+                coder.encode_revision_key(event.key),
+                coder.encode_rev_value(rev, deleted=deleted),
+                coder.encode_rev_value(old_rev, deleted=deleted),
+                creator.ttl_for_key(event.key),
+            )
+            value = TOMBSTONE if deleted else event.value
+            batch.put(coder.encode_object_key(event.key, rev), value,
+                      creator.ttl_for_key(event.key))
+            batch.commit()
+            new_event.valid = True
+        except CASFailedError:
+            pass  # superseded meanwhile: nothing to repair
+        except UncertainResultError as e:
+            new_event.err = e
+        finally:
+            self._notify(new_event)
+
+    # ================================================================ lifecycle
+    def _read_revision_checked(self, revision: int) -> int:
+        committed = self.tso.committed()
+        read_rev = revision or committed
+        if revision > committed:
+            raise FutureRevisionError(revision, committed)
+        compacted = self._compact_revision_cached()
+        if compacted and read_rev < compacted:
+            raise CompactedError(read_rev, compacted)
+        return read_rev
+
+    def close(self) -> None:
+        with self._ring_cond:
+            self._closed = True
+            self._ring_cond.notify_all()
+        self._seq_thread.join(timeout=2.0)
+        self.retry.close()
+        self.watcher_hub.close()
+        self.scanner.close()
+
+
+def wait_for_revision(backend: Backend, revision: int, timeout: float = 5.0) -> bool:
+    """Test helper: block until the sequencer has committed ``revision``
+    (reference waitUntilRevisionEqualOrTimeout, backend_test.go:1437)."""
+    import time
+
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if backend.tso.committed() >= revision:
+            return True
+        time.sleep(0.002)
+    return False

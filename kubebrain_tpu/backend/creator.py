@@ -1,0 +1,64 @@
+"""Create (insert) path.
+
+Reference: pkg/backend/creator/naive.go:53-98. A create is the atomic batch
+
+    PutIfNotExist(revision_key, rev_value(new_rev)) + Put(object_key, value)
+
+On CAS conflict the engine hands back the observed revision record
+(``Conflict.value``), which enables two conversions without extra reads:
+
+- the record is a **tombstone with a lower revision** — the key was deleted;
+  convert create→update by CAS-ing over the tombstone (naive.go:83-86);
+- the record vanished between conflict and inspection (compacted-away
+  delete) — retry the create once (naive.go:70-72).
+
+A live record means the key exists: surface ``KeyExistsError`` with the
+existing revision so the etcd shim can return txn-failed + current kv.
+"""
+
+from __future__ import annotations
+
+from .. import coder
+from ..storage import CASFailedError, KvStorage
+from .errors import KeyExistsError
+
+EVENTS_TTL_PREFIX = b"/events/"
+EVENTS_TTL_SECONDS = 3600
+
+
+def ttl_for_key(user_key: bytes) -> int:
+    """TTL is by key pattern, not lease (reference util.go:28-42, lease.go)."""
+    return EVENTS_TTL_SECONDS if user_key.startswith(EVENTS_TTL_PREFIX) else 0
+
+
+def create(store: KvStorage, user_key: bytes, value: bytes, revision: int) -> None:
+    """Insert ``user_key``=``value`` at ``revision``; raises KeyExistsError
+    (with the live revision) or propagates engine errors (incl. uncertain)."""
+    ttl = ttl_for_key(user_key)
+    rev_key = coder.encode_revision_key(user_key)
+    obj_key = coder.encode_object_key(user_key, revision)
+    for _attempt in range(2):
+        batch = store.begin_batch_write()
+        batch.put_if_not_exist(rev_key, coder.encode_rev_value(revision), ttl)
+        batch.put(obj_key, value, ttl)
+        try:
+            batch.commit()
+            return
+        except CASFailedError as e:
+            observed = e.conflict.value if e.conflict else None
+            if observed is None:
+                # record disappeared under us (compacted delete): retry create
+                continue
+            try:
+                old_rev, deleted = coder.decode_rev_value(observed)
+            except coder.CodecError:
+                raise KeyExistsError(user_key, 0) from e
+            if deleted and old_rev < revision:
+                # deleted key: create becomes an update over the tombstone
+                batch2 = store.begin_batch_write()
+                batch2.cas(rev_key, coder.encode_rev_value(revision), observed, ttl)
+                batch2.put(obj_key, value, ttl)
+                batch2.commit()  # CAS race here surfaces to caller
+                return
+            raise KeyExistsError(user_key, old_rev) from e
+    raise KeyExistsError(user_key, 0)

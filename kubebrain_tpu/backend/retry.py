@@ -1,0 +1,110 @@
+"""Async FIFO repair of uncertain write results.
+
+Reference: pkg/backend/retry (queue.go:23-81, retry.go:142-264). When a
+distributed engine's commit times out, the write *may or may not* have
+landed (``UncertainResultError``). The write path reports failure to the
+client but posts an invalid event; the sequencer appends it here. This loop
+then, for every queued event older than ``probe_after`` seconds:
+
+1. re-reads the key's revision record;
+2. if the record's mod revision still equals the uncertain op's revision, the
+   op **did** land — but no valid event was ever emitted, so watchers and
+   readers would disagree with storage. Repair: idempotently rewrite the same
+   value at a *fresh* revision via CAS (retry.go:222-264), which emits a
+   proper event through the normal write path;
+3. otherwise the op never landed (or was already superseded) — drop it.
+
+``min_revision()`` (retry.go:123) lower-bounds compaction: compacting past an
+unresolved uncertain write could garbage-collect the very record step 2 needs.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Callable
+
+from .common import Verb, WatchEvent
+
+
+class AsyncFifoRetry:
+    def __init__(
+        self,
+        read_rev_record: Callable[[bytes], tuple[int, bool] | None],
+        rewrite: Callable[[WatchEvent, tuple[int, bool]], None],
+        check_interval: float = 1.0,
+        probe_after: float = 5.0,
+    ):
+        self._read_rev_record = read_rev_record
+        self._rewrite = rewrite
+        self._check_interval = check_interval
+        self._probe_after = probe_after
+        self._lock = threading.Lock()
+        self._queue: deque[tuple[WatchEvent, float]] = deque()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def append(self, event: WatchEvent) -> None:
+        with self._lock:
+            self._queue.append((event, time.monotonic()))
+
+    def min_revision(self) -> int:
+        """Smallest unresolved uncertain revision; 0 when queue empty."""
+        with self._lock:
+            if not self._queue:
+                return 0
+            return min(ev.revision for ev, _ in self._queue)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._queue)
+
+    def process_ready(self, now: float | None = None) -> int:
+        """Resolve every queued event old enough to probe; returns count.
+
+        Split out of the loop for deterministic tests (the reference drives
+        this via TestUncertainRewrite, backend_test.go:1268-1386).
+        """
+        now = time.monotonic() if now is None else now
+        resolved = 0
+        while True:
+            with self._lock:
+                if not self._queue:
+                    return resolved
+                event, enqueued = self._queue[0]
+                if now - enqueued < self._probe_after:
+                    return resolved
+                self._queue.popleft()
+            self._resolve(event)
+            resolved += 1
+
+    def _resolve(self, event: WatchEvent) -> None:
+        record = self._read_rev_record(event.key)
+        if record is None:
+            return  # key vanished entirely: op failed or was compacted away
+        rev, deleted = record
+        if rev != event.revision:
+            return  # op never landed, or a later write superseded it: drop
+        if deleted != (event.verb == Verb.DELETE):
+            return
+        self._rewrite(event, record)
+
+    # ----------------------------------------------------------------- daemon
+    def run(self) -> None:
+        self._thread = threading.Thread(
+            target=self._loop, name="kb-async-retry", daemon=True
+        )
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self._check_interval):
+            try:
+                self.process_ready()
+            except Exception:  # engine hiccup: keep the repair loop alive
+                pass
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)

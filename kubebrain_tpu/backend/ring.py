@@ -1,0 +1,66 @@
+"""Fixed-size watch-history cache.
+
+Reference: pkg/backend/ring.go:31-118 — a mutex-guarded circular buffer of
+events ordered by revision; ``find_events(rev)`` binary-searches and copies
+the suffix with revision >= rev. Watchers that ask for a revision older than
+the oldest cached event must re-list (backend/watch.go:78-84).
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+
+from .common import WatchEvent
+
+
+class RingOverflowError(Exception):
+    pass
+
+
+class Ring:
+    def __init__(self, capacity: int):
+        assert capacity > 0
+        self._cap = capacity
+        self._buf: list[WatchEvent] = []
+        self._start = 0  # index of oldest
+        self._lock = threading.Lock()
+
+    def add(self, event: WatchEvent) -> None:
+        with self._lock:
+            if len(self._buf) < self._cap:
+                self._buf.append(event)
+            else:
+                self._buf[self._start] = event
+                self._start = (self._start + 1) % self._cap
+
+    def _ordered(self) -> list[WatchEvent]:
+        return self._buf[self._start :] + self._buf[: self._start]
+
+    def oldest_revision(self) -> int:
+        """0 when empty."""
+        with self._lock:
+            if not self._buf:
+                return 0
+            return self._buf[self._start].revision
+
+    def latest_revision(self) -> int:
+        with self._lock:
+            if not self._buf:
+                return 0
+            return self._buf[(self._start - 1) % len(self._buf)].revision
+
+    def find_events(self, revision: int) -> list[WatchEvent]:
+        """All cached events with event.revision >= revision, in order.
+
+        Reference ring.go:84-118 (sort.Search + suffix copy).
+        """
+        with self._lock:
+            ordered = self._ordered()
+            revs = [e.revision for e in ordered]
+            idx = bisect.bisect_left(revs, revision)
+            return ordered[idx:]
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._buf)

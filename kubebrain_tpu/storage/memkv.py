@@ -1,0 +1,204 @@
+"""In-memory versioned sorted-map engine — the deterministic test fake.
+
+Reference: pkg/storage/memkv (skiplist.go:30, batch.go, iter.go). Differences
+by design:
+
+- The logical clock is a commit counter, not wall-clock ns (skiplist.go:57) —
+  deterministic tests.
+- Snapshot isolation is real: every committed batch gets one timestamp and
+  every key keeps its version history, so an ``iter`` at snapshot S never
+  observes a commit > S (the reference fakes this with a whole-store mutex
+  held across the batch, skiplist.go:82-85).
+- Partitions are configurable via ``split_points`` so partition-parallel scans
+  and border adjustment are testable without a distributed engine — the role
+  the mock TiKV cluster plays in the reference tests (backend_test.go:171-178).
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+import time
+
+from . import BatchWrite, Iter, KvStorage, Partition, register_engine
+from .errors import CASFailedError, Conflict, KeyNotFoundError
+
+_PUT_IF_NOT_EXIST = 0
+_CAS = 1
+_PUT = 2
+_DEL = 3
+_DEL_CURRENT = 4
+
+
+class _Version:
+    __slots__ = ("ts", "value", "expire_at")
+
+    def __init__(self, ts: int, value: bytes | None, expire_at: float):
+        self.ts = ts
+        self.value = value  # None == engine-level deletion
+        self.expire_at = expire_at  # 0.0 == no TTL
+
+
+class MemKv(KvStorage):
+    def __init__(
+        self,
+        split_points: list[bytes] | None = None,
+        ttl_supported: bool = True,
+    ):
+        self._lock = threading.RLock()
+        self._keys: list[bytes] = []  # sorted index of every key ever written
+        self._versions: dict[bytes, list[_Version]] = {}
+        self._ts = 0
+        self._split_points = sorted(split_points or [])
+        self._ttl_supported = ttl_supported
+
+    # ------------------------------------------------------------- clock/shards
+    def get_timestamp_oracle(self) -> int:
+        with self._lock:
+            return self._ts
+
+    def get_partitions(self, start: bytes, end: bytes) -> list[Partition]:
+        borders = [start]
+        for sp in self._split_points:
+            if start < sp and (not end or sp < end):
+                borders.append(sp)
+        borders.append(end)
+        return [Partition(borders[i], borders[i + 1]) for i in range(len(borders) - 1)]
+
+    # ------------------------------------------------------------------- reads
+    def _live_value(self, key: bytes, snapshot_ts: int | None, now: float) -> bytes | None:
+        """Latest value at the snapshot, honoring TTL; None if absent/deleted."""
+        versions = self._versions.get(key)
+        if not versions:
+            return None
+        ts = self._ts if snapshot_ts is None else snapshot_ts
+        for v in reversed(versions):
+            if v.ts <= ts:
+                if v.value is None:
+                    return None
+                if self._ttl_supported and v.expire_at and now >= v.expire_at:
+                    return None
+                return v.value
+        return None
+
+    def get(self, key: bytes, snapshot_ts: int | None = None) -> bytes:
+        with self._lock:
+            val = self._live_value(key, snapshot_ts, time.time())
+            if val is None:
+                raise KeyNotFoundError(key)
+            return val
+
+    def iter(
+        self,
+        start: bytes,
+        end: bytes,
+        snapshot_ts: int | None = None,
+        limit: int = 0,
+    ) -> Iter:
+        reverse = bool(end) and start > end
+        with self._lock:
+            now = time.time()
+            ts = self._ts if snapshot_ts is None else snapshot_ts
+            if reverse:
+                # (reverse contract: end <= k <= start, descending)
+                lo = bisect.bisect_left(self._keys, end)
+                hi = bisect.bisect_right(self._keys, start)
+                candidates = reversed(self._keys[lo:hi])
+            else:
+                lo = bisect.bisect_left(self._keys, start)
+                hi = bisect.bisect_left(self._keys, end) if end else len(self._keys)
+                candidates = iter(self._keys[lo:hi])
+            buf: list[tuple[bytes, bytes]] = []
+            for k in candidates:
+                val = self._live_value(k, ts, now)
+                if val is not None:
+                    buf.append((k, val))
+                    if limit and len(buf) >= limit:
+                        break
+        return _BufferedIter(buf)
+
+    # ------------------------------------------------------------------ writes
+    def begin_batch_write(self) -> BatchWrite:
+        return _MemBatch(self)
+
+    def _commit(self, ops: list[tuple]) -> None:
+        with self._lock:
+            now = time.time()
+            # Validate all conditional ops against latest state first
+            # (all-or-nothing; reference memkv serializes batches under the
+            # store mutex, batch.go:146-167).
+            for idx, op in enumerate(ops):
+                kind, key = op[0], op[1]
+                cur = self._live_value(key, None, now)
+                if kind == _PUT_IF_NOT_EXIST and cur is not None:
+                    raise CASFailedError(Conflict(idx, key, cur))
+                if kind == _CAS and cur != op[3]:
+                    raise CASFailedError(Conflict(idx, key, cur))
+                if kind == _DEL_CURRENT and cur != op[2]:
+                    raise CASFailedError(Conflict(idx, key, cur))
+            self._ts += 1
+            ts = self._ts
+            for op in ops:
+                kind, key = op[0], op[1]
+                if kind in (_PUT_IF_NOT_EXIST, _CAS, _PUT):
+                    value, ttl = op[2], op[-1]
+                    expire_at = now + ttl if ttl else 0.0
+                    self._append(key, _Version(ts, value, expire_at))
+                else:  # _DEL / _DEL_CURRENT
+                    self._append(key, _Version(ts, None, 0.0))
+
+    def _append(self, key: bytes, version: _Version) -> None:
+        if key not in self._versions:
+            self._versions[key] = []
+            bisect.insort(self._keys, key)
+        self._versions[key].append(version)
+
+    # --------------------------------------------------------------- lifecycle
+    def support_ttl(self) -> bool:
+        return self._ttl_supported
+
+    def close(self) -> None:
+        with self._lock:
+            self._keys.clear()
+            self._versions.clear()
+
+
+class _BufferedIter(Iter):
+    def __init__(self, buf: list[tuple[bytes, bytes]]):
+        self._buf = buf
+        self._pos = 0
+
+    def next(self) -> tuple[bytes, bytes]:
+        if self._pos >= len(self._buf):
+            raise StopIteration
+        item = self._buf[self._pos]
+        self._pos += 1
+        return item
+
+
+class _MemBatch(BatchWrite):
+    def __init__(self, store: MemKv):
+        self._store = store
+        self._ops: list[tuple] = []
+
+    def put_if_not_exist(self, key: bytes, value: bytes, ttl_seconds: int = 0) -> None:
+        self._ops.append((_PUT_IF_NOT_EXIST, key, value, ttl_seconds))
+
+    def cas(self, key: bytes, new_value: bytes, old_value: bytes, ttl_seconds: int = 0) -> None:
+        self._ops.append((_CAS, key, new_value, old_value, ttl_seconds))
+
+    def put(self, key: bytes, value: bytes, ttl_seconds: int = 0) -> None:
+        self._ops.append((_PUT, key, value, ttl_seconds))
+
+    def delete(self, key: bytes) -> None:
+        self._ops.append((_DEL, key))
+
+    def del_current(self, key: bytes, expected_value: bytes) -> None:
+        self._ops.append((_DEL_CURRENT, key, expected_value))
+
+    def commit(self) -> None:
+        self._store._commit(self._ops)
+        self._ops = []
+
+
+register_engine("memkv", MemKv)

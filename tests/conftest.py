@@ -1,0 +1,13 @@
+"""Test environment: force an 8-device virtual CPU mesh before jax imports.
+
+Mirrors SURVEY §4's implication: mesh-sharded scans are tested on CPU via
+``xla_force_host_platform_device_count`` (the role the in-process mock TiKV
+cluster plays in the reference tests, backend_test.go:171-178).
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()

@@ -1,0 +1,66 @@
+"""Key codec tests. Reference parity: coder/normal_test.go:23 (TestCompatible)
+plus order-preservation properties the device block store depends on."""
+
+import pytest
+
+from kubebrain_tpu import coder
+
+
+def test_roundtrip():
+    for key in [b"/registry/pods/default/nginx", b"a", b"\xff" * 40, b"k\x00mid"]:
+        for rev in [0, 1, 7, 2**31, 2**63 - 1]:
+            internal = coder.encode_object_key(key, rev)
+            got_key, got_rev = coder.decode(internal)
+            assert got_key == key and got_rev == rev
+
+
+def test_revision_key_sorts_first():
+    key = b"/registry/pods/x"
+    rk = coder.encode_revision_key(key)
+    versions = [coder.encode_object_key(key, r) for r in (1, 2, 100, 2**40)]
+    assert all(rk < v for v in versions)
+    assert versions == sorted(versions)
+
+
+def test_order_groups_by_user_key():
+    # NUL-free keys: version chains of distinct keys never interleave.
+    keys = [b"/a", b"/a/b", b"/a/c", b"/ab", b"/b"]
+    internals = []
+    for k in sorted(keys):
+        for r in (0, 1, 9, 2**33):
+            internals.append(coder.encode_object_key(k, r))
+    assert internals == sorted(internals)
+
+
+def test_decode_rejects_garbage():
+    with pytest.raises(coder.CodecError):
+        coder.decode(b"short")
+    with pytest.raises(coder.CodecError):
+        coder.decode(b"XXXX" + b"key" + b"\x00" + b"\x00" * 8)
+    good = coder.encode_object_key(b"key", 5)
+    bad_split = good[: len(good) - 9] + b"\x01" + good[-8:]
+    with pytest.raises(coder.CodecError):
+        coder.decode(bad_split)
+
+
+def test_rev_value():
+    assert coder.decode_rev_value(coder.encode_rev_value(42)) == (42, False)
+    assert coder.decode_rev_value(coder.encode_rev_value(42, deleted=True)) == (42, True)
+    with pytest.raises(coder.CodecError):
+        coder.decode_rev_value(b"\x00" * 5)
+
+
+def test_prefix_end():
+    assert coder.prefix_end(b"/registry/") == b"/registry0"
+    assert coder.prefix_end(b"a\xff") == b"b"
+    assert coder.prefix_end(b"\xff\xff") == b""
+    # every key with the prefix is < prefix_end
+    pe = coder.prefix_end(b"/reg")
+    assert b"/reg/zzz" < pe and b"/reg\xff\xff" < pe
+
+
+def test_internal_range_covers_all_versions():
+    lo, hi = coder.internal_range(b"/a", b"/b")
+    assert lo <= coder.encode_object_key(b"/a", 0)
+    assert coder.encode_object_key(b"/az", 2**60) < hi
+    assert hi <= coder.encode_object_key(b"/b", 0)

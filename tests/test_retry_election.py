@@ -1,0 +1,171 @@
+"""Uncertain-write repair + leader election tests.
+
+Reference: TestUncertainRewrite backend_test.go:1268-1386 (inject uncertain
+events, assert repair converges and emits the right event sequence);
+testBackendResourceLock :1044 (two backends racing over one KV lock).
+"""
+
+import time
+
+import pytest
+
+from kubebrain_tpu import coder
+from kubebrain_tpu.backend import Backend, BackendConfig, Verb, WatchEvent, wait_for_revision
+from kubebrain_tpu.backend.election import LeaderElection, ResourceLock
+from kubebrain_tpu.storage import new_storage
+from kubebrain_tpu.storage.errors import UncertainResultError
+
+
+@pytest.fixture
+def backend():
+    store = new_storage("memkv")
+    b = Backend(store, BackendConfig(event_ring_capacity=1024, watch_cache_capacity=1024))
+    yield b
+    b.close()
+    store.close()
+
+
+class FlakyCommit:
+    """Engine decorator whose batch commit succeeds but REPORTS uncertainty —
+    the classic distributed commit-timeout (fault injection by decoration,
+    reference compact_test.go:83-132)."""
+
+    def __init__(self, store, fail_times=1):
+        self._store = store
+        self.remaining = fail_times
+
+    def __getattr__(self, name):
+        return getattr(self._store, name)
+
+    def begin_batch_write(self):
+        real = self._store.begin_batch_write()
+        outer = self
+
+        class B:
+            def __getattr__(self, name):
+                return getattr(real, name)
+
+            def commit(self):
+                real.commit()
+                if outer.remaining > 0:
+                    outer.remaining -= 1
+                    raise UncertainResultError("injected commit timeout")
+
+        return B()
+
+
+def test_uncertain_create_repair():
+    store = new_storage("memkv")
+    flaky = FlakyCommit(store, fail_times=1)
+    b = Backend(flaky, BackendConfig(event_ring_capacity=1024))
+    b.retry._probe_after = 0.0  # probe immediately in tests
+    wid, q = b.watcher_hub.add_watcher(b"", 0)
+    with pytest.raises(UncertainResultError):
+        b.create(b"/k", b"v")
+    assert wait_for_revision(b, 1)
+    assert len(b.retry) == 1
+    assert b.retry.min_revision() == 1
+    resolved = b.retry.process_ready()
+    assert resolved == 1
+    # repair rewrote the value at revision 2 and emitted a proper event
+    assert wait_for_revision(b, 2)
+    kv = b.get(b"/k")
+    assert kv.value == b"v" and kv.revision == 2
+    batch = q.get(timeout=5)
+    assert [(e.revision, e.verb, e.key) for e in batch] == [(2, Verb.CREATE, b"/k")]
+    assert len(b.retry) == 0 and b.retry.min_revision() == 0
+    b.close()
+    store.close()
+
+
+def test_uncertain_never_landed_dropped(backend):
+    """If the revision record doesn't match the uncertain revision, the op
+    failed (or was superseded): the retry must drop it silently."""
+    r1 = backend.create(b"/k", b"v1")
+    backend.retry._probe_after = 0.0
+    ghost = WatchEvent(revision=99, verb=Verb.PUT, key=b"/k", value=b"ghost", valid=False)
+    backend.retry.append(ghost)
+    assert backend.retry.process_ready() == 1
+    assert backend.get(b"/k").value == b"v1"
+    assert backend.get(b"/k").revision == r1
+
+
+def test_uncertain_bounds_compaction(backend):
+    r1 = backend.create(b"/k", b"v1")
+    r2 = backend.update(b"/k", b"v2", r1)
+    assert wait_for_revision(backend, r2)
+    ghost = WatchEvent(revision=r1, verb=Verb.CREATE, key=b"/zzz", value=b"g", valid=False)
+    backend.retry.append(ghost)
+    # compact clamps to min-uncertain − 1 == r1 − 1 == 0 → no-op
+    assert backend.compact(r2) == 0
+
+
+def test_uncertain_delete_repair():
+    store = new_storage("memkv")
+    flaky = FlakyCommit(store, fail_times=0)
+    b = Backend(flaky, BackendConfig(event_ring_capacity=1024))
+    b.retry._probe_after = 0.0
+    r1 = b.create(b"/k", b"v1")
+    flaky.remaining = 1  # next commit (the delete) reports uncertain
+    with pytest.raises(UncertainResultError):
+        b.delete(b"/k")
+    assert wait_for_revision(b, 2)
+    assert b.retry.process_ready() == 1
+    assert wait_for_revision(b, 3)
+    record = b._read_rev_record(b"/k")
+    assert record is not None and record[1] is True  # still deleted
+    assert record[0] == 3  # at the repaired revision
+    raw = store.get(coder.encode_object_key(b"/k", 3))
+    from kubebrain_tpu.backend import TOMBSTONE
+
+    assert raw == TOMBSTONE
+    b.close()
+    store.close()
+
+
+# ---------------------------------------------------------------- election
+def test_resource_lock_acquire_steal():
+    store = new_storage("memkv")
+    lock_a = ResourceLock(store, "node-a")
+    lock_b = ResourceLock(store, "node-b")
+    ea = LeaderElection(lock_a, lease_seconds=0.3, renew_interval=0.05, retry_interval=0.02)
+    eb = LeaderElection(lock_b, lease_seconds=0.3, renew_interval=0.05, retry_interval=0.02)
+    assert ea.try_acquire_once()
+    assert not eb.try_acquire_once()
+    assert ea.leader_identity() == "node-a"
+    # lease expires without renewal → b steals
+    time.sleep(0.35)
+    assert eb.try_acquire_once()
+    assert eb.leader_identity() == "node-b"
+    store.close()
+
+
+def test_election_campaign_callbacks():
+    store = new_storage("memkv")
+    store_rev_seen = []
+    ea = LeaderElection(
+        ResourceLock(store, "node-a"),
+        on_started_leading=lambda rev: store_rev_seen.append(rev),
+        lease_seconds=0.5,
+        renew_interval=0.05,
+        retry_interval=0.02,
+    )
+    ea.campaign()
+    assert ea.wait_for_leadership(2.0)
+    assert store_rev_seen and store_rev_seen[0] >= 0
+    ea.close()
+    store.close()
+
+
+def test_lock_tso_seeds_revision():
+    """The lock record carries the engine clock so a new leader resumes
+    revisions monotonically (election.go Describe → leader.go:96-107)."""
+    store = new_storage("memkv")
+    b = Backend(store, BackendConfig(event_ring_capacity=1024))
+    b.create(b"/k", b"v")
+    assert wait_for_revision(b, 1)
+    lock = ResourceLock(store, "node-a")
+    rec = lock.create()
+    assert rec.tso >= 1
+    b.close()
+    store.close()

@@ -1,0 +1,145 @@
+"""Watch pipeline tests: ring cache, hub fan-out, registration + live tail.
+
+Reference: ring_test.go TestRing :26, testBackendWriteAndWatch :1177,
+watch.go error cases :60-84, watcherhub.go slow-consumer drop :82-90.
+"""
+
+import queue
+
+import pytest
+
+from kubebrain_tpu.backend import (
+    Backend,
+    BackendConfig,
+    Verb,
+    WatchEvent,
+    WatchExpiredError,
+    wait_for_revision,
+)
+from kubebrain_tpu.backend.ring import Ring
+from kubebrain_tpu.backend.watcherhub import WatcherHub
+from kubebrain_tpu.storage import new_storage
+
+
+# ----------------------------------------------------------------------- Ring
+def test_ring_wraparound_and_find():
+    r = Ring(4)
+    for rev in range(1, 8):  # 7 events into cap-4 ring
+        r.add(WatchEvent(revision=rev, key=b"k"))
+    assert len(r) == 4
+    assert r.oldest_revision() == 4
+    assert r.latest_revision() == 7
+    assert [e.revision for e in r.find_events(5)] == [5, 6, 7]
+    assert [e.revision for e in r.find_events(1)] == [4, 5, 6, 7]
+    assert r.find_events(8) == []
+
+
+# ------------------------------------------------------------------------ Hub
+def test_hub_fanout_filters():
+    hub = WatcherHub()
+    _, qa = hub.add_watcher(b"/a", 0)
+    _, qb = hub.add_watcher(b"/b", 0)
+    _, qlate = hub.add_watcher(b"", 3)
+    batch = [
+        WatchEvent(revision=1, key=b"/a/1"),
+        WatchEvent(revision=2, key=b"/b/1"),
+        WatchEvent(revision=3, key=b"/a/2"),
+    ]
+    hub.stream(batch)
+    assert [e.revision for e in qa.get_nowait()] == [1, 3]
+    assert [e.revision for e in qb.get_nowait()] == [2]
+    assert [e.revision for e in qlate.get_nowait()] == [3]
+
+
+def test_hub_drops_slow_consumer(monkeypatch):
+    import kubebrain_tpu.backend.watcherhub as wh
+
+    monkeypatch.setattr(wh, "SUBSCRIBER_BUFFER", 2)
+    hub = WatcherHub()
+    wid, q = hub.add_watcher(b"", 0)
+    for rev in range(1, 5):  # buffer 2 → third push drops the watcher
+        hub.stream([WatchEvent(revision=rev, key=b"/k")])
+    assert hub.watcher_count() == 0
+    drained = []
+    while True:
+        item = q.get_nowait()
+        if item is None:
+            break
+        drained.append(item)
+    # one buffered batch was evicted to make room for the poison pill
+    assert len(drained) == 1
+
+
+# ------------------------------------------------------------------- Backend
+@pytest.fixture
+def backend():
+    store = new_storage("memkv")
+    b = Backend(store, BackendConfig(event_ring_capacity=1024, watch_cache_capacity=64))
+    yield b
+    b.close()
+    store.close()
+
+
+def collect(q, n, timeout=5.0):
+    out = []
+    while len(out) < n:
+        batch = q.get(timeout=timeout)
+        assert batch is not None, "watch closed early"
+        out.extend(batch)
+    return out
+
+
+def test_watch_live_tail(backend):
+    wid, q = backend.watch(b"/registry/")
+    r1 = backend.create(b"/registry/a", b"v1")
+    r2 = backend.update(b"/registry/a", b"v2", r1)
+    r3, _ = backend.delete(b"/registry/a")
+    backend.create(b"/other/x", b"nope")  # filtered out
+    events = collect(q, 3)
+    assert [(e.revision, e.verb) for e in events] == [
+        (r1, Verb.CREATE),
+        (r2, Verb.PUT),
+        (r3, Verb.DELETE),
+    ]
+    assert events[2].prev_revision == r2
+    backend.unwatch(wid)
+
+
+def test_watch_catchup_replay(backend):
+    r1 = backend.create(b"/registry/a", b"v1")
+    r2 = backend.create(b"/registry/b", b"v2")
+    assert wait_for_revision(backend, r2)
+    # register at r1: replay r1..r2 from cache, then live events follow
+    wid, q = backend.watch(b"/registry/", revision=r1)
+    events = collect(q, 2)
+    assert [e.revision for e in events] == [r1, r2]
+    r3 = backend.create(b"/registry/c", b"v3")
+    events = collect(q, 1)
+    assert events[0].revision == r3
+    backend.unwatch(wid)
+
+
+def test_watch_too_old_revision_expires(backend):
+    # cache cap is 64: push enough events to evict revision 1
+    for i in range(80):
+        backend.create(b"/registry/k%03d" % i, b"v")
+    assert wait_for_revision(backend, 80)
+    with pytest.raises(WatchExpiredError):
+        backend.watch(b"/registry/", revision=1)
+
+
+def test_watch_failed_writes_invisible(backend):
+    """Failed ops consume revisions but never reach watchers."""
+    from kubebrain_tpu.backend import KeyExistsError
+
+    wid, q = backend.watch(b"/")
+    backend.create(b"/a", b"v")
+    with pytest.raises(KeyExistsError):
+        backend.create(b"/a", b"dup")
+    backend.create(b"/b", b"v")
+    events = collect(q, 2)
+    assert [e.key for e in events] == [b"/a", b"/b"]
+    assert [e.revision for e in events] == [1, 3]  # rev 2 was the failed dup
+    with pytest.raises(queue.Empty):
+        q.get_nowait()
+    backend.unwatch(wid)
