@@ -68,13 +68,14 @@ class Backend:
         self.watch_cache = Ring(self.config.watch_cache_capacity)
         self.watcher_hub = WatcherHub(fanout_matcher=self.config.fanout_matcher)
         self.retry = AsyncFifoRetry(self._read_rev_record, self._retry_rewrite)
-        self.scanner = Scanner(
-            store,
+        scanner_kw = dict(
             get_compact_revision=lambda _snap: self._compact_revision_cached(),
             retry_min_revision=self.retry.min_revision,
             compact_history=CompactHistory(),
             max_workers=self.config.scanner_workers,
         )
+        # engines with their own scan offload (tpu) supply the scanner
+        self.scanner = store.make_scanner(**scanner_kw) or Scanner(store, **scanner_kw)
         # compact watermark cache: -1 unknown; refreshed at most once per
         # COMPACT_CACHE_TTL so hot reads don't pay an engine round-trip
         # (local compactions update it synchronously; the TTL bounds follower

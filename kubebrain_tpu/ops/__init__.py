@@ -1,0 +1,14 @@
+"""TPU kernels for the MVCC hot loops.
+
+The reference's hot loops are byte-key comparison, revision filtering, MVCC
+visibility selection, GC victim marking, and watch fan-out filtering
+(scanner worker.run scanner.go:389-516; watcherhub.go:78-100). Here they are
+vectorized JAX/Pallas ops over fixed-width packed key blocks:
+
+- ``keys``   — pack variable-length NUL-free keys into big-endian ``uint32``
+  lane chunks so lexicographic byte order == vectorized u32 tuple order.
+- ``scan``   — blockwise range/visibility/count kernels (the north-star
+  "prefix-match + revision-filter" kernel).
+- ``fanout`` — (events × watchers) prefix-match mask for watch broadcast.
+- ``compact``— GC victim mask (superseded versions, tombstones, TTL).
+"""

@@ -1,0 +1,80 @@
+"""Compaction / GC victim-mask kernel.
+
+Reference: the compact branches of the scan worker (scanner.go:445-491) — in
+one pass over a sorted block, mark rows that compaction at ``compact_rev``
+makes unreachable:
+
+- superseded: a newer version of the same key exists at <= compact_rev;
+- dead tombstone: the row is a tombstone and is the last version
+  <= compact_rev (nothing can ever read it again);
+- TTL-expired: every version of a TTL key (``/events/``) is <= the TTL
+  cutoff revision (derived from the compact-history log when the engine has
+  no native TTL, scanner.go:566-591).
+
+The mask comes back to the host, which applies the deletes to the
+authoritative store and shrinks the device mirror by compaction-gather —
+the "pmap'd k-way merge + tombstone sweep" of the north star is this mask +
+a gather, fanned out per partition over the mesh.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .scan import rev_leq, same_as_next
+
+
+@jax.jit
+def victim_mask(
+    keys: jnp.ndarray,     # uint32[N, C] sorted packed user keys
+    rev_hi: jnp.ndarray,   # uint32[N]
+    rev_lo: jnp.ndarray,   # uint32[N]
+    tomb: jnp.ndarray,     # bool[N]
+    ttl_key: jnp.ndarray,  # bool[N] row belongs to a TTL (/events/) key
+    n_valid: jnp.ndarray,  # int32 scalar
+    compact_hi: jnp.ndarray,
+    compact_lo: jnp.ndarray,
+    ttl_cutoff_hi: jnp.ndarray,  # TTL cutoff revision (0 = disabled)
+    ttl_cutoff_lo: jnp.ndarray,
+) -> jnp.ndarray:
+    """bool[N]: version rows deletable when compacting to compact_rev."""
+    n = keys.shape[0]
+    valid = jnp.arange(n) < n_valid
+    le_compact = valid & rev_leq(rev_hi, rev_lo, compact_hi, compact_lo)
+    same_next = same_as_next(keys)
+    le_next = jnp.roll(le_compact, -1)
+    superseded = le_compact & same_next & le_next
+    is_last_le = le_compact & ~(same_next & le_next)
+    dead_tombstone = is_last_le & tomb
+
+    # TTL expiry: a group is expired ⇔ its LAST row (any revision) is <= the
+    # cutoff. Broadcast the group-last verdict backwards with a bounded
+    # linear carry: version chains are short post-compaction, so MAX_CHAIN
+    # steps of (same_next & next_expired) cover real chains; longer chains
+    # just expire over successive compactions.
+    ttl_enabled = (ttl_cutoff_hi > 0) | (ttl_cutoff_lo > 0)
+    last_of_group = valid & ~same_next
+    last_le_cutoff = last_of_group & rev_leq(rev_hi, rev_lo, ttl_cutoff_hi, ttl_cutoff_lo)
+    MAX_CHAIN = 64
+    expired = last_le_cutoff
+    run = same_next  # run[i]: rows i..i+step are one group
+    step = 1
+    while step < MAX_CHAIN:
+        expired = expired | (run & jnp.roll(expired, -step))
+        run = run & jnp.roll(run, -step)
+        step *= 2
+    expired = expired & ttl_enabled & ttl_key & valid
+
+    return superseded | dead_tombstone | expired
+
+
+def compact_block(keys, rev_hi, rev_lo, tomb, mask):
+    """Shrink a block by dropping masked rows (device-side gather); returns
+    (keys, rev_hi, rev_lo, tomb, new_count). Order is preserved so the block
+    stays sorted."""
+    keep = ~mask
+    n = keys.shape[0]
+    (idx,) = jnp.nonzero(keep, size=n, fill_value=n - 1)
+    new_count = jnp.sum(keep, dtype=jnp.int32)
+    return keys[idx], rev_hi[idx], rev_lo[idx], tomb[idx], new_count

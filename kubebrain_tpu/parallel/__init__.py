@@ -1,0 +1,20 @@
+"""Device-mesh parallelism for the MVCC data plane.
+
+The reference scales scans/compaction by running one Go worker per storage
+partition (scanner.go:264-288) and fans watch events out over subscriber
+channels (watcherhub.go:78). The TPU equivalents (SURVEY §2.9):
+
+- P1/P2: partitions = a mesh axis; each device owns the sorted block(s) of
+  its key-range shard; scan/compact kernels run under shard_map with no
+  cross-device traffic except the final count psum / result gather — blocks
+  are split at user-key boundaries so shards are fully independent.
+- P4: watch fan-out shards the *watcher table* over the mesh; events are
+  replicated (small) and the (E × W) mask is computed shard-local, then
+  gathered.
+- Cross-host control plane (revision sync, election) stays on gRPC/DCN —
+  see kubebrain_tpu/server/service.
+"""
+
+from .mesh import make_mesh, partition_spec
+
+__all__ = ["make_mesh", "partition_spec"]

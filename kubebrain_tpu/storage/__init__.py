@@ -175,6 +175,13 @@ class KvStorage(abc.ABC):
         pkg/storage/interface.go:28-31. Default: self."""
         return self
 
+    def make_scanner(self, **kwargs):
+        """Engines that bring their own scan offload (the ``tpu`` engine)
+        return a backend Scanner here; None selects the generic iterator
+        scanner. Mirrors how the reference picks partition-parallel scan
+        behavior from the engine's GetPartitions shape."""
+        return None
+
     def close(self) -> None:  # pragma: no cover - default no-op
         pass
 

@@ -1,0 +1,6 @@
+"""TPU block-store engine (``--storage=tpu``)."""
+
+from .blocks import Mirror, build_mirror
+from .engine import TpuKvStorage, TpuScanner
+
+__all__ = ["Mirror", "build_mirror", "TpuKvStorage", "TpuScanner"]

@@ -1,0 +1,142 @@
+"""HBM-resident sorted block mirror of the MVCC keyspace.
+
+The TiKV-role engine re-imagined for TPU (SURVEY §2.8): the authoritative
+store stays on host (writes are pointwise and CAS-heavy — wrong for TPU);
+the *scan-hot columns* (packed user key, revision, tombstone flag) are
+mirrored into device HBM as P sorted partitions, padded to a common row
+count and sharded over the mesh's ``part`` axis. Values never leave the
+host — kernels decide *which* rows are visible; the host materializes bytes
+by row index (the same division of labor as reference workers streaming
+KVs out of engine iterators, scanner.go:395-427).
+
+Partition borders are always user-key-aligned (adjustPartitionBorders,
+scanner.go:202-225) so no version chain straddles devices and shard-local
+kernels need no cross-device carry.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ...ops import keys as keyops
+
+
+@dataclass
+class Mirror:
+    # device (sharded over "part" on axis 0)
+    keys_dev: jax.Array     # uint32[P, N, C]
+    rh_dev: jax.Array       # uint32[P, N]
+    rl_dev: jax.Array       # uint32[P, N]
+    tomb_dev: jax.Array     # bool[P, N]
+    ttl_dev: jax.Array      # bool[P, N]
+    n_valid_dev: jax.Array  # int32[P]
+    # host copies (row-aligned with device arrays)
+    keys_host: np.ndarray   # uint32[P, N, C]
+    revs_host: np.ndarray   # uint64[P, N]
+    tomb_host: np.ndarray   # bool[P, N]
+    n_valid: np.ndarray     # int32[P]
+    user_keys: list[list[bytes]]   # per partition, per row
+    values: list[list[bytes]]      # per partition, per row
+    snapshot_ts: int
+    max_rev: int
+
+    @property
+    def partitions(self) -> int:
+        return self.keys_host.shape[0]
+
+    @property
+    def rows(self) -> int:
+        return int(self.n_valid.sum())
+
+    def partition_first_keys(self) -> list[bytes]:
+        out = []
+        for p in range(self.partitions):
+            out.append(self.user_keys[p][0] if self.n_valid[p] > 0 else b"")
+        return out
+
+
+TTL_PREFIX = b"/events/"
+
+
+def build_mirror(
+    rows: list[tuple[bytes, int, bytes]],
+    mesh,
+    key_width: int,
+    snapshot_ts: int,
+) -> Mirror:
+    """Build a Mirror from sorted (user_key, revision, value) version rows.
+
+    Splits into P = mesh-size partitions balanced by row count, never
+    splitting a user key's version chain across partitions.
+    """
+    n_parts = int(np.prod(mesh.devices.shape)) if mesh is not None else 1
+    n = len(rows)
+    # choose user-key-aligned split offsets
+    offsets = [0]
+    target = max(1, (n + n_parts - 1) // n_parts)
+    for p in range(1, n_parts):
+        pos = min(p * target, n)
+        while 0 < pos < n and rows[pos][0] == rows[pos - 1][0]:
+            pos += 1  # don't split a version chain
+        pos = max(pos, offsets[-1])
+        offsets.append(pos)
+    offsets.append(n)
+    counts = [offsets[i + 1] - offsets[i] for i in range(n_parts)]
+    n_max = max(max(counts), 8)
+
+    c = key_width // 4
+    keys_h = np.zeros((n_parts, n_max, c), dtype=np.uint32)
+    revs_h = np.zeros((n_parts, n_max), dtype=np.uint64)
+    tomb_h = np.zeros((n_parts, n_max), dtype=bool)
+    ttl_h = np.zeros((n_parts, n_max), dtype=bool)
+    user_keys: list[list[bytes]] = []
+    values: list[list[bytes]] = []
+    max_rev = 0
+
+    from ...backend.common import TOMBSTONE
+
+    for p in range(n_parts):
+        part_rows = rows[offsets[p] : offsets[p + 1]]
+        uks = [r[0] for r in part_rows]
+        if part_rows:
+            packed, _ = keyops.pack_keys(uks, key_width)
+            keys_h[p, : len(part_rows)] = packed
+            revs = np.array([r[1] for r in part_rows], dtype=np.uint64)
+            revs_h[p, : len(part_rows)] = revs
+            tomb_h[p, : len(part_rows)] = [r[2] == TOMBSTONE for r in part_rows]
+            ttl_h[p, : len(part_rows)] = [uk.startswith(TTL_PREFIX) for uk in uks]
+            max_rev = max(max_rev, int(revs.max()))
+        user_keys.append(uks)
+        values.append([r[2] for r in part_rows])
+
+    rh, rl = keyops.split_revs(revs_h.reshape(-1))
+    rh = rh.reshape(n_parts, n_max)
+    rl = rl.reshape(n_parts, n_max)
+    n_valid = np.array(counts, dtype=np.int32)
+
+    def put(arr, *trailing_none):
+        if mesh is None:
+            return jax.device_put(arr)
+        spec = PartitionSpec("part", *(None,) * (arr.ndim - 1))
+        return jax.device_put(arr, NamedSharding(mesh, spec))
+
+    return Mirror(
+        keys_dev=put(keys_h),
+        rh_dev=put(rh),
+        rl_dev=put(rl),
+        tomb_dev=put(tomb_h),
+        ttl_dev=put(ttl_h),
+        n_valid_dev=put(n_valid),
+        keys_host=keys_h,
+        revs_host=revs_h,
+        tomb_host=tomb_h,
+        n_valid=n_valid,
+        user_keys=user_keys,
+        values=values,
+        snapshot_ts=snapshot_ts,
+        max_rev=max_rev,
+    )

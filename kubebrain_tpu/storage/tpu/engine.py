@@ -1,0 +1,464 @@
+"""The ``tpu`` storage engine: host-authoritative store + HBM scan mirror.
+
+Division of labor (SURVEY §7 build plan, step 4):
+
+- **writes / point reads / CAS**: delegated to a host engine (memkv for
+  tests, the C++ native store in production) — pointwise, latency-bound,
+  wrong shape for TPU;
+- **range scans / counts / compaction decisions**: the device mirror
+  (blocks.Mirror) + the kernels in kubebrain_tpu.ops, vmapped over the
+  partition axis and sharded across the mesh;
+- **freshness**: committed version rows are appended to a host-side delta
+  log by the batch decorator; queries overlay the delta (all delta revisions
+  exceed every published revision, so overlay-wins resolution is exact);
+  the delta is merged into the mirror once it crosses a threshold.
+  Uncertain commits poison the mirror (force rebuild from the store) —
+  the store is the only source of truth for maybe-applied writes.
+
+This mirrors the reference's TiKV adapter role (pkg/storage/tikv) with the
+region map replaced by mesh partitions (SURVEY §2.10: mesh sharding mirrors
+storage sharding through GetPartitions).
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ... import coder
+from ...backend.common import TOMBSTONE
+from ...backend.scanner import CompactHistory, CompactStats, Scanner
+from ...ops import keys as keyops
+from ...ops.compact import victim_mask
+from ...ops.scan import lex_geq, lex_less, visibility_mask
+from ...parallel.mesh import make_mesh
+from .. import BatchWrite, CASFailedError, KvStorage, Partition, register_engine
+from ..errors import UncertainResultError
+from .blocks import TTL_PREFIX, Mirror, build_mirror
+
+
+@jax.jit
+def _vis_batch(keys, rh, rl, tomb, nv, start, end, unb, qhi, qlo):
+    """Visibility masks for all partitions: [P, N] bool + [P] counts."""
+    f = lambda k, a, b, t, n: visibility_mask(k, a, b, t, n, start, end, unb, qhi, qlo)
+    mask = jax.vmap(f)(keys, rh, rl, tomb, nv)
+    return mask, jnp.sum(mask, axis=1, dtype=jnp.int32)
+
+
+@jax.jit
+def _victim_batch(keys, rh, rl, tomb, ttl, nv, start, end, unb, chi, clo, thi, tlo):
+    """Compaction victim masks for all partitions, range-restricted."""
+    f = lambda k, a, b, t, x, n: victim_mask(k, a, b, t, x, n, chi, clo, thi, tlo)
+    mask = jax.vmap(f)(keys, rh, rl, tomb, ttl, nv)
+    rng = jax.vmap(lambda k: lex_geq(k, start) & (unb | lex_less(k, end)))(keys)
+    return mask & rng
+
+
+class TpuScanner(Scanner):
+    """Scanner contract over the device mirror; host fallback for small
+    limit queries (one engine iter beats a kernel launch for a 500-row page).
+    """
+
+    def __init__(
+        self,
+        store: KvStorage,
+        get_compact_revision,
+        retry_min_revision=lambda: 0,
+        compact_history: CompactHistory | None = None,
+        max_workers: int = 8,
+        mesh=None,
+        key_width: int = keyops.KEY_WIDTH,
+        merge_threshold: int = 4096,
+        host_limit_threshold: int = 1024,
+    ):
+        super().__init__(store, get_compact_revision, retry_min_revision, compact_history, max_workers)
+        self._mesh = mesh if mesh is not None else make_mesh()
+        self._kw = key_width
+        self._merge_threshold = merge_threshold
+        self._host_limit_threshold = host_limit_threshold
+        self._mlock = threading.RLock()
+        self._mirror: Mirror | None = None
+        self._delta: list[tuple[bytes, int, bytes]] = []  # (user_key, rev, value)
+        self._force_rebuild = True
+
+    # ------------------------------------------------------------ write feed
+    def record_version_rows(self, rows: list[tuple[bytes, int, bytes]]) -> None:
+        with self._mlock:
+            self._delta.extend(rows)
+
+    def mark_uncertain(self) -> None:
+        """A commit with unknowable outcome may or may not have produced
+        rows; only the store knows — rebuild the mirror from it."""
+        with self._mlock:
+            self._force_rebuild = True
+
+    # -------------------------------------------------------------- publish
+    def _ensure_published(self, full: bool = False) -> None:
+        with self._mlock:
+            if self._force_rebuild or self._mirror is None:
+                self._rebuild_from_store()
+            elif self._delta and (full or len(self._delta) >= self._merge_threshold):
+                self._merge_delta()
+
+    def _rebuild_from_store(self) -> None:
+        snapshot = self._store.get_timestamp_oracle()
+        lo, hi = coder.internal_range(b"", b"")
+        rows: list[tuple[bytes, int, bytes]] = []
+        for ikey, value in self._store.iter(lo, hi, snapshot_ts=snapshot):
+            ukey, rev = coder.decode(ikey)
+            if rev != 0:
+                rows.append((ukey, rev, value))
+        self._mirror = build_mirror(rows, self._mesh, self._kw, snapshot)
+        self._delta = []
+        self._force_rebuild = False
+
+    def _merge_delta(self) -> None:
+        m = self._mirror
+        old_rows: list[tuple[bytes, int, bytes]] = []
+        for p in range(m.partitions):
+            nv = int(m.n_valid[p])
+            old_rows.extend(
+                (m.user_keys[p][i], int(m.revs_host[p][i]), m.values[p][i])
+                for i in range(nv)
+            )
+        merged = sorted(old_rows + self._delta, key=lambda r: (r[0], r[1]))
+        self._mirror = build_mirror(merged, self._mesh, self._kw, self._store.get_timestamp_oracle())
+        self._delta = []
+
+    def publish(self) -> None:
+        """Force the mirror fully up to date (bench/startup hook)."""
+        self._ensure_published(full=True)
+
+    # -------------------------------------------------------------- queries
+    def _query_bounds(self, start: bytes, end: bytes):
+        s = jnp.asarray(keyops.pack_one(start, self._kw))
+        unbounded = not end
+        e = jnp.asarray(keyops.pack_one(end if end else b"", self._kw))
+        return s, e, jnp.asarray(unbounded)
+
+    def _device_visible(self, mirror: Mirror, start: bytes, end: bytes, read_rev: int):
+        s, e, unb = self._query_bounds(start, end)
+        qhi, qlo = keyops.split_revs(np.array([read_rev], dtype=np.uint64))
+        mask, counts = _vis_batch(
+            mirror.keys_dev, mirror.rh_dev, mirror.rl_dev, mirror.tomb_dev,
+            mirror.n_valid_dev, s, e, unb,
+            jnp.asarray(qhi[0]), jnp.asarray(qlo[0]),
+        )
+        return np.asarray(mask), np.asarray(counts)
+
+    def _delta_overlay(
+        self, delta: list[tuple[bytes, int, bytes]], start: bytes, end: bytes, read_rev: int
+    ) -> dict[bytes, tuple[int, bytes] | None]:
+        """Per user key: latest delta version <= read_rev in [start, end).
+        None value ⇒ tombstoned. Delta revisions all exceed published
+        revisions, so any entry here overrides the device result."""
+        out: dict[bytes, tuple[int, bytes] | None] = {}
+        # delta is in commit order and per-key revisions only grow, so the
+        # last qualifying entry per key wins
+        for ukey, rev, value in delta:
+            if ukey < start or (end and ukey >= end):
+                continue
+            if rev > read_rev:
+                continue
+            out[ukey] = None if value == TOMBSTONE else (rev, value)
+        return out
+
+    def range_(self, start: bytes, end: bytes, read_revision: int, limit: int = 0):
+        if limit and limit <= self._host_limit_threshold:
+            return super().range_(start, end, read_revision, limit)
+        self._snapshot_checked(read_revision)
+        self._ensure_published()
+        with self._mlock:
+            mirror = self._mirror
+            delta = list(self._delta)
+        mask, _counts = self._device_visible(mirror, start, end, read_revision)
+        overlay = self._delta_overlay(delta, start, end, read_revision)
+        from ...backend.common import KeyValue
+
+        kvs: list[KeyValue] = []
+        for p in range(mirror.partitions):
+            for i in np.nonzero(mask[p])[0]:
+                uk = mirror.user_keys[p][i]
+                if uk in overlay:
+                    continue  # delta supersedes
+                kvs.append(KeyValue(uk, mirror.values[p][i], int(mirror.revs_host[p][i])))
+        for uk, entry in overlay.items():
+            if entry is not None:
+                kvs.append(KeyValue(uk, entry[1], entry[0]))
+        kvs.sort(key=lambda kv: kv.key)
+        if limit:
+            return kvs[:limit], len(kvs) > limit
+        return kvs, False
+
+    def count(self, start: bytes, end: bytes, read_revision: int) -> int:
+        self._snapshot_checked(read_revision)
+        self._ensure_published()
+        with self._mlock:
+            mirror = self._mirror
+            delta = list(self._delta)
+        _mask, counts = self._device_visible(mirror, start, end, read_revision)
+        total = int(counts.sum())
+        overlay = self._delta_overlay(delta, start, end, read_revision)
+        for uk, entry in overlay.items():
+            had = self._host_visible(mirror, uk, read_revision)
+            if entry is None and had:
+                total -= 1
+            elif entry is not None and not had:
+                total += 1
+        return total
+
+    def _host_visible(self, mirror: Mirror, ukey: bytes, read_rev: int) -> bool:
+        """Host-side point visibility check against the published mirror."""
+        p = self._partition_of(mirror, ukey)
+        uks = mirror.user_keys[p]
+        nv = int(mirror.n_valid[p])
+        lo = bisect.bisect_left(uks, ukey, 0, nv)
+        hi = bisect.bisect_right(uks, ukey, 0, nv)
+        best = None
+        for i in range(lo, hi):
+            rev = int(mirror.revs_host[p][i])
+            if rev <= read_rev:
+                best = i
+        return best is not None and not bool(mirror.tomb_host[p][best])
+
+    @staticmethod
+    def _partition_of(mirror: Mirror, ukey: bytes) -> int:
+        firsts = mirror.partition_first_keys()
+        p = 0
+        for i, fk in enumerate(firsts):
+            if fk and fk <= ukey:
+                p = i
+        return p
+
+    # -------------------------------------------------------------- compact
+    def compact(self, start: bytes, end: bytes, compact_revision: int) -> CompactStats:
+        """Device-side victim marking + host deletes (the north-star
+        compaction path). ``start``/``end`` are internal-key borders from the
+        backend (compact.go:107-126); rev-record GC and TTL bookkeeping
+        follow the generic scanner's rules."""
+        self._ensure_published(full=True)
+        with self._mlock:
+            mirror = self._mirror
+        # bypass the delta tracker for our own GC deletes — compact updates
+        # the mirror itself at the end
+        store = getattr(self._store, "untracked", self._store.exclusive_client)()
+        self.compact_history.log(compact_revision)
+        ttl_cutoff = 0
+        if not store.support_ttl():
+            from ...backend.scanner import EVENTS_TTL_SECONDS
+
+            ttl_cutoff = self.compact_history.timeout_revision(EVENTS_TTL_SECONDS)
+
+        # internal borders → user-key bounds for the kernels
+        s_user = coder.decode(start)[0] if coder.is_internal_key(start) else b""
+        unbounded = not coder.is_internal_key(end)
+        e_user = b"" if unbounded else coder.decode(end)[0]
+        s, e, unb = self._query_bounds(s_user, e_user)
+        chi, clo = keyops.split_revs(np.array([compact_revision], dtype=np.uint64))
+        thi, tlo = keyops.split_revs(np.array([ttl_cutoff], dtype=np.uint64))
+        mask = np.asarray(
+            _victim_batch(
+                mirror.keys_dev, mirror.rh_dev, mirror.rl_dev, mirror.tomb_dev,
+                mirror.ttl_dev, mirror.n_valid_dev, s, e, unb,
+                jnp.asarray(chi[0]), jnp.asarray(clo[0]),
+                jnp.asarray(thi[0]), jnp.asarray(tlo[0]),
+            )
+        )
+
+        stats = CompactStats(scanned=mirror.rows)
+        retry_min = self._retry_min_revision()
+        BATCH = 256
+        pending: list[bytes] = []
+        surviving: list[tuple[bytes, int, bytes]] = []
+        for p in range(mirror.partitions):
+            nv = int(mirror.n_valid[p])
+            uks = mirror.user_keys[p]
+            i = 0
+            while i < nv:
+                j = i
+                while j < nv and uks[j] == uks[i]:
+                    j += 1
+                group_doomed = 0
+                for r in range(i, j):
+                    if mask[p][r]:
+                        rev = int(mirror.revs_host[p][r])
+                        pending.append(coder.encode_object_key(uks[r], rev))
+                        group_doomed += 1
+                        if mirror.tomb_host[p][r]:
+                            stats.deleted_tombstones += 1
+                        elif r < j - 1:
+                            stats.deleted_versions += 1
+                        else:
+                            stats.expired_ttl += 1
+                    else:
+                        surviving.append(
+                            (uks[r], int(mirror.revs_host[p][r]), mirror.values[p][r])
+                        )
+                # rev-record GC: the whole group is gone and its last row was
+                # a tombstone or TTL-expired (scanner.go:472-491)
+                if group_doomed == j - i and group_doomed > 0:
+                    last_rev = int(mirror.revs_host[p][j - 1])
+                    uncertain_inflight = retry_min and last_rev >= retry_min
+                    if not uncertain_inflight:
+                        raw = coder.encode_rev_value(last_rev, deleted=bool(mirror.tomb_host[p][j - 1]))
+                        try:
+                            store.del_current(coder.encode_revision_key(uks[i]), raw)
+                            stats.deleted_rev_records += 1
+                        except CASFailedError:
+                            # rewritten since the mirror snapshot: keep rows?
+                            # the version rows are still safely deletable
+                            # (superseded/tombstone at <= compact_revision)
+                            pass
+                i = j
+        for b0 in range(0, len(pending), BATCH):
+            batch = store.begin_batch_write()
+            for k in pending[b0 : b0 + BATCH]:
+                batch.delete(k)
+            batch.commit()
+
+        # shrink the mirror in place from the surviving rows + any delta
+        with self._mlock:
+            if self._mirror is mirror:
+                merged = sorted(surviving + self._delta, key=lambda r: (r[0], r[1]))
+                self._mirror = build_mirror(
+                    merged, self._mesh, self._kw, self._store.get_timestamp_oracle()
+                )
+                self._delta = []
+        return stats
+
+
+class TpuKvStorage(KvStorage):
+    """Decorator pairing a host engine with a TpuScanner delta feed.
+
+    Extracted rows: every committed Put to an object key (revision >= 1) is a
+    version row for the mirror. Uncertain commits poison the mirror.
+    """
+
+    def __init__(self, inner: KvStorage, mesh=None, key_width: int = keyops.KEY_WIDTH, **scanner_kw):
+        self._inner = inner
+        self._mesh = mesh
+        self._kw = key_width
+        self._scanner_kw = scanner_kw
+        self._scanner: TpuScanner | None = None
+
+    # ---- scanner wiring (Backend calls make_scanner, storage/__init__.py)
+    def make_scanner(self, **kw) -> TpuScanner:
+        kw.update(self._scanner_kw)
+        self._scanner = TpuScanner(self, mesh=self._mesh, key_width=self._kw, **kw)
+        return self._scanner
+
+    # ---- engine delegation
+    def get_timestamp_oracle(self) -> int:
+        return self._inner.get_timestamp_oracle()
+
+    def get_partitions(self, start: bytes, end: bytes) -> list[Partition]:
+        """Mesh-partition-aligned shard map so host-fallback scans parallel
+        the same way the device does (SURVEY §2.10)."""
+        with_mirror = self._scanner and self._scanner._mirror
+        if not with_mirror:
+            return self._inner.get_partitions(start, end)
+        firsts = [fk for fk in self._scanner._mirror.partition_first_keys() if fk]
+        borders = [coder.encode_revision_key(fk) for fk in firsts]
+        out, left = [], start
+        for b in borders:
+            if left < b and (not end or b < end):
+                out.append(Partition(left, b))
+                left = b
+        out.append(Partition(left, end))
+        return out
+
+    def get(self, key: bytes, snapshot_ts: int | None = None) -> bytes:
+        return self._inner.get(key, snapshot_ts)
+
+    def iter(self, start: bytes, end: bytes, snapshot_ts: int | None = None, limit: int = 0):
+        return self._inner.iter(start, end, snapshot_ts, limit)
+
+    def begin_batch_write(self) -> BatchWrite:
+        return _TrackedBatch(self._inner.begin_batch_write(), self)
+
+    def support_ttl(self) -> bool:
+        return self._inner.support_ttl()
+
+    def exclusive_client(self) -> KvStorage:
+        return self
+
+    def untracked(self) -> KvStorage:
+        """Raw inner engine — used by TpuScanner.compact so its own GC
+        deletes don't poison the mirror it is about to update."""
+        return self._inner.exclusive_client()
+
+    def close(self) -> None:
+        self._inner.close()
+
+    def _on_committed(self, rows: list[tuple[bytes, int, bytes]]) -> None:
+        if self._scanner is not None and rows:
+            self._scanner.record_version_rows(rows)
+
+    def _on_uncertain(self) -> None:
+        if self._scanner is not None:
+            self._scanner.mark_uncertain()
+
+
+class _TrackedBatch(BatchWrite):
+    def __init__(self, inner: BatchWrite, owner: TpuKvStorage):
+        self._inner = inner
+        self._owner = owner
+        self._rows: list[tuple[bytes, int, bytes]] = []
+        self._deletes_object_rows = False
+
+    def _track(self, key: bytes, value: bytes) -> None:
+        if coder.is_internal_key(key):
+            ukey, rev = coder.decode(key)
+            if rev != 0:
+                self._rows.append((ukey, rev, value))
+
+    def put_if_not_exist(self, key, value, ttl_seconds=0):
+        self._track(key, value)
+        self._inner.put_if_not_exist(key, value, ttl_seconds)
+
+    def cas(self, key, new_value, old_value, ttl_seconds=0):
+        self._track(key, new_value)
+        self._inner.cas(key, new_value, old_value, ttl_seconds)
+
+    def put(self, key, value, ttl_seconds=0):
+        self._track(key, value)
+        self._inner.put(key, value, ttl_seconds)
+
+    def delete(self, key):
+        if coder.is_internal_key(key) and coder.decode(key)[1] != 0:
+            self._deletes_object_rows = True
+        self._inner.delete(key)
+
+    def del_current(self, key, expected_value):
+        if coder.is_internal_key(key) and coder.decode(key)[1] != 0:
+            self._deletes_object_rows = True
+        self._inner.del_current(key, expected_value)
+
+    def commit(self):
+        try:
+            self._inner.commit()
+        except UncertainResultError:
+            self._owner._on_uncertain()
+            raise
+        # external deletes of version rows (not via TpuScanner.compact, which
+        # bypasses tracking and maintains the mirror itself) invalidate the
+        # mirror; anything else feeds the delta log
+        if self._deletes_object_rows:
+            self._owner._on_uncertain()
+        else:
+            self._owner._on_committed(self._rows)
+        self._rows = []
+
+
+def _tpu_factory(inner: str = "memkv", mesh=None, key_width: int = keyops.KEY_WIDTH, **inner_kw) -> TpuKvStorage:
+    from .. import new_storage
+
+    return TpuKvStorage(new_storage(inner, **inner_kw), mesh=mesh, key_width=key_width)
+
+
+register_engine("tpu", _tpu_factory)

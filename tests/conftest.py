@@ -1,13 +1,27 @@
-"""Test environment: force an 8-device virtual CPU mesh before jax imports.
+"""Test environment: force an 8-device virtual CPU mesh before any kernel runs.
 
 Mirrors SURVEY §4's implication: mesh-sharded scans are tested on CPU via
 ``xla_force_host_platform_device_count`` (the role the in-process mock TiKV
 cluster plays in the reference tests, backend_test.go:171-178).
+
+This container's sitecustomize registers the axon TPU-tunnel PJRT plugin in
+every interpreter and exports JAX_PLATFORMS=axon; tests must never touch the
+tunnel (single real chip, serialized access — a killed test run can wedge
+it). Empirically the only reliable override is to set the platform *in
+process* before the first backend initialization — `env JAX_PLATFORMS=cpu`
+at process start still initializes the axon plugin.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+# persistent compile cache: kernel shapes repeat across test runs
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/jax_cache_kubebrain")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0.5")
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

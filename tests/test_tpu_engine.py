@@ -1,0 +1,162 @@
+"""TPU block-store engine tests: the same MVCC semantics through
+``--storage=tpu`` (device mirror + delta overlay), differential-tested
+against the generic engine — the multi-backend matrix of the reference
+(backend_test.go:52-88) extended to the device path.
+
+Runs on the 8-device virtual CPU mesh (conftest.py).
+"""
+
+import numpy as np
+import pytest
+
+from kubebrain_tpu.backend import Backend, BackendConfig, wait_for_revision
+from kubebrain_tpu.storage import new_storage
+from kubebrain_tpu.storage.errors import KeyNotFoundError
+
+
+@pytest.fixture
+def tb():
+    store = new_storage("tpu", inner="memkv")
+    b = Backend(store, BackendConfig(event_ring_capacity=4096, watch_cache_capacity=4096))
+    # low thresholds so tests exercise the device path, not the host fallback
+    b.scanner._host_limit_threshold = 0
+    b.scanner._merge_threshold = 8
+    yield b
+    b.close()
+    store.close()
+
+
+def test_basic_crud_via_device(tb):
+    K = b"/registry/pods/default/nginx"
+    r1 = tb.create(K, b"v1")
+    res = tb.list_(b"/registry/", b"/registry0")
+    assert [(kv.key, kv.value, kv.revision) for kv in res.kvs] == [(K, b"v1", r1)]
+    r2 = tb.update(K, b"v2", r1)
+    res = tb.list_(b"/registry/", b"/registry0")
+    assert [(kv.value, kv.revision) for kv in res.kvs] == [(b"v2", r2)]
+    # snapshot read hits the device path too
+    res = tb.list_(b"/registry/", b"/registry0", revision=r1)
+    assert [(kv.value, kv.revision) for kv in res.kvs] == [(b"v1", r1)]
+    tb.delete(K)
+    res = tb.list_(b"/registry/", b"/registry0")
+    assert res.kvs == []
+
+
+def test_mirror_merge_and_overlay(tb):
+    # 20 writes with merge_threshold 8: some rows come from the merged
+    # mirror, some from the delta overlay
+    revs = {}
+    for i in range(20):
+        k = b"/registry/pods/p%02d" % i
+        revs[k] = tb.create(k, b"v%d" % i)
+    res = tb.list_(b"/registry/pods/", b"/registry/pods0")
+    assert len(res.kvs) == 20
+    assert [kv.key for kv in res.kvs] == sorted(revs)
+    cnt, _ = tb.count(b"/registry/pods/", b"/registry/pods0")
+    assert cnt == 20
+    # delete half; count adjusts through overlay + device
+    for i in range(0, 20, 2):
+        tb.delete(b"/registry/pods/p%02d" % i)
+    cnt, _ = tb.count(b"/registry/pods/", b"/registry/pods0")
+    assert cnt == 10
+
+
+def test_limit_uses_host_path_consistently(tb):
+    for i in range(12):
+        tb.create(b"/registry/x%02d" % i, b"v")
+    tb.scanner._host_limit_threshold = 1024  # re-enable host fallback
+    res = tb.list_(b"/registry/", b"/registry0", limit=5)
+    assert len(res.kvs) == 5 and res.more
+    assert [kv.key for kv in res.kvs] == [b"/registry/x%02d" % i for i in range(5)]
+
+
+def test_compact_on_device(tb):
+    K = b"/registry/pods/a"
+    r1 = tb.create(K, b"v1")
+    r2 = tb.update(K, b"v2", r1)
+    KD = b"/registry/pods/del"
+    rd = tb.create(KD, b"bye")
+    rdel, _ = tb.delete(KD)
+    assert wait_for_revision(tb, rdel)
+    done = tb.compact(rdel)
+    assert done == rdel
+    from kubebrain_tpu import coder
+
+    # superseded + tombstoned rows physically gone from the host store
+    inner = tb.store._inner
+    with pytest.raises(KeyNotFoundError):
+        inner.get(coder.encode_object_key(K, r1))
+    with pytest.raises(KeyNotFoundError):
+        inner.get(coder.encode_revision_key(KD))
+    # and the mirror still answers correctly
+    res = tb.list_(b"/registry/", b"/registry0")
+    assert [(kv.key, kv.value) for kv in res.kvs] == [(K, b"v2")]
+    cnt, _ = tb.count(b"/registry/", b"/registry0")
+    assert cnt == 1
+
+
+def test_differential_vs_generic_engine():
+    """Random workload on both engines; every read must agree.
+    (The reference runs identical table-driven cases across engines;
+    randomized differential testing covers more interleavings.)"""
+    rng = np.random.RandomState(7)
+    g_store = new_storage("memkv")
+    g = Backend(g_store, BackendConfig(event_ring_capacity=8192))
+    t_store = new_storage("tpu", inner="memkv")
+    t = Backend(t_store, BackendConfig(event_ring_capacity=8192))
+    t.scanner._host_limit_threshold = 0
+    t.scanner._merge_threshold = 16
+
+    keys = [b"/reg/k%02d" % i for i in range(30)]
+    live_rev: dict[bytes, int] = {}
+    checkpoints = []
+    for step in range(300):
+        k = keys[rng.randint(len(keys))]
+        op = rng.rand()
+        for be in (g, t):
+            try:
+                if k not in live_rev:
+                    r = be.create(k, b"val%d" % step)
+                elif op < 0.6:
+                    r = be.update(k, b"val%d" % step, live_rev[k])
+                else:
+                    r, _ = be.delete(k, live_rev[k])
+            except Exception as e:
+                r = ("err", type(e).__name__)
+            results = r
+        # engines share revision sequence determinism: same op order
+        if k not in live_rev:
+            live_rev[k] = results if isinstance(results, int) else live_rev.get(k, 0)
+        elif op < 0.6:
+            live_rev[k] = results
+        else:
+            live_rev.pop(k, None)
+        if step % 50 == 49:
+            checkpoints.append(g.current_revision())
+
+    def snapshot(be, rev=0):
+        res = be.list_(b"/reg/", b"/reg0", revision=rev)
+        return [(kv.key, kv.value, kv.revision) for kv in res.kvs]
+
+    assert g.current_revision() == t.current_revision()
+    assert snapshot(g) == snapshot(t)
+    for cp in checkpoints:
+        assert snapshot(g, cp) == snapshot(t, cp), f"diverged at rev {cp}"
+    cg, _ = g.count(b"/reg/", b"/reg0")
+    ct, _ = t.count(b"/reg/", b"/reg0")
+    assert cg == ct
+    for be in (g, t):
+        be.close()
+    g_store.close()
+    t_store.close()
+
+
+def test_partitions_align_with_mesh(tb):
+    for i in range(40):
+        tb.create(b"/registry/pods/p%03d" % i, b"v")
+    tb.scanner.publish()
+    parts = tb.get_partitions(b"/registry/", b"/registry0")
+    # mirror partitions (8 CPU devices) surface as storage partitions
+    assert len(parts) >= 2
+    assert parts[0].left == b"/registry/"
+    assert parts[-1].right == b"/registry0"
