@@ -1,0 +1,192 @@
+"""North-star benchmark: MVCC range-scan rate over a 1M-key x 100-revision
+class dataset (BASELINE.json config: "range-scan keys/sec").
+
+Measures the device visibility kernel (prefix-match + revision filter +
+last-version select + tombstone suppression — the single pass the reference
+does row-by-row in scanner worker.run, scanner.go:389-516) over HBM-resident
+packed blocks, against a vectorized numpy CPU implementation of the *same*
+algorithm (a much stronger baseline than the reference's per-row LSM
+iteration).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
+
+Env knobs: KB_BENCH_KEYS (default 200000), KB_BENCH_REVS (default 100),
+KB_BENCH_PLATFORM (force "cpu"), KB_BENCH_ITERS.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+
+WIDTH = 64  # bytes per packed key; registry bench keys are ~36B
+CHUNKS = WIDTH // 4
+
+
+def _probe_tpu_alive(timeout: float = 90.0) -> bool:
+    """The axon tunnel serializes one client and can wedge; probe it in a
+    throwaway subprocess so a dead tunnel can't hang the bench."""
+    code = "import jax, jax.numpy as jnp; jnp.arange(4).sum().block_until_ready(); print('ok')"
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, timeout=timeout
+        )
+        return b"ok" in out.stdout
+    except (subprocess.TimeoutExpired, OSError):
+        return False
+
+
+def _force_cpu() -> None:
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+
+def build_dataset(n_keys: int, revs_per_key: int):
+    """Vectorized construction of sorted (key, rev) rows: fixed-format keys
+    '/registry/pods/default/pod-%08d' x revs_per_key ascending revisions,
+    last version tombstoned for 10% of keys."""
+    prefix = b"/registry/pods/default/pod-"
+    plen = len(prefix)
+    n = n_keys * revs_per_key
+
+    digits = np.zeros((n_keys, 8), np.uint8)
+    x = np.arange(n_keys, dtype=np.int64)
+    for d in range(7, -1, -1):
+        digits[:, d] = (x % 10) + ord("0")
+        x //= 10
+    key_bytes = np.zeros((n_keys, WIDTH), np.uint8)
+    key_bytes[:, :plen] = np.frombuffer(prefix, np.uint8)
+    key_bytes[:, plen : plen + 8] = digits
+
+    rows = np.repeat(key_bytes, revs_per_key, axis=0)
+    be = rows.reshape(n, CHUNKS, 4).astype(np.uint32)
+    chunks = (be[..., 0] << 24) | (be[..., 1] << 16) | (be[..., 2] << 8) | be[..., 3]
+    del rows, be
+
+    revs = np.arange(1, n + 1, dtype=np.uint64)
+    rh = (revs >> np.uint64(32)).astype(np.uint32)
+    rl = (revs & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    tomb = np.zeros(n, dtype=bool)
+    tomb[revs_per_key - 1 :: 10 * revs_per_key] = True  # last version of every 10th key
+    return chunks, rh, rl, tomb
+
+
+def pack_bound(key: bytes) -> np.ndarray:
+    row = np.zeros((1, WIDTH), np.uint8)
+    row[0, : len(key)] = np.frombuffer(key, np.uint8)
+    be = row.reshape(1, CHUNKS, 4).astype(np.uint32)
+    return ((be[..., 0] << 24) | (be[..., 1] << 16) | (be[..., 2] << 8) | be[..., 3])[0]
+
+
+def cpu_scan(chunks, rh, rl, tomb, start, end, qhi, qlo) -> int:
+    """The same visibility algorithm, vectorized numpy (CPU baseline)."""
+    def lex_less(keys, bound):
+        eq = keys == bound
+        neq = ~eq
+        has_diff = neq.any(axis=1)
+        first = neq.argmax(axis=1)
+        lt_first = np.take_along_axis(keys < bound, first[:, None], axis=1)[:, 0]
+        return has_diff & lt_first
+
+    in_range = ~lex_less(chunks, start) & lex_less(chunks, end)
+    rev_le = (rh < qhi) | ((rh == qhi) & (rl <= qlo))
+    cand = in_range & rev_le
+    same_next = np.zeros(len(chunks), dtype=bool)
+    same_next[:-1] = (chunks[1:] == chunks[:-1]).all(axis=1)
+    cand_next = np.zeros_like(cand)
+    cand_next[:-1] = cand[1:]
+    visible = cand & ~(same_next & cand_next) & ~tomb
+    return int(visible.sum())
+
+
+def main() -> None:
+    n_keys = int(os.environ.get("KB_BENCH_KEYS", 200_000))
+    revs = int(os.environ.get("KB_BENCH_REVS", 100))
+    iters = int(os.environ.get("KB_BENCH_ITERS", 10))
+    platform = os.environ.get("KB_BENCH_PLATFORM", "")
+
+    if platform == "cpu" or (
+        os.environ.get("PALLAS_AXON_POOL_IPS") and not _probe_tpu_alive()
+    ):
+        print("[bench] TPU tunnel unavailable -> CPU fallback", file=sys.stderr)
+        _force_cpu()
+
+    import jax
+    import jax.numpy as jnp
+
+    from kubebrain_tpu.ops.scan import visibility_mask
+
+    dev = jax.devices()[0]
+    print(f"[bench] device: {dev}", file=sys.stderr)
+
+    t0 = time.time()
+    chunks, rh, rl, tomb = build_dataset(n_keys, revs)
+    n = len(chunks)
+    start = pack_bound(b"/registry/pods/")
+    end = pack_bound(b"/registry/pods0")
+    read_rev = np.uint64(n * 3 // 4)  # mid-history snapshot read
+    qhi = np.uint32(read_rev >> np.uint64(32))
+    qlo = np.uint32(read_rev & np.uint64(0xFFFFFFFF))
+    print(f"[bench] dataset: {n_keys} keys x {revs} revs = {n} rows "
+          f"({chunks.nbytes/1e9:.2f} GB keys) in {time.time()-t0:.1f}s", file=sys.stderr)
+
+    # ---- CPU baseline (vectorized numpy, same algorithm)
+    t0 = time.time()
+    cpu_visible = cpu_scan(chunks, rh, rl, tomb, start, end, qhi, qlo)
+    cpu_dt = time.time() - t0
+    cpu_rate = n / cpu_dt
+    print(f"[bench] CPU numpy: {cpu_dt:.2f}s = {cpu_rate/1e6:.1f}M rows/s "
+          f"(visible {cpu_visible})", file=sys.stderr)
+
+    # ---- device kernel
+    @jax.jit
+    def scan_count(keys, a, b, t, nv, s, e, hi, lo):
+        mask = visibility_mask(keys, a, b, t, nv, s, e, jnp.asarray(False), hi, lo)
+        return jnp.sum(mask, dtype=jnp.int64 if jax.config.x64_enabled else jnp.int32)
+
+    d_args = [jax.device_put(x, dev) for x in (chunks, rh, rl, tomb)]
+    s_dev, e_dev = jax.device_put(start, dev), jax.device_put(end, dev)
+    nv = jnp.asarray(np.int32(min(n, 2**31 - 1)))
+    t0 = time.time()
+    out = scan_count(d_args[0], d_args[1], d_args[2], d_args[3], nv, s_dev, e_dev, qhi, qlo)
+    out.block_until_ready()
+    compile_dt = time.time() - t0
+    tpu_visible = int(out)
+    print(f"[bench] device first call (incl compile): {compile_dt:.1f}s, "
+          f"visible {tpu_visible}", file=sys.stderr)
+    assert tpu_visible == cpu_visible, f"device {tpu_visible} != cpu {cpu_visible}"
+
+    lat = []
+    for _ in range(iters):
+        t0 = time.time()
+        scan_count(d_args[0], d_args[1], d_args[2], d_args[3], nv, s_dev, e_dev, qhi, qlo).block_until_ready()
+        lat.append(time.time() - t0)
+    best = min(lat)
+    p50 = sorted(lat)[len(lat) // 2]
+    rate = n / p50
+    print(f"[bench] device: best {best*1e3:.1f}ms p50 {p50*1e3:.1f}ms "
+          f"= {rate/1e6:.1f}M rows/s", file=sys.stderr)
+
+    print(json.dumps({
+        "metric": "range-scan keys/sec",
+        "value": round(rate),
+        "unit": "rows/sec",
+        "vs_baseline": round(rate / cpu_rate, 3),
+        "detail": {
+            "rows": n, "visible": tpu_visible,
+            "scan_p50_ms": round(p50 * 1e3, 2),
+            "cpu_numpy_rows_per_sec": round(cpu_rate),
+            "device": str(dev),
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

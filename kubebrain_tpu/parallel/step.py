@@ -1,0 +1,140 @@
+"""The full data-plane step, sharded over a 2D device mesh.
+
+One compiled step = everything the TPU does for the MVCC store per tick:
+
+- partition-sharded range scan (visibility masks + global count via psum
+  over ``part``) — SURVEY P1;
+- partition-sharded compaction victim marking — SURVEY P2;
+- watcher-sharded watch fan-out mask (events replicated, watcher table
+  sharded over ``wat``) — SURVEY P4.
+
+Mesh axes: ``part`` shards the key space (storage partitions), ``wat``
+shards the watcher table / replicates block data — the reader-replica axis
+(SURVEY P6). Collectives: psum over ``part`` for the scan count; the fan-out
+mask stays sharded (each wat-shard serves its own watcher subset).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..ops.compact import victim_mask
+from ..ops.scan import rev_leq, visibility_mask
+
+
+def _fanout_math(ek, ehi, elo, wch, wmk, whi, wlo):
+    masked = ek[:, None, :] & wmk[None, :, :]
+    prefix_ok = jnp.all(masked == wch[None, :, :], axis=-1)
+    rev_ok = rev_leq(whi[None, :], wlo[None, :], ehi[:, None], elo[:, None])
+    return prefix_ok & rev_ok
+
+
+def make_data_plane_step(mesh):
+    """Returns a jitted step(fn) over ``mesh`` (axes ``part``, ``wat``)."""
+
+    block = P("part", None, None)
+    row = P("part", None)
+    rep = P()
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(
+            block, row, row, row, row, P("part"),          # blocks
+            rep, rep, rep, rep, rep,                       # scan query
+            rep, rep, rep, rep,                            # compact query
+            P("wat", None), P("wat", None), P("wat"), P("wat"),  # watcher table
+            rep, rep, rep,                                 # event batch
+        ),
+        out_specs=(row, rep, row, P(None, "wat")),
+    )
+    def step(
+        keys, rh, rl, tomb, ttl, nv,
+        start, end, unb, qhi, qlo,
+        chi, clo, thi, tlo,
+        wch, wmk, whi, wlo,
+        ek, ehi, elo,
+    ):
+        vis = jax.vmap(
+            lambda k, a, b, t, n: visibility_mask(k, a, b, t, n, start, end, unb, qhi, qlo)
+        )(keys, rh, rl, tomb, nv)
+        local = jnp.sum(vis, dtype=jnp.int32)
+        total = jax.lax.psum(local, "part")
+        victims = jax.vmap(
+            lambda k, a, b, t, x, n: victim_mask(k, a, b, t, x, n, chi, clo, thi, tlo)
+        )(keys, rh, rl, tomb, ttl, nv)
+        fmask = _fanout_math(ek, ehi, elo, wch, wmk, whi, wlo)
+        return vis, total, victims, fmask
+
+    return jax.jit(step)
+
+
+def make_example_args(mesh, n_parts=None, rows=64, chunks=16, watchers=8, events=8, seed=0):
+    """Tiny, correctly-sharded example inputs for the step (dry-run/compile
+    checks). Returns a tuple matching make_data_plane_step's signature."""
+    import numpy as np
+
+    from ..ops import keys as keyops
+
+    part = mesh.shape["part"]
+    wat = mesh.shape["wat"]
+    n_parts = n_parts or part
+    assert n_parts % part == 0 and watchers % wat == 0
+    rng = np.random.RandomState(seed)
+
+    width = chunks * 4
+    all_keys, all_revs, all_tomb, all_ttl, nv = [], [], [], [], []
+    rev = 0
+    for p in range(n_parts):
+        ks, rs = [], []
+        for i in range(rows // 2):
+            k = b"/registry/pods/p%02d-%04d" % (p, i)
+            for _ in range(2):
+                rev += 1
+                ks.append(k)
+                rs.append(rev)
+        packed, _ = keyops.pack_keys(ks, width)
+        pad = rows - len(ks)
+        all_keys.append(np.pad(packed, ((0, pad), (0, 0))))
+        all_revs.append(np.pad(np.array(rs, dtype=np.uint64), (0, pad)))
+        all_tomb.append(rng.rand(rows) < 0.1)
+        all_ttl.append(np.zeros(rows, dtype=bool))
+        nv.append(len(ks))
+
+    keys = np.stack(all_keys)
+    revs = np.stack(all_revs)
+    rh, rl = keyops.split_revs(revs.reshape(-1))
+    rh, rl = rh.reshape(n_parts, rows), rl.reshape(n_parts, rows)
+    tomb = np.stack(all_tomb)
+    ttl = np.stack(all_ttl)
+    nvv = np.array(nv, dtype=np.int32)
+
+    def q(rev):
+        hi, lo = keyops.split_revs(np.array([rev], dtype=np.uint64))
+        return np.uint32(hi[0]), np.uint32(lo[0])
+
+    start = keyops.pack_one(b"/registry/", width)
+    end = keyops.pack_one(b"/registry0", width)
+    qhi, qlo = q(rev)
+    chi, clo = q(max(rev // 2, 1))
+    thi, tlo = q(0)
+
+    prefixes = [b"/registry/pods/p%02d" % (i % n_parts) for i in range(watchers)]
+    wch, wmk = keyops.chunk_prefix_masks(prefixes, width)
+    whi, wlo = keyops.split_revs(np.zeros(watchers, dtype=np.uint64))
+
+    ev_keys = [b"/registry/pods/p%02d-%04d" % (i % n_parts, i) for i in range(events)]
+    ek, _ = keyops.pack_keys(ev_keys, width)
+    ehi, elo = keyops.split_revs(np.arange(1, events + 1, dtype=np.uint64))
+
+    return (
+        keys, rh, rl, tomb, ttl, nvv,
+        start, end, np.False_, qhi, qlo,
+        chi, clo, thi, tlo,
+        wch, wmk, whi, wlo,
+        ek, ehi, elo,
+    )
