@@ -56,6 +56,8 @@ def build_dataset(n_keys: int, revs_per_key: int):
     plen = len(prefix)
     n = n_keys * revs_per_key
 
+    from kubebrain_tpu.ops import keys as keyops
+
     digits = np.zeros((n_keys, 8), np.uint8)
     x = np.arange(n_keys, dtype=np.int64)
     for d in range(7, -1, -1):
@@ -65,10 +67,7 @@ def build_dataset(n_keys: int, revs_per_key: int):
     key_bytes[:, :plen] = np.frombuffer(prefix, np.uint8)
     key_bytes[:, plen : plen + 8] = digits
 
-    rows = np.repeat(key_bytes, revs_per_key, axis=0)
-    be = rows.reshape(n, CHUNKS, 4).astype(np.uint32)
-    chunks = (be[..., 0] << 24) | (be[..., 1] << 16) | (be[..., 2] << 8) | be[..., 3]
-    del rows, be
+    chunks = keyops.bytes_to_chunks(np.repeat(key_bytes, revs_per_key, axis=0))
 
     revs = np.arange(1, n + 1, dtype=np.uint64)
     rh = (revs >> np.uint64(32)).astype(np.uint32)
@@ -79,10 +78,9 @@ def build_dataset(n_keys: int, revs_per_key: int):
 
 
 def pack_bound(key: bytes) -> np.ndarray:
-    row = np.zeros((1, WIDTH), np.uint8)
-    row[0, : len(key)] = np.frombuffer(key, np.uint8)
-    be = row.reshape(1, CHUNKS, 4).astype(np.uint32)
-    return ((be[..., 0] << 24) | (be[..., 1] << 16) | (be[..., 2] << 8) | be[..., 3])[0]
+    from kubebrain_tpu.ops import keys as keyops
+
+    return keyops.pack_one(key, WIDTH)
 
 
 def cpu_scan(chunks, rh, rl, tomb, start, end, qhi, qlo) -> int:

@@ -344,6 +344,11 @@ class Backend:
 
     # ==================================================================== watch
     def watch(self, prefix: bytes = b"", revision: int = 0):
+        """Prefix-watch sugar over watch_range."""
+        end = coder.prefix_end(prefix) if prefix else b""
+        return self.watch_range(prefix, end, revision)
+
+    def watch_range(self, start: bytes, end: bytes, revision: int = 0):
         """Subscribe-then-replay watch registration (reference watch.go:37-96):
         subscribe to the hub FIRST, then replay history from the cache for
         events in (revision, hub-subscription point]; raise WatchExpiredError
@@ -353,6 +358,11 @@ class Backend:
         def validate() -> None:
             if not revision:
                 return
+            compacted = self._compact_revision_cached()
+            if revision < compacted:
+                # etcd semantics: watching below the compact watermark is
+                # unservable history — cancel so the client re-lists
+                raise WatchExpiredError(f"want {revision}, compacted {compacted}")
             oldest = self.watch_cache.oldest_revision()
             if len(self.watch_cache) == 0:
                 if revision < self.tso.committed():
@@ -361,7 +371,7 @@ class Backend:
                 raise WatchExpiredError(f"want {revision}, cache oldest {oldest}")
 
         wid, q, _replayed = self.watcher_hub.add_watcher_with_replay(
-            prefix, revision, self.watch_cache, validate=validate
+            start, end, revision, self.watch_cache, validate=validate
         )
         return wid, q
 

@@ -7,10 +7,12 @@ a watcher that cannot keep up is removed and its stream ends, forcing the
 client to re-watch (and possibly re-list). This bounds memory and protects
 the pipeline — the same protocol etcd uses for its watch streams.
 
-The hot part of fan-out — deciding *which* watchers match an event batch —
-can be offloaded: ``kubebrain_tpu.ops.fanout`` computes an (events × watchers)
-prefix-match mask on the TPU mesh; the hub uses it when a batch and the
-watcher set are both large (BASELINE config 3: 10k watchers × 1k events/s).
+Filters are key *ranges* [start, end) + a minimum revision (etcd watch
+semantics; a prefix watch is [p, prefix_end(p)), a single-key watch is
+[k, k+\\0)). The hot part of fan-out — deciding which watchers match an
+event batch — can be offloaded: ``kubebrain_tpu.ops.fanout`` computes the
+(events × watchers) range-match mask on the mesh; the hub uses it when the
+batch × watcher product is large (BASELINE config 3: 10k watchers × 1k ev/s).
 """
 
 from __future__ import annotations
@@ -24,29 +26,42 @@ from .common import WatchEvent
 SUBSCRIBER_BUFFER = 10000
 
 
+def _in_range(key: bytes, start: bytes, end: bytes) -> bool:
+    return key >= start and (not end or key < end)
+
+
 class WatcherHub:
     def __init__(self, fanout_matcher: Callable | None = None):
         self._lock = threading.Lock()
         self._next_id = 0
         self._subs: dict[int, queue.Queue] = {}
-        self._filters: dict[int, tuple[bytes, int]] = {}  # id -> (prefix, min_revision)
-        # Optional vectorized matcher: (events, [(id, prefix, min_rev)]) -> mask
+        # id -> (start, end, min_revision); end == b"" means unbounded
+        self._filters: dict[int, tuple[bytes, bytes, int]] = {}
+        # Optional vectorized matcher:
+        # (events, [(id, start, end, min_rev)]) -> bool[E][W]
         self._fanout_matcher = fanout_matcher
 
-    def add_watcher(self, prefix: bytes = b"", min_revision: int = 0) -> tuple[int, queue.Queue]:
+    def add_watcher(
+        self, start: bytes = b"", end: bytes = b"", min_revision: int = 0
+    ) -> tuple[int, queue.Queue]:
         with self._lock:
-            return self._add_locked(prefix, min_revision)
+            return self._add_locked(start, end, min_revision)
 
-    def _add_locked(self, prefix: bytes, min_revision: int) -> tuple[int, queue.Queue]:
+    def _add_locked(self, start: bytes, end: bytes, min_revision: int) -> tuple[int, queue.Queue]:
         self._next_id += 1
         wid = self._next_id
         q: queue.Queue = queue.Queue(maxsize=SUBSCRIBER_BUFFER)
         self._subs[wid] = q
-        self._filters[wid] = (prefix, min_revision)
+        self._filters[wid] = (start, end, min_revision)
         return wid, q
 
     def add_watcher_with_replay(
-        self, prefix: bytes, revision: int, cache, validate: Callable[[], None] | None = None
+        self,
+        start: bytes,
+        end: bytes,
+        revision: int,
+        cache,
+        validate: Callable[[], None] | None = None,
     ) -> tuple[int, queue.Queue, int]:
         """Atomically subscribe AND replay history >= ``revision`` from the
         watch cache, then set the live filter to newest-replayed + 1.
@@ -65,11 +80,13 @@ class WatcherHub:
         with self._lock:
             if validate is not None:
                 validate()  # e.g. cache-expiry check, atomic with the replay
-            catch_up = [
-                e for e in cache.find_events(revision) if e.key.startswith(prefix)
-            ] if revision else []
+            catch_up = (
+                [e for e in cache.find_events(revision) if _in_range(e.key, start, end)]
+                if revision
+                else []
+            )
             next_rev = (catch_up[-1].revision + 1) if catch_up else revision
-            wid, q = self._add_locked(prefix, next_rev)
+            wid, q = self._add_locked(start, end, next_rev)
             if catch_up:
                 q.put_nowait(catch_up)
             return wid, q, len(catch_up)
@@ -99,8 +116,8 @@ class WatcherHub:
     def stream(self, batch: list[WatchEvent]) -> None:
         """Push one batch to every matching subscriber; drop the slow.
 
-        Reference watcherhub.go:78-100. Per-watcher filtering (prefix +
-        min-revision) happens here rather than in each consumer goroutine so a
+        Reference watcherhub.go:78-100. Per-watcher filtering (range +
+        min-revision) happens here rather than in each consumer thread so a
         vectorized matcher can compute the whole (E × W) mask at once.
         """
         if not batch:
@@ -121,11 +138,11 @@ class WatcherHub:
         else:
             per_watcher = {}
             for wid, _q in subs:
-                prefix, min_rev = filters[wid]
+                start, end, min_rev = filters[wid]
                 per_watcher[wid] = [
                     ev
                     for ev in batch
-                    if ev.revision >= min_rev and ev.key.startswith(prefix)
+                    if ev.revision >= min_rev and _in_range(ev.key, start, end)
                 ]
 
         dead: list[int] = []

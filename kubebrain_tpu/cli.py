@@ -1,0 +1,164 @@
+"""Process bootstrap: flags, wiring, graceful shutdown.
+
+Reference: cmd/main.go (cobra root command, SIGINT/SIGTERM graceful exit
+with a 3s force-kill watchdog, :35-97) and cmd/option/option.go (flags,
+validation, dependency wiring — storage → metrics decorator → backend →
+endpoint, :230-259). Engine choice is a runtime flag (--storage) instead of
+the reference's compile-time Go build tags (option_badger.go:15).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import sys
+import threading
+
+from . import __version__
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="kubebrain-tpu",
+        description="TPU-native etcd3-compatible metadata store for Kubernetes",
+    )
+    p.add_argument("--storage", default="memkv", choices=["memkv", "tpu", "native"],
+                   help="storage engine (reference: build-tag selected TiKV/Badger)")
+    p.add_argument("--inner-storage", default="memkv",
+                   help="host engine backing the tpu mirror (tpu engine only)")
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--client-port", type=int, default=2379)
+    p.add_argument("--peer-port", type=int, default=2380)
+    p.add_argument("--info-port", type=int, default=8081)
+    p.add_argument("--prefix", default="/", help="key prefix served/compacted")
+    p.add_argument("--skip-prefixes", default="", help="comma-separated prefixes compaction skips")
+    p.add_argument("--watch-cache-size", type=int, default=200_000)
+    p.add_argument("--identity", default="", help="host:peerPort; autodetected when empty")
+    p.add_argument("--single-node", action="store_true",
+                   help="stub leader election (always leader)")
+    p.add_argument("--enable-etcd-proxy", action="store_true",
+                   help="followers forward writes to the leader")
+    p.add_argument("--enable-storage-metrics", action="store_true")
+    p.add_argument("--tpu-fanout", action="store_true",
+                   help="vectorized watch fan-out on the device mesh")
+    p.add_argument("--cert-file", default="")
+    p.add_argument("--key-file", default="")
+    p.add_argument("--ca-file", default="")
+    p.add_argument("--cluster-name", default="")
+    p.add_argument("--compact-interval", type=float, default=60.0)
+    p.add_argument("--jax-platform", default=os.environ.get("KB_JAX_PLATFORM", ""),
+                   help="force the jax backend (e.g. 'cpu'); applied in-process "
+                        "before any kernel runs — the only override the axon "
+                        "TPU-tunnel sitecustomize respects")
+    p.add_argument("--version", action="store_true", help="print version and exit")
+    return p
+
+
+def apply_jax_platform(platform: str) -> None:
+    if not platform:
+        return
+    os.environ["JAX_PLATFORMS"] = platform
+    import jax
+
+    jax.config.update("jax_platforms", platform)
+
+
+def build_endpoint(args):
+    """Dependency wiring (reference KubeBrainOption.Run, option.go:230-259):
+    storage → [metrics decorator] → backend → server → endpoint."""
+    from .backend import Backend, BackendConfig
+    from .endpoint import Endpoint, EndpointConfig
+    from .metrics import new_metrics
+    from .server import Server
+    from .server.service import PeerService, SingleNodePeerService
+    from .storage import new_storage
+    from .util.net import get_host
+
+    metrics = new_metrics(args.cluster_name)
+    if args.storage == "tpu":
+        store = new_storage("tpu", inner=args.inner_storage)
+    else:
+        store = new_storage(args.storage)
+    if args.enable_storage_metrics:
+        from .storage.metrics_wrap import MetricsKvStorage
+
+        store = MetricsKvStorage(store, metrics)
+
+    fanout = None
+    if args.tpu_fanout:
+        from .ops.fanout import FanoutMatcher
+
+        fanout = FanoutMatcher()
+
+    backend = Backend(store, BackendConfig(
+        prefix=args.prefix.encode(),
+        skip_prefixes=[s.encode() for s in args.skip_prefixes.split(",") if s],
+        watch_cache_capacity=args.watch_cache_size,
+        fanout_matcher=fanout,
+    ))
+
+    identity = args.identity or f"{get_host()}:{args.peer_port}"
+    if args.single_node:
+        peers = SingleNodePeerService(backend, identity)
+    else:
+        peers = PeerService(
+            backend, identity, args.client_port, enable_proxy=args.enable_etcd_proxy
+        )
+    server = Server(
+        backend, peers, metrics, identity,
+        client_urls=[f"http://{identity.rsplit(':', 1)[0]}:{args.client_port}"],
+    )
+    endpoint = Endpoint(server, metrics, EndpointConfig(
+        host=args.host,
+        client_port=args.client_port,
+        peer_port=args.peer_port,
+        info_port=args.info_port,
+        cert_file=args.cert_file,
+        key_file=args.key_file,
+        ca_file=args.ca_file,
+    ))
+    return endpoint, backend, store
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.version:
+        print(f"kubebrain-tpu {__version__} (storage engines: memkv, tpu, native)")
+        return 0
+
+    apply_jax_platform(args.jax_platform)
+    endpoint, backend, store = build_endpoint(args)
+    stop = threading.Event()
+    watchdog: list[threading.Timer] = []
+
+    def _graceful_exit(signum, frame):  # noqa: ARG001
+        # force-kill watchdog (reference forceExitWhileGracefulExitTimeout,
+        # cmd/main.go:62): a wedged close must not block exit > 3s
+        t = threading.Timer(3.0, lambda: os._exit(2))
+        t.daemon = True
+        t.start()
+        watchdog.append(t)
+        stop.set()
+
+    signal.signal(signal.SIGINT, _graceful_exit)
+    signal.signal(signal.SIGTERM, _graceful_exit)
+
+    endpoint.run()
+    print(
+        f"kubebrain-tpu {__version__} serving: etcd3+brain gRPC :{args.client_port}, "
+        f"peer http :{args.peer_port}, info http :{args.info_port} "
+        f"(storage={args.storage})",
+        file=sys.stderr,
+    )
+    stop.wait()
+    endpoint.close()
+    backend.close()
+    store.close()
+    for t in watchdog:
+        t.cancel()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

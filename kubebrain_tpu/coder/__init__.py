@@ -119,13 +119,39 @@ def prefix_end(prefix: bytes) -> bytes:
     return b""
 
 
+MAX_REVISION = 2**64 - 1  # bound sentinel; real revisions start at 1
+
+
+def _bound_after_all_versions(user_key: bytes) -> bytes:
+    """Internal key sorting after every version row of ``user_key`` and
+    before any longer/greater user key's rows."""
+    return encode_object_key(user_key, MAX_REVISION)
+
+
 def internal_range(start_user_key: bytes, end_user_key: bytes) -> tuple[bytes, bytes]:
     """Map a user-key range [start, end) onto internal-key space.
 
     The start bound is the start key's revision key (revision 0, sorts before
     all its versions); the end bound is the end key's revision key so that all
     versions of keys < end are included. Reference: pkg/backend/range.go:151.
+
+    NUL-bearing *bounds* (etcd continuation tokens are ``last_key + b"\\0"``)
+    would interleave with the NUL split byte + small-revision rows of
+    ``last_key``; since stored keys are NUL-free, such a bound is canonicalized
+    by truncating at the first NUL: "everything > base" for a start bound /
+    "everything <= base" for an end bound — both are the position just after
+    base's version chain.
     """
-    lo = encode_revision_key(start_user_key)
-    hi = encode_revision_key(end_user_key) if end_user_key else prefix_end(MAGIC)
+    if b"\x00" in start_user_key:
+        base = start_user_key.split(b"\x00", 1)[0]
+        lo = _bound_after_all_versions(base)
+    else:
+        lo = encode_revision_key(start_user_key)
+    if not end_user_key:
+        hi = prefix_end(MAGIC)
+    elif b"\x00" in end_user_key:
+        base = end_user_key.split(b"\x00", 1)[0]
+        hi = _bound_after_all_versions(base)
+    else:
+        hi = encode_revision_key(end_user_key)
     return lo, hi

@@ -1,0 +1,5 @@
+"""Endpoint layer (reference pkg/endpoint)."""
+
+from .endpoint import Endpoint, EndpointConfig
+
+__all__ = ["Endpoint", "EndpointConfig"]

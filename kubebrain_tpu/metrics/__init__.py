@@ -1,0 +1,71 @@
+"""Vendor-neutral metrics facade.
+
+Reference: pkg/metrics/metrics.go:36-52 — EmitCounter/EmitGauge/EmitHistogram
+plus gRPC server interceptors and HTTP handlers, with a Prometheus
+implementation and a no-op/minimal one for tests (pkg/metrics/mock).
+"""
+
+from __future__ import annotations
+
+import abc
+import time
+
+
+class Metrics(abc.ABC):
+    @abc.abstractmethod
+    def emit_counter(self, name: str, value: float = 1, **tags: str) -> None: ...
+
+    @abc.abstractmethod
+    def emit_gauge(self, name: str, value: float, **tags: str) -> None: ...
+
+    @abc.abstractmethod
+    def emit_histogram(self, name: str, value: float, **tags: str) -> None: ...
+
+    def http_handler(self):
+        """(content_type, body_bytes) callable for the /metrics endpoint."""
+        return lambda: ("text/plain", b"")
+
+    def timed(self, name: str, **tags: str):
+        """Context manager emitting a latency histogram + count."""
+        return _Timer(self, name, tags)
+
+
+class _Timer:
+    def __init__(self, metrics: Metrics, name: str, tags: dict):
+        self._m = metrics
+        self._name = name
+        self._tags = tags
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        ok = "false" if exc_type else "true"
+        self._m.emit_histogram(
+            self._name + ".latency.seconds", time.perf_counter() - self._t0,
+            success=ok, **self._tags,
+        )
+        self._m.emit_counter(self._name + ".count", 1, success=ok, **self._tags)
+        return False
+
+
+class NoopMetrics(Metrics):
+    """Test/minimal sink (reference mock/minimal.go:22-32)."""
+
+    def emit_counter(self, name, value=1, **tags):
+        pass
+
+    def emit_gauge(self, name, value, **tags):
+        pass
+
+    def emit_histogram(self, name, value, **tags):
+        pass
+
+
+def new_metrics(cluster: str = "", backend: str = "prometheus") -> Metrics:
+    if backend == "noop":
+        return NoopMetrics()
+    from .prom import PrometheusMetrics
+
+    return PrometheusMetrics(cluster)

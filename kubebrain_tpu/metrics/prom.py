@@ -1,0 +1,63 @@
+"""Prometheus implementation.
+
+Reference: pkg/metrics/prometheus/prometheus.go — one lazily-registered vec
+per metric name (dots→underscores), global cluster label, /metrics handler.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from prometheus_client import (
+    CollectorRegistry,
+    Counter,
+    Gauge,
+    Histogram,
+    generate_latest,
+)
+from prometheus_client import CONTENT_TYPE_LATEST
+
+from . import Metrics
+
+_BUCKETS = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
+    0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0,
+)
+
+
+class PrometheusMetrics(Metrics):
+    def __init__(self, cluster: str = ""):
+        self.registry = CollectorRegistry()
+        self._cluster = cluster
+        self._lock = threading.Lock()
+        self._vecs: dict[tuple[str, str], object] = {}
+
+    def _vec(self, kind: str, name: str, tags: dict):
+        pname = name.replace(".", "_").replace("-", "_")
+        labels = tuple(sorted(tags)) + (("cluster",) if self._cluster else ())
+        key = (kind, pname)
+        with self._lock:
+            vec = self._vecs.get(key)
+            if vec is None:
+                cls = {"counter": Counter, "gauge": Gauge, "histogram": Histogram}[kind]
+                kw = {"buckets": _BUCKETS} if kind == "histogram" else {}
+                vec = cls(pname, pname, labelnames=labels, registry=self.registry, **kw)
+                self._vecs[key] = vec
+        if self._cluster:
+            tags = {**tags, "cluster": self._cluster}
+        return vec.labels(**{k: str(v) for k, v in tags.items()}) if tags else vec
+
+    def emit_counter(self, name, value=1, **tags):
+        self._vec("counter", name, tags).inc(value)
+
+    def emit_gauge(self, name, value, **tags):
+        self._vec("gauge", name, tags).set(value)
+
+    def emit_histogram(self, name, value, **tags):
+        self._vec("histogram", name, tags).observe(value)
+
+    def http_handler(self):
+        def handler():
+            return (CONTENT_TYPE_LATEST, generate_latest(self.registry))
+
+        return handler

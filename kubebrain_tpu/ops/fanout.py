@@ -21,7 +21,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import keys as keyops
-from .scan import rev_leq
+from .scan import lex_less, rev_leq
 
 
 @jax.jit
@@ -42,12 +42,31 @@ def fanout_mask(
     return prefix_ok & rev_ok
 
 
-class FanoutMatcher:
-    """Host adapter: WatcherHub-compatible matcher backed by the kernel.
+@jax.jit
+def fanout_mask_range(
+    event_keys: jnp.ndarray,   # uint32[E, C]
+    ev_rev_hi: jnp.ndarray,    # uint32[E]
+    ev_rev_lo: jnp.ndarray,    # uint32[E]
+    w_start: jnp.ndarray,      # uint32[W, C]
+    w_end: jnp.ndarray,        # uint32[W, C]
+    w_unbounded: jnp.ndarray,  # bool[W]
+    min_rev_hi: jnp.ndarray,   # uint32[W]
+    min_rev_lo: jnp.ndarray,   # uint32[W]
+) -> jnp.ndarray:
+    """bool[E, W] delivery mask for key-*range* watchers [start, end)
+    (etcd watch semantics — the hub's filter shape)."""
+    ge = ~lex_less(event_keys[:, None, :], w_start[None, :, :])   # [E, W]
+    lt = lex_less(event_keys[:, None, :], w_end[None, :, :])
+    rev_ok = rev_leq(min_rev_hi[None, :], min_rev_lo[None, :], ev_rev_hi[:, None], ev_rev_lo[:, None])
+    return ge & (w_unbounded[None, :] | lt) & rev_ok
 
-    Callable as (events, [(wid, prefix, min_rev)]) -> bool[E][W] (the hub's
-    ``fanout_matcher`` hook). Re-packs the watcher table only when the watcher
-    set changes; event batches are packed per call.
+
+class FanoutMatcher:
+    """Host adapter: WatcherHub-compatible matcher backed by the range kernel.
+
+    Callable as (events, [(wid, start, end, min_rev)]) -> bool[E][W] (the
+    hub's ``fanout_matcher`` hook). Re-packs the watcher table only when the
+    watcher set changes; event batches are packed per call.
     """
 
     def __init__(self, width: int = keyops.KEY_WIDTH):
@@ -55,22 +74,31 @@ class FanoutMatcher:
         self._cache_key: tuple | None = None
         self._cached = None
 
-    def _watcher_table(self, specs: list[tuple[int, bytes, int]]):
-        cache_key = tuple((wid, prefix, rev) for wid, prefix, rev in specs)
+    def _watcher_table(self, specs: list[tuple[int, bytes, bytes, int]]):
+        cache_key = tuple(specs)
         if cache_key != self._cache_key:
-            chunks, masks = keyops.chunk_prefix_masks([p for _, p, _ in specs], self._width)
-            hi, lo = keyops.split_revs(np.array([r for _, _, r in specs], dtype=np.uint64))
+            # canonicalize NUL-bearing bounds (single-key watches use
+            # end = key + b"\0", which zero-pads equal to the key)
+            starts, _ = keyops.pack_keys(
+                [keyops.canonicalize_bound(s) for _, s, _, _ in specs], self._width
+            )
+            ends, _ = keyops.pack_keys(
+                [keyops.canonicalize_bound(e) for _, _, e, _ in specs], self._width
+            )
+            unbounded = np.array([not e for _, _, e, _ in specs])
+            hi, lo = keyops.split_revs(np.array([r for _, _, _, r in specs], dtype=np.uint64))
             self._cached = (
-                jnp.asarray(chunks), jnp.asarray(masks), jnp.asarray(hi), jnp.asarray(lo),
+                jnp.asarray(starts), jnp.asarray(ends), jnp.asarray(unbounded),
+                jnp.asarray(hi), jnp.asarray(lo),
             )
             self._cache_key = cache_key
         return self._cached
 
     def __call__(self, events, watcher_specs):
-        chunks, masks, whi, wlo = self._watcher_table(watcher_specs)
+        ws, we, wu, whi, wlo = self._watcher_table(watcher_specs)
         ek, _ = keyops.pack_keys([e.key for e in events], self._width)
         ehi, elo = keyops.split_revs(np.array([e.revision for e in events], dtype=np.uint64))
-        mask = fanout_mask(
-            jnp.asarray(ek), jnp.asarray(ehi), jnp.asarray(elo), chunks, masks, whi, wlo
+        mask = fanout_mask_range(
+            jnp.asarray(ek), jnp.asarray(ehi), jnp.asarray(elo), ws, we, wu, whi, wlo
         )
         return np.asarray(mask)

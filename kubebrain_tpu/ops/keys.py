@@ -66,6 +66,20 @@ def pack_one(key: bytes, width: int = KEY_WIDTH) -> np.ndarray:
     return pack_keys([key], width)[0][0]
 
 
+def canonicalize_bound(key: bytes) -> bytes:
+    """Rewrite a NUL-bearing range bound for the zero-padded compare.
+
+    Stored keys are NUL-free, so a bound like etcd's continuation token
+    ``base + b"\\0"`` means "strictly after base" — but zero-padded it
+    compares EQUAL to base. ``base + b"\\0\\1"`` sits strictly between base
+    and every longer NUL-free key, preserving the intended position.
+    """
+    if b"\x00" not in key:
+        return key
+    base = key.split(b"\x00", 1)[0]
+    return base + b"\x00\x01"
+
+
 def split_revs(revs: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
     """uint64[N] → (hi uint32[N], lo uint32[N])."""
     revs = np.asarray(revs, dtype=np.uint64)

@@ -23,14 +23,8 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from ..ops.compact import victim_mask
-from ..ops.scan import rev_leq, visibility_mask
-
-
-def _fanout_math(ek, ehi, elo, wch, wmk, whi, wlo):
-    masked = ek[:, None, :] & wmk[None, :, :]
-    prefix_ok = jnp.all(masked == wch[None, :, :], axis=-1)
-    rev_ok = rev_leq(whi[None, :], wlo[None, :], ehi[:, None], elo[:, None])
-    return prefix_ok & rev_ok
+from ..ops.fanout import fanout_mask_range
+from ..ops.scan import visibility_mask
 
 
 def make_data_plane_step(mesh):
@@ -47,7 +41,7 @@ def make_data_plane_step(mesh):
             block, row, row, row, row, P("part"),          # blocks
             rep, rep, rep, rep, rep,                       # scan query
             rep, rep, rep, rep,                            # compact query
-            P("wat", None), P("wat", None), P("wat"), P("wat"),  # watcher table
+            P("wat", None), P("wat", None), P("wat"), P("wat"), P("wat"),  # watcher table
             rep, rep, rep,                                 # event batch
         ),
         out_specs=(row, rep, row, P(None, "wat")),
@@ -56,7 +50,7 @@ def make_data_plane_step(mesh):
         keys, rh, rl, tomb, ttl, nv,
         start, end, unb, qhi, qlo,
         chi, clo, thi, tlo,
-        wch, wmk, whi, wlo,
+        ws, we, wu, whi, wlo,
         ek, ehi, elo,
     ):
         vis = jax.vmap(
@@ -67,7 +61,7 @@ def make_data_plane_step(mesh):
         victims = jax.vmap(
             lambda k, a, b, t, x, n: victim_mask(k, a, b, t, x, n, chi, clo, thi, tlo)
         )(keys, rh, rl, tomb, ttl, nv)
-        fmask = _fanout_math(ek, ehi, elo, wch, wmk, whi, wlo)
+        fmask = fanout_mask_range(ek, ehi, elo, ws, we, wu, whi, wlo)
         return vis, total, victims, fmask
 
     return jax.jit(step)
@@ -124,7 +118,11 @@ def make_example_args(mesh, n_parts=None, rows=64, chunks=16, watchers=8, events
     thi, tlo = q(0)
 
     prefixes = [b"/registry/pods/p%02d" % (i % n_parts) for i in range(watchers)]
-    wch, wmk = keyops.chunk_prefix_masks(prefixes, width)
+    from .. import coder
+
+    ws, _ = keyops.pack_keys(prefixes, width)
+    we, _ = keyops.pack_keys([coder.prefix_end(p) for p in prefixes], width)
+    wu = np.zeros(watchers, dtype=bool)
     whi, wlo = keyops.split_revs(np.zeros(watchers, dtype=np.uint64))
 
     ev_keys = [b"/registry/pods/p%02d-%04d" % (i % n_parts, i) for i in range(events)]
@@ -135,6 +133,6 @@ def make_example_args(mesh, n_parts=None, rows=64, chunks=16, watchers=8, events
         keys, rh, rl, tomb, ttl, nvv,
         start, end, np.False_, qhi, qlo,
         chi, clo, thi, tlo,
-        wch, wmk, whi, wlo,
+        ws, we, wu, whi, wlo,
         ek, ehi, elo,
     )

@@ -1,0 +1,19 @@
+"""Generated protobuf modules (protoc --python_out; service handlers are
+hand-written in kubebrain_tpu.server since grpc_tools is not available).
+
+protoc emits flat sibling imports (``import kv_pb2``), so this package dir
+is put on sys.path before loading them.
+"""
+
+import os
+import sys
+
+_here = os.path.dirname(__file__)
+if _here not in sys.path:
+    sys.path.insert(0, _here)
+
+import brain_pb2  # noqa: E402
+import kv_pb2  # noqa: E402
+import rpc_pb2  # noqa: E402
+
+__all__ = ["kv_pb2", "rpc_pb2", "brain_pb2"]

@@ -1,0 +1,91 @@
+"""Server composition: gRPC services + info HTTP handlers.
+
+Reference: pkg/server/server.go:70-180 — composes the etcd RPC server, the
+brain RPC server, and the HTTP handlers ``/health``, ``/status`` (the
+follower→leader revision-sync endpoint, :151-165) and ``/election``.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import threading
+import traceback
+
+from .. import __version__
+from ..backend import Backend
+from ..metrics import Metrics, NoopMetrics
+from .brain import BrainServer, make_brain_handlers
+from .etcd import make_etcd_handlers
+from .service import PeerService, SingleNodePeerService
+
+
+class Server:
+    def __init__(
+        self,
+        backend: Backend,
+        peers: PeerService | SingleNodePeerService,
+        metrics: Metrics | None = None,
+        identity: str = "kubebrain-tpu",
+        client_urls: list[str] | None = None,
+    ):
+        self.backend = backend
+        self.peers = peers
+        self.metrics = metrics or NoopMetrics()
+        self.identity = identity
+        self.brain = BrainServer(backend, peers)
+        self.grpc_handlers = make_etcd_handlers(
+            backend, peers, identity, client_urls or []
+        ) + make_brain_handlers(self.brain)
+
+    def start_background(self) -> None:
+        self.brain.start_background()
+
+    # ------------------------------------------------------------------ HTTP
+    def http_handlers(self) -> dict:
+        """path -> fn() -> (content_type, body). The /status payload is the
+        revision-sync contract consumed by HttpRevisionSyncer."""
+        return {
+            "/health": self._health,
+            "/status": self._status,
+            "/election": self._election,
+            "/debug/threads": self._threads,
+        }
+
+    def _health(self):
+        return "application/json", json.dumps({"health": "true"}).encode()
+
+    def _status(self):
+        return "application/json", json.dumps({
+            "revision": self.backend.current_revision(),
+            "compact_revision": self.backend.compact_revision(),
+            "is_leader": self.peers.is_leader(),
+            "leader": self.peers.leader_peer_address(),
+            "identity": self.identity,
+            "watchers": self.backend.watcher_hub.watcher_count(),
+            "version": __version__,
+        }).encode()
+
+    def _election(self):
+        return "application/json", json.dumps({
+            "leader": self.peers.leader_peer_address(),
+            "identity": self.identity,
+            "is_leader": self.peers.is_leader(),
+        }).encode()
+
+    def _threads(self):
+        """Poor man's pprof: live thread stacks (reference mounts Go pprof,
+        pkg/endpoint/pprof.go — the Python analogue is stack dumps; kernel
+        profiling goes through jax.profiler instead)."""
+        out = []
+        for tid, frame in sys._current_frames().items():
+            name = next(
+                (t.name for t in threading.enumerate() if t.ident == tid), str(tid)
+            )
+            out.append(f"--- thread {name} ---")
+            out.extend(line.rstrip() for line in traceback.format_stack(frame))
+        return "text/plain", "\n".join(out).encode()
+
+    def close(self) -> None:
+        self.brain.close()
+        self.peers.close()

@@ -1,0 +1,249 @@
+"""etcd3 KV service terminal: Range demux + Txn pattern matching + Compact.
+
+Reference: pkg/server/etcd/kv.go. kube-apiserver speaks a tiny, rigid subset
+of etcd3 — this service recognizes exactly that subset and rejects the rest:
+
+- ``Range`` demuxes get / count / list / partition-borders (the magic
+  revision 1888 returns partition borders for partition-wise listing,
+  kv.go:33,54-57);
+- ``Txn`` pattern-matches the four transaction shapes the apiserver emits —
+  create (mod/version == 0 guard + put), update (mod == rev guard + put),
+  delete (mod == rev guard + delete_range), and the compactor's
+  coordination txn on the literal ``compact_rev_key`` — which under this
+  matcher is just a create/update with a VERSION guard (kv.go:160-230).
+  Version guards are honored with mod-revision semantics: the guard value is
+  an opaque token the compactor reads back from Get, so any per-update
+  changing token satisfies the protocol;
+- raw ``Put``/``DeleteRange`` are unsupported (kv.go:142-148);
+- errors map to the etcd error strings clients key on (ErrCompacted /
+  ErrFutureRev) so kube-apiserver re-lists correctly.
+"""
+
+from __future__ import annotations
+
+import grpc
+
+from ...backend import (
+    Backend,
+    CASRevisionMismatchError,
+    CompactedError,
+    FutureRevisionError,
+    KeyExistsError,
+)
+from ...storage.errors import KeyNotFoundError
+from ...proto import rpc_pb2
+from . import shim
+
+PARTITION_MAGIC_REVISION = 1888  # reference kv.go:33
+COMPACT_REV_KEY = b"compact_rev_key"  # the apiserver compactor's coordination key
+
+ERR_COMPACTED = "etcdserver: mvcc: required revision has been compacted"
+ERR_FUTURE_REV = "etcdserver: mvcc: required revision is a future revision"
+
+
+class KVService:
+    def __init__(self, backend: Backend, peers=None, limiter=None):
+        self.backend = backend
+        self.peers = peers  # PeerService: leader check / proxy / revision sync
+        self.limiter = limiter
+
+    # ------------------------------------------------------------------ Range
+    def Range(self, request: rpc_pb2.RangeRequest, context) -> rpc_pb2.RangeResponse:
+        if self.peers is not None:
+            self.peers.sync_read_revision()
+        # etcd range conventions: empty range_end = the single key;
+        # range_end == b"\0" = everything >= key ("from key")
+        range_end = bytes(request.range_end)
+        single_key = not range_end
+        if range_end == b"\x00":
+            range_end = b""
+        try:
+            if request.count_only:
+                if single_key:
+                    try:
+                        self.backend.get(request.key, request.revision)
+                        n, rev = 1, self.backend.current_revision()
+                    except KeyNotFoundError:
+                        n, rev = 0, self.backend.current_revision()
+                else:
+                    n, rev = self.backend.count(request.key, range_end, request.revision)
+                return rpc_pb2.RangeResponse(header=shim.header(rev), count=n)
+            if request.revision == PARTITION_MAGIC_REVISION:
+                return self._partitions(request)
+            if single_key:
+                return self._get(request)
+            return self._list(request, range_end)
+        except CompactedError:
+            context.abort(grpc.StatusCode.OUT_OF_RANGE, ERR_COMPACTED)
+        except FutureRevisionError:
+            context.abort(grpc.StatusCode.OUT_OF_RANGE, ERR_FUTURE_REV)
+
+    def _get(self, request) -> rpc_pb2.RangeResponse:
+        try:
+            kv = self.backend.get(request.key, request.revision)
+        except KeyNotFoundError:
+            return rpc_pb2.RangeResponse(
+                header=shim.header(self.backend.current_revision()), count=0
+            )
+        resp = rpc_pb2.RangeResponse(
+            header=shim.header(max(self.backend.current_revision(), kv.revision)), count=1
+        )
+        if request.keys_only:
+            kv = type(kv)(kv.key, b"", kv.revision)
+        resp.kvs.append(shim.to_kv(kv))
+        self._fix_version_token(resp, request.key)
+        return resp
+
+    @staticmethod
+    def _fix_version_token(resp, key: bytes) -> None:
+        """The apiserver compactor guards its coordination txns with
+        Version(compact_rev_key) and treats the value as an opaque token read
+        back from Get. The MVCC core doesn't track per-key versions (like the
+        reference, backendshim.go maps only revisions), so for this one key
+        version := mod_revision — a token that changes on every update, which
+        is all the protocol needs (kv.go:211-230)."""
+        if key == COMPACT_REV_KEY:
+            for kv in resp.kvs:
+                kv.version = kv.mod_revision
+
+    def _list(self, request, range_end: bytes) -> rpc_pb2.RangeResponse:
+        res = self.backend.list_(
+            request.key, range_end, request.revision, int(request.limit)
+        )
+        resp = rpc_pb2.RangeResponse(
+            header=shim.header(res.revision), more=res.more, count=len(res.kvs)
+        )
+        for kv in res.kvs:
+            if request.keys_only:
+                kv = type(kv)(kv.key, b"", kv.revision)
+            resp.kvs.append(shim.to_kv(kv))
+        return resp
+
+    def _partitions(self, request) -> rpc_pb2.RangeResponse:
+        """Partition borders as bare KeyValues (reference kv.go:54-57 +
+        range.go:208-244): n+1 border keys for n partitions."""
+        parts = self.backend.get_partitions(request.key, request.range_end)
+        rev = self.backend.current_revision()
+        resp = rpc_pb2.RangeResponse(header=shim.header(rev), count=len(parts) + 1)
+        borders = [parts[0].left] + [p.right for p in parts]
+        for b in borders:
+            resp.kvs.add(key=b, mod_revision=rev)
+        return resp
+
+    # -------------------------------------------------------------------- Txn
+    def Txn(self, request: rpc_pb2.TxnRequest, context) -> rpc_pb2.TxnResponse:
+        if self.peers is not None and not self.peers.is_leader():
+            fwd = self.peers.forward_txn(request)
+            if fwd is not None:
+                return fwd
+            context.abort(grpc.StatusCode.UNAVAILABLE, "etcdserver: not leader")
+        m = self._match(request, context)
+        kind, key, guard_rev, value = m
+        try:
+            if kind == "create":
+                rev = self.backend.create(key, value)
+                return self._txn_ok(rev, put=True)
+            if kind == "update":
+                rev = self.backend.update(key, value, guard_rev)
+                return self._txn_ok(rev, put=True)
+            # delete
+            rev, prev = self.backend.delete(key, guard_rev)
+            return self._txn_ok(rev, put=False)
+        except KeyExistsError as e:
+            return self._txn_failed(request, e.revision)
+        except (CASRevisionMismatchError,) as e:
+            return self._txn_failed(request, e.revision)
+        except KeyNotFoundError:
+            return self._txn_failed(request, 0)
+
+    def _match(self, request, context):
+        """Classify the txn (reference kv.go:160-230). Returns
+        (kind, key, guard_revision, value)."""
+        if len(request.compare) != 1 or len(request.success) != 1:
+            context.abort(
+                grpc.StatusCode.UNIMPLEMENTED,
+                "etcdserver: unsupported transaction shape",
+            )
+        cmp = request.compare[0]
+        if cmp.result != rpc_pb2.Compare.EQUAL or cmp.target not in (
+            rpc_pb2.Compare.MOD,
+            rpc_pb2.Compare.VERSION,
+            rpc_pb2.Compare.CREATE,
+        ):
+            context.abort(
+                grpc.StatusCode.UNIMPLEMENTED, "etcdserver: unsupported compare"
+            )
+        guard = (
+            cmp.mod_revision
+            if cmp.target == rpc_pb2.Compare.MOD
+            else cmp.version if cmp.target == rpc_pb2.Compare.VERSION else cmp.create_revision
+        )
+        op = request.success[0]
+        which = op.WhichOneof("request")
+        if which == "request_put":
+            if op.request_put.key != cmp.key:
+                context.abort(grpc.StatusCode.UNIMPLEMENTED, "etcdserver: key mismatch")
+            kind = "create" if guard == 0 else "update"
+            return kind, bytes(op.request_put.key), int(guard), bytes(op.request_put.value)
+        if which == "request_delete_range":
+            if op.request_delete_range.key != cmp.key:
+                context.abort(grpc.StatusCode.UNIMPLEMENTED, "etcdserver: key mismatch")
+            return "delete", bytes(op.request_delete_range.key), int(guard), b""
+        context.abort(
+            grpc.StatusCode.UNIMPLEMENTED, "etcdserver: unsupported transaction op"
+        )
+
+    def _txn_ok(self, revision: int, put: bool) -> rpc_pb2.TxnResponse:
+        resp = rpc_pb2.TxnResponse(header=shim.header(revision), succeeded=True)
+        op = resp.responses.add()
+        if put:
+            op.response_put.header.revision = revision
+        else:
+            op.response_delete_range.header.revision = revision
+            op.response_delete_range.deleted = 1
+        return resp
+
+    def _txn_failed(self, request, current_rev: int) -> rpc_pb2.TxnResponse:
+        """Failed guard: run the failure branch (always [OpGet(key)] from
+        kube-apiserver) so the client sees the current kv."""
+        resp = rpc_pb2.TxnResponse(
+            header=shim.header(self.backend.current_revision()), succeeded=False
+        )
+        for op in request.failure:
+            if op.WhichOneof("request") != "request_range":
+                continue
+            r = op.request_range
+            try:
+                kv = self.backend.get(r.key, r.revision)
+                rr = rpc_pb2.RangeResponse(header=shim.header(kv.revision), count=1)
+                rr.kvs.append(shim.to_kv(kv))
+                self._fix_version_token(rr, bytes(r.key))
+            except (KeyNotFoundError, CompactedError):
+                rr = rpc_pb2.RangeResponse(
+                    header=shim.header(self.backend.current_revision()), count=0
+                )
+            resp.responses.add().response_range.CopyFrom(rr)
+        return resp
+
+    # ----------------------------------------------------------------- Compact
+    def Compact(self, request: rpc_pb2.CompactionRequest, context) -> rpc_pb2.CompactionResponse:
+        if self.peers is not None and not self.peers.is_leader():
+            # compaction is the leader's job; accept and no-op on followers
+            return rpc_pb2.CompactionResponse(
+                header=shim.header(self.backend.current_revision())
+            )
+        done = self.backend.compact(request.revision)
+        return rpc_pb2.CompactionResponse(header=shim.header(done))
+
+    # ------------------------------------------------- unsupported raw writes
+    def Put(self, request, context):
+        context.abort(
+            grpc.StatusCode.UNIMPLEMENTED,
+            "etcdserver: raw Put is not supported; use Txn",  # kv.go:142-148
+        )
+
+    def DeleteRange(self, request, context):
+        context.abort(
+            grpc.StatusCode.UNIMPLEMENTED,
+            "etcdserver: raw DeleteRange is not supported; use Txn",
+        )

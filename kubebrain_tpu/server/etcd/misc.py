@@ -1,0 +1,57 @@
+"""Lease / Cluster / Maintenance terminals.
+
+Reference: pkg/server/etcd/lease.go (LeaseGrant returns the TTL as the lease
+ID — "fake but truthy"; TTL is enforced by key pattern, not lease state,
+lease.go:24-31) and cluster.go (MemberList stub, :25-33).
+"""
+
+from __future__ import annotations
+
+import grpc
+
+from ... import __version__
+from ...proto import rpc_pb2
+from . import shim
+
+
+class LeaseService:
+    def __init__(self, backend):
+        self.backend = backend
+
+    def LeaseGrant(self, request, context) -> rpc_pb2.LeaseGrantResponse:
+        # kube-apiserver attaches leases to /events/ keys; TTL is honored by
+        # key pattern in the write path (creator.ttl_for_key), so the lease
+        # object itself is a polite fiction: ID := TTL.
+        return rpc_pb2.LeaseGrantResponse(
+            header=shim.header(self.backend.current_revision()),
+            ID=request.TTL,
+            TTL=request.TTL,
+        )
+
+
+class ClusterService:
+    def __init__(self, backend, identity: str = "kubebrain-tpu", client_urls=None):
+        self.backend = backend
+        self.identity = identity
+        self.client_urls = client_urls or []
+
+    def MemberList(self, request, context) -> rpc_pb2.MemberListResponse:
+        resp = rpc_pb2.MemberListResponse(
+            header=shim.header(self.backend.current_revision())
+        )
+        resp.members.add(ID=1, name=self.identity, clientURLs=self.client_urls)
+        return resp
+
+
+class MaintenanceService:
+    def __init__(self, backend):
+        self.backend = backend
+
+    def Status(self, request, context) -> rpc_pb2.StatusResponse:
+        return rpc_pb2.StatusResponse(
+            header=shim.header(self.backend.current_revision()),
+            version=f"3.5.0-kubebrain-tpu-{__version__}",
+            leader=1,
+            raftIndex=self.backend.current_revision(),
+            raftTerm=1,
+        )

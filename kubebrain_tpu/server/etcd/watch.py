@@ -1,0 +1,245 @@
+"""etcd3 Watch service: one gRPC stream = one watcher session holding many
+watches, each pumping from its backend queue into the shared response stream.
+
+Reference: pkg/server/etcd/watch.go. Protocol points kept:
+
+- each WatchCreateRequest spawns an independent watch with its own cancel
+  (watch.go:83-117);
+- **negative start revision ⇒ "range stream"**: the client is asking for a
+  List delivered over the watch channel (batches of PUT events at the list
+  revision, then a cancel) — the custom-apiserver partition-listing trick
+  (watch.go:101,150-152,204);
+- a watcher whose start revision fell out of the history cache is cancelled
+  with compact_revision=1, forcing the client to re-list (watch.go:174-186);
+- progress requests answer with a bare header (watch_id −1).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+
+from ...backend import Backend, WatchExpiredError
+from ...proto import rpc_pb2
+from . import shim
+
+
+class WatchService:
+    def __init__(self, backend: Backend, peers=None):
+        self.backend = backend
+        self.peers = peers
+
+    def Watch(self, request_iterator, context):
+        out: queue.Queue = queue.Queue(maxsize=1024)
+        session = _WatchSession(self.backend, out, context)
+        reader = threading.Thread(
+            target=session.read_loop, args=(request_iterator,), daemon=True
+        )
+        reader.start()
+        try:
+            while True:
+                item = out.get()
+                if item is None:
+                    return
+                yield item
+        finally:
+            session.close()
+
+    def _sentinel(self):  # pragma: no cover
+        pass
+
+
+class _WatchSession:
+    def __init__(self, backend: Backend, out: queue.Queue, context):
+        self.backend = backend
+        self.out = out
+        self.context = context
+        self._lock = threading.Lock()
+        self._watches: dict[int, tuple[int, threading.Event]] = {}  # watch_id -> (hub wid, stop)
+        self._next_id = 0
+        self._closed = False
+
+    # --------------------------------------------------------------- requests
+    def read_loop(self, request_iterator) -> None:
+        try:
+            for req in request_iterator:
+                which = req.WhichOneof("request_union")
+                if which == "create_request":
+                    self._create(req.create_request)
+                elif which == "cancel_request":
+                    self._cancel(req.cancel_request.watch_id, "watch cancelled by client")
+                elif which == "progress_request":
+                    self._send(
+                        rpc_pb2.WatchResponse(
+                            header=shim.header(self.backend.current_revision()),
+                            watch_id=-1,
+                        )
+                    )
+        except Exception:
+            pass  # stream closed / client gone
+        self._send(None)
+
+    def _create(self, creq) -> None:
+        with self._lock:
+            self._next_id += 1
+            watch_id = creq.watch_id if creq.watch_id > 0 else self._next_id
+        if creq.start_revision < 0:
+            # negative revision: list-over-watch range stream (watch.go:150)
+            t = threading.Thread(
+                target=self._range_stream, args=(creq, watch_id), daemon=True
+            )
+            t.start()
+            return
+        end = bytes(creq.range_end)
+        if not end:
+            end = bytes(creq.key) + b"\x00"  # single-key watch
+        elif end == b"\x00":
+            end = b""  # etcd convention: range_end "\0" = everything >= key
+        try:
+            wid, q = self.backend.watch_range(
+                bytes(creq.key), end, int(creq.start_revision)
+            )
+        except WatchExpiredError:
+            self._send(
+                rpc_pb2.WatchResponse(
+                    header=shim.header(self.backend.current_revision()),
+                    watch_id=watch_id,
+                    created=True,
+                    canceled=True,
+                    compact_revision=max(self.backend.compact_revision(), 1),
+                    cancel_reason="etcdserver: mvcc: required revision has been compacted",
+                )
+            )
+            return
+        stop = threading.Event()
+        with self._lock:
+            if self._closed:
+                self.backend.unwatch(wid)
+                return
+            self._watches[watch_id] = (wid, stop)
+        self._send(
+            rpc_pb2.WatchResponse(
+                header=shim.header(self.backend.current_revision()),
+                watch_id=watch_id,
+                created=True,
+            )
+        )
+        no_put = rpc_pb2.WatchCreateRequest.NOPUT in creq.filters
+        no_delete = rpc_pb2.WatchCreateRequest.NODELETE in creq.filters
+        pump = threading.Thread(
+            target=self._pump,
+            args=(watch_id, wid, q, stop, bool(creq.prev_kv), no_put, no_delete),
+            daemon=True,
+        )
+        pump.start()
+
+    # ----------------------------------------------------------------- pumps
+    def _pump(self, watch_id, wid, q, stop, want_prev, no_put, no_delete) -> None:
+        from ...proto import kv_pb2
+
+        while not stop.is_set():
+            try:
+                batch = q.get(timeout=0.5)
+            except queue.Empty:
+                continue
+            if batch is None:
+                # hub dropped us (slow consumer) or backend closed: cancel so
+                # the client re-watches (watcherhub.go:82-90)
+                self._send(
+                    rpc_pb2.WatchResponse(
+                        header=shim.header(self.backend.current_revision()),
+                        watch_id=watch_id,
+                        canceled=True,
+                        cancel_reason="etcdserver: watcher dropped (slow consumer)",
+                    )
+                )
+                self._remove(watch_id)
+                return
+            resp = rpc_pb2.WatchResponse(
+                header=shim.header(batch[-1].revision), watch_id=watch_id
+            )
+            for ev in batch:
+                pe = shim.to_event(ev, want_prev)
+                if (pe.type == kv_pb2.Event.PUT and no_put) or (
+                    pe.type == kv_pb2.Event.DELETE and no_delete
+                ):
+                    continue
+                resp.events.append(pe)
+            if resp.events:
+                self._send(resp)
+
+    def _range_stream(self, creq, watch_id: int) -> None:
+        """List delivered over the watch protocol (reference watcher.List,
+        watch.go:204-273): PUT event batches at the snapshot revision, then a
+        clean cancel."""
+        from ...backend.errors import CompactedError, FutureRevisionError
+
+        revision = -int(creq.start_revision)
+        try:
+            rev, stream = self.backend.list_by_stream(
+                bytes(creq.key), bytes(creq.range_end), revision
+            )
+        except (CompactedError, FutureRevisionError) as e:
+            self._send(
+                rpc_pb2.WatchResponse(
+                    header=shim.header(self.backend.current_revision()),
+                    watch_id=watch_id,
+                    created=True,
+                    canceled=True,
+                    compact_revision=getattr(e, "compacted", 1),
+                    cancel_reason=str(e),
+                )
+            )
+            return
+        self._send(
+            rpc_pb2.WatchResponse(header=shim.header(rev), watch_id=watch_id, created=True)
+        )
+        from ...proto import kv_pb2
+
+        for batch in stream:
+            resp = rpc_pb2.WatchResponse(header=shim.header(rev), watch_id=watch_id)
+            for kv in batch:
+                resp.events.append(
+                    kv_pb2.Event(type=kv_pb2.Event.PUT, kv=shim.to_kv(kv))
+                )
+            self._send(resp)
+        self._send(
+            rpc_pb2.WatchResponse(
+                header=shim.header(rev), watch_id=watch_id, canceled=True
+            )
+        )
+
+    # -------------------------------------------------------------- plumbing
+    def _cancel(self, watch_id: int, reason: str) -> None:
+        self._remove(watch_id)
+        self._send(
+            rpc_pb2.WatchResponse(
+                header=shim.header(self.backend.current_revision()),
+                watch_id=watch_id,
+                canceled=True,
+                cancel_reason=reason,
+            )
+        )
+
+    def _remove(self, watch_id: int) -> None:
+        with self._lock:
+            entry = self._watches.pop(watch_id, None)
+        if entry:
+            wid, stop = entry
+            stop.set()
+            self.backend.unwatch(wid)
+
+    def _send(self, item) -> None:
+        try:
+            self.out.put(item, timeout=5.0)
+        except queue.Full:
+            pass  # stream writer wedged; the gRPC context will cancel us
+
+    def close(self) -> None:
+        with self._lock:
+            self._closed = True
+            entries = list(self._watches.values())
+            self._watches.clear()
+        for wid, stop in entries:
+            stop.set()
+            self.backend.unwatch(wid)

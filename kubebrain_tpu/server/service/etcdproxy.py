@@ -1,0 +1,70 @@
+"""Follower→leader write forwarding over the etcd3 protocol.
+
+Reference: pkg/server/service/etcdproxy/etcd_proxy.go — community
+kube-apiserver load-balances writes across all replicas, but only the leader
+can write; followers therefore proxy Txn (and Watch) to the leader's client
+port. The reference keeps an etcd clientv3 pointed at the leader with a 1s
+leader-change check loop (etcd_proxy.go:71-79); here a raw grpc channel
+speaks the same etcdserverpb methods, re-dialed when the leader moves.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable
+
+import grpc
+
+from ...proto import rpc_pb2
+
+PROXY_TIMEOUT_SECONDS = 5.0
+
+
+class EtcdProxy:
+    def __init__(self, get_leader_client_address: Callable[[], str | None]):
+        self._get_leader = get_leader_client_address
+        self._lock = threading.Lock()
+        self._channel: grpc.Channel | None = None
+        self._target: str | None = None
+
+    def _stub(self):
+        target = self._get_leader()
+        if not target:
+            return None
+        with self._lock:
+            if target != self._target:
+                if self._channel is not None:
+                    self._channel.close()
+                self._channel = grpc.insecure_channel(target)
+                self._target = target
+            return self._channel.unary_unary(
+                "/etcdserverpb.KV/Txn",
+                request_serializer=rpc_pb2.TxnRequest.SerializeToString,
+                response_deserializer=rpc_pb2.TxnResponse.FromString,
+            )
+
+    def forward_txn(self, request: rpc_pb2.TxnRequest) -> rpc_pb2.TxnResponse | None:
+        call = self._stub()
+        if call is None:
+            return None
+        try:
+            return call(request, timeout=PROXY_TIMEOUT_SECONDS)
+        except grpc.RpcError:
+            return None
+
+    def close(self) -> None:
+        with self._lock:
+            if self._channel is not None:
+                self._channel.close()
+                self._channel = None
+                self._target = None
+
+
+class DisabledEtcdProxy:
+    """No-op when --enable-etcd-proxy is off (reference etcdproxy/disabled.go)."""
+
+    def forward_txn(self, request):  # noqa: ARG002
+        return None
+
+    def close(self) -> None:
+        pass

@@ -1,0 +1,104 @@
+"""PeerService — the composite the RPC terminals depend on.
+
+Reference: pkg/server/service/peer_service.go:28-68
+(PeerService = RevisionSyncer + LeaderElection + EtcdProxy). Identity format
+is "host:peerPort" (cmd/option/option.go:234-238); the leader's client port
+(for proxying) is derived by swapping the port.
+"""
+
+from __future__ import annotations
+
+from ...backend import Backend
+from ...backend.election import LeaderElection, ResourceLock, StubLeaderElection
+from .etcdproxy import DisabledEtcdProxy, EtcdProxy
+from .revision import HttpRevisionSyncer, RevisionSyncError
+
+
+class PeerService:
+    def __init__(
+        self,
+        backend: Backend,
+        identity: str,
+        client_port: int,
+        enable_proxy: bool = False,
+        on_leader_change=None,
+    ):
+        self.backend = backend
+        self.identity = identity
+        self._client_port = client_port
+        self.election = LeaderElection(
+            ResourceLock(backend.store, identity),
+            on_started_leading=self._on_started_leading,
+            on_stopped_leading=on_leader_change,
+        )
+        self.syncer = HttpRevisionSyncer(self.leader_peer_address, backend.set_current_revision)
+        self.proxy = EtcdProxy(self.leader_client_address) if enable_proxy else DisabledEtcdProxy()
+
+    def _on_started_leading(self, start_revision: int) -> None:
+        """Seed the revision sequencer from the lock record's engine clock
+        (reference leader.go:96-107 → backend.SetCurrentRevision)."""
+        self.backend.set_current_revision(max(start_revision, self.backend.current_revision()))
+
+    # -------------------------------------------------------------- addresses
+    def leader_peer_address(self) -> str | None:
+        if self.election.is_leader():
+            return self.identity
+        return self.election.leader_identity()
+
+    def leader_client_address(self) -> str | None:
+        peer = self.leader_peer_address()
+        if not peer:
+            return None
+        host = peer.rsplit(":", 1)[0]
+        return f"{host}:{self._client_port}"
+
+    # ------------------------------------------------------------- contract
+    def is_leader(self) -> bool:
+        return self.election.is_leader()
+
+    def campaign(self) -> None:
+        self.election.campaign()
+
+    def sync_read_revision(self) -> None:
+        """Followers sync the read revision from the leader before every read
+        (reference revision.go:114-128, read.go:128); failure fails the read."""
+        if self.election.is_leader():
+            return
+        self.syncer.sync()
+
+    def forward_txn(self, request):
+        return self.proxy.forward_txn(request)
+
+    def close(self) -> None:
+        self.election.close()
+        self.proxy.close()
+
+
+class SingleNodePeerService:
+    """Always-leader, no peers (stub election, reference leader/stub.go)."""
+
+    def __init__(self, backend: Backend, identity: str = "local"):
+        self.backend = backend
+        self.identity = identity
+        self.election = StubLeaderElection(identity)
+
+    def is_leader(self) -> bool:
+        return True
+
+    def campaign(self) -> None:
+        pass
+
+    def sync_read_revision(self) -> None:
+        pass
+
+    def forward_txn(self, request):  # noqa: ARG002
+        return None
+
+    def leader_peer_address(self) -> str:
+        return self.identity
+
+    def close(self) -> None:
+        pass
+
+
+__all__ = ["PeerService", "SingleNodePeerService", "RevisionSyncError"]

@@ -1,0 +1,99 @@
+"""Follower→leader read-revision sync.
+
+Reference: pkg/server/service/revision/revision.go — a follower cannot serve
+reads from its stale local revision: before each read it HTTP-GETs the
+leader's ``/status`` endpoint (which reports the committed revision,
+server/server.go:151-165), deduplicated through a singleflight so a burst of
+reads costs one round-trip (revision.go:114-128), with http/https schema
+auto-probing (revision.go:142-209).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.request
+from typing import Callable
+
+SYNC_TIMEOUT_SECONDS = 1.0
+
+
+class RevisionSyncError(Exception):
+    pass
+
+
+class HttpRevisionSyncer:
+    def __init__(
+        self,
+        get_leader_address: Callable[[], str | None],
+        set_revision: Callable[[int], None],
+        timeout: float = SYNC_TIMEOUT_SECONDS,
+    ):
+        self._get_leader_address = get_leader_address
+        self._set_revision = set_revision
+        self._timeout = timeout
+        self._schema_cache: dict[str, str] = {}  # address -> working schema
+        # singleflight: one in-flight sync; followers pile onto its result
+        self._flight_lock = threading.Lock()
+        self._flight: threading.Event | None = None
+        self._flight_result: tuple[int | None, BaseException | None] = (None, None)
+
+    def sync(self) -> int:
+        """Fetch the leader revision and apply it locally; singleflighted."""
+        with self._flight_lock:
+            flight = self._flight
+            if flight is None:
+                flight = self._flight = threading.Event()
+                owner = True
+            else:
+                owner = False
+        if not owner:
+            flight.wait(self._timeout * 2)
+            rev, err = self._flight_result
+            if err is not None:
+                raise RevisionSyncError(str(err))
+            if rev is None:
+                raise RevisionSyncError("sync timed out")
+            return rev
+        try:
+            rev = self._fetch()
+            self._set_revision(rev)
+            self._flight_result = (rev, None)
+            return rev
+        except BaseException as e:
+            self._flight_result = (None, e)
+            raise RevisionSyncError(str(e)) from e
+        finally:
+            with self._flight_lock:
+                self._flight = None
+            flight.set()
+
+    def _fetch(self) -> int:
+        address = self._get_leader_address()
+        if not address:
+            raise RevisionSyncError("no leader")
+        schemas = [self._schema_cache.get(address)] if address in self._schema_cache else []
+        schemas += [s for s in ("http", "https") if s not in schemas]
+        last_err: BaseException | None = None
+        for schema in schemas:
+            if schema is None:
+                continue
+            try:
+                rev = self._fetch_one(f"{schema}://{address}/status")
+                self._schema_cache[address] = schema
+                return rev
+            except BaseException as e:  # wrong schema / transient: try next
+                last_err = e
+        raise RevisionSyncError(f"leader /status unreachable: {last_err}")
+
+    def _fetch_one(self, url: str) -> int:
+        import ssl
+
+        ctx = None
+        if url.startswith("https"):
+            ctx = ssl.create_default_context()
+            ctx.check_hostname = False
+            ctx.verify_mode = ssl.CERT_NONE  # peer identity comes from the lock record
+        with urllib.request.urlopen(url, timeout=self._timeout, context=ctx) as resp:
+            payload = json.loads(resp.read().decode())
+        return int(payload["revision"])

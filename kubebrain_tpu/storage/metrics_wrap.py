@@ -1,0 +1,87 @@
+"""Engine decorator timing every storage op.
+
+Reference: pkg/storage/metrics/store.go:30-231 — times Get/Del/DelCurrent/
+Iter/Commit and counts batch ops; enabled by --enable-storage-metrics
+(cmd/option/option.go:254-256).
+"""
+
+from __future__ import annotations
+
+from . import BatchWrite, KvStorage
+from ..metrics import Metrics
+
+
+class MetricsKvStorage(KvStorage):
+    def __init__(self, inner: KvStorage, metrics: Metrics):
+        self._inner = inner
+        self._m = metrics
+
+    def get_timestamp_oracle(self) -> int:
+        return self._inner.get_timestamp_oracle()
+
+    def get_partitions(self, start, end):
+        return self._inner.get_partitions(start, end)
+
+    def get(self, key, snapshot_ts=None):
+        with self._m.timed("storage.get"):
+            return self._inner.get(key, snapshot_ts)
+
+    def iter(self, start, end, snapshot_ts=None, limit=0):
+        with self._m.timed("storage.iter"):
+            return self._inner.iter(start, end, snapshot_ts, limit)
+
+    def begin_batch_write(self) -> BatchWrite:
+        return _MetricsBatch(self._inner.begin_batch_write(), self._m)
+
+    def delete(self, key):
+        with self._m.timed("storage.del"):
+            self._inner.delete(key)
+
+    def del_current(self, key, expected_value):
+        with self._m.timed("storage.del_current"):
+            self._inner.del_current(key, expected_value)
+
+    def support_ttl(self) -> bool:
+        return self._inner.support_ttl()
+
+    def exclusive_client(self) -> KvStorage:
+        return MetricsKvStorage(self._inner.exclusive_client(), self._m)
+
+    def make_scanner(self, **kwargs):
+        return self._inner.make_scanner(**kwargs)
+
+    def close(self) -> None:
+        self._inner.close()
+
+
+class _MetricsBatch(BatchWrite):
+    def __init__(self, inner: BatchWrite, metrics: Metrics):
+        self._inner = inner
+        self._m = metrics
+        self._ops = 0
+
+    def put_if_not_exist(self, key, value, ttl_seconds=0):
+        self._ops += 1
+        self._inner.put_if_not_exist(key, value, ttl_seconds)
+
+    def cas(self, key, new_value, old_value, ttl_seconds=0):
+        self._ops += 1
+        self._inner.cas(key, new_value, old_value, ttl_seconds)
+
+    def put(self, key, value, ttl_seconds=0):
+        self._ops += 1
+        self._inner.put(key, value, ttl_seconds)
+
+    def delete(self, key):
+        self._ops += 1
+        self._inner.delete(key)
+
+    def del_current(self, key, expected_value):
+        self._ops += 1
+        self._inner.del_current(key, expected_value)
+
+    def commit(self):
+        self._m.emit_counter("storage.batch.ops", self._ops)
+        with self._m.timed("storage.commit"):
+            self._inner.commit()
+        self._ops = 0

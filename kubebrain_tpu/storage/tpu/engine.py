@@ -136,9 +136,9 @@ class TpuScanner(Scanner):
 
     # -------------------------------------------------------------- queries
     def _query_bounds(self, start: bytes, end: bytes):
-        s = jnp.asarray(keyops.pack_one(start, self._kw))
+        s = jnp.asarray(keyops.pack_one(keyops.canonicalize_bound(start), self._kw))
         unbounded = not end
-        e = jnp.asarray(keyops.pack_one(end if end else b"", self._kw))
+        e = jnp.asarray(keyops.pack_one(keyops.canonicalize_bound(end) if end else b"", self._kw))
         return s, e, jnp.asarray(unbounded)
 
     def _device_visible(self, mirror: Mirror, start: bytes, end: bytes, read_rev: int):

@@ -59,7 +59,7 @@ def test_uncertain_create_repair():
     flaky = FlakyCommit(store, fail_times=1)
     b = Backend(flaky, BackendConfig(event_ring_capacity=1024))
     b.retry._probe_after = 0.0  # probe immediately in tests
-    wid, q = b.watcher_hub.add_watcher(b"", 0)
+    wid, q = b.watcher_hub.add_watcher(b"", b"", 0)
     with pytest.raises(UncertainResultError):
         b.create(b"/k", b"v")
     assert wait_for_revision(b, 1)

@@ -37,9 +37,9 @@ def test_ring_wraparound_and_find():
 # ------------------------------------------------------------------------ Hub
 def test_hub_fanout_filters():
     hub = WatcherHub()
-    _, qa = hub.add_watcher(b"/a", 0)
-    _, qb = hub.add_watcher(b"/b", 0)
-    _, qlate = hub.add_watcher(b"", 3)
+    _, qa = hub.add_watcher(b"/a", b"/a\xff", 0)
+    _, qb = hub.add_watcher(b"/b", b"/b\xff", 0)
+    _, qlate = hub.add_watcher(b"", b"", 3)
     batch = [
         WatchEvent(revision=1, key=b"/a/1"),
         WatchEvent(revision=2, key=b"/b/1"),
@@ -56,7 +56,7 @@ def test_hub_drops_slow_consumer(monkeypatch):
 
     monkeypatch.setattr(wh, "SUBSCRIBER_BUFFER", 2)
     hub = WatcherHub()
-    wid, q = hub.add_watcher(b"", 0)
+    wid, q = hub.add_watcher(b"", b"", 0)
     for rev in range(1, 5):  # buffer 2 → third push drops the watcher
         hub.stream([WatchEvent(revision=rev, key=b"/k")])
     assert hub.watcher_count() == 0
