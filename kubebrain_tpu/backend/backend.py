@@ -29,7 +29,15 @@ from .. import coder
 from ..storage import CASFailedError, KvStorage, Partition, UncertainResultError
 from ..storage.errors import KeyNotFoundError
 from . import creator
-from .common import COMPACT_KEY, TOMBSTONE, KeyValue, RangeResult, Verb, WatchEvent
+from .common import (
+    COMPACT_KEY,
+    LAST_REV_KEY,
+    TOMBSTONE,
+    KeyValue,
+    RangeResult,
+    Verb,
+    WatchEvent,
+)
 from .errors import (
     CASRevisionMismatchError,
     CompactedError,
@@ -91,11 +99,27 @@ class Backend:
         self._next_rev = 1  # next revision the sequencer expects
         self._closed = False
 
+        # resume the revision sequence on restart over an existing store
+        recovered = self.recover_revision()
+        if recovered:
+            self.tso.init(recovered)
+            self._next_rev = recovered + 1
+
         self._seq_thread = threading.Thread(
             target=self._collect_events, name="kb-sequencer", daemon=True
         )
         self._seq_thread.start()
         self.retry.run()
+
+    def recover_revision(self) -> int:
+        """Highest revision any write batch ever committed (LAST_REV_KEY is
+        written inside every write batch); 0 on a fresh store."""
+        try:
+            raw = self.store.get(LAST_REV_KEY)
+            rev, _ = coder.decode_rev_value(raw)
+            return rev
+        except (KeyNotFoundError, coder.CodecError):
+            return 0
 
     # =================================================================== writes
     def create(self, user_key: bytes, value: bytes) -> int:
@@ -135,6 +159,7 @@ class Backend:
                 ttl,
             )
             batch.put(coder.encode_object_key(user_key, rev), value, ttl)
+            batch.put(LAST_REV_KEY, coder.encode_rev_value(rev))
             batch.commit()
             event.valid = True
             return rev
@@ -187,6 +212,7 @@ class Backend:
                 coder.encode_rev_value(latest_rev),
             )
             batch.put(coder.encode_object_key(user_key, rev), TOMBSTONE)
+            batch.put(LAST_REV_KEY, coder.encode_rev_value(rev))
             batch.commit()
             event.valid = True
             return rev, KeyValue(user_key, prev_value or b"", latest_rev)
@@ -466,6 +492,7 @@ class Backend:
             value = TOMBSTONE if deleted else event.value
             batch.put(coder.encode_object_key(event.key, rev), value,
                       creator.ttl_for_key(event.key))
+            batch.put(LAST_REV_KEY, coder.encode_rev_value(rev))
             batch.commit()
             new_event.valid = True
         except CASFailedError:

@@ -60,3 +60,9 @@ TOMBSTONE = b"\x00kb_tombstone\x00"
 META_PREFIX = b"!kb_meta/"
 COMPACT_KEY = META_PREFIX + b"compact"
 ELECTION_KEY = META_PREFIX + b"election"
+# Highest successfully-committed revision, updated inside every write batch.
+# A new leader seeds its sequencer from this + the election record clock so
+# revision numbers are never re-dealt across terms (the reference gets this
+# from TiKV's PD timestamp domain dominating revision counts; an embedded
+# commit-counter clock needs the explicit watermark).
+LAST_REV_KEY = META_PREFIX + b"last_rev"

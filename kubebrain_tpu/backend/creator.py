@@ -20,6 +20,7 @@ from __future__ import annotations
 
 from .. import coder
 from ..storage import CASFailedError, KvStorage
+from .common import LAST_REV_KEY
 from .errors import KeyExistsError
 
 EVENTS_TTL_PREFIX = b"/events/"
@@ -41,6 +42,7 @@ def create(store: KvStorage, user_key: bytes, value: bytes, revision: int) -> No
         batch = store.begin_batch_write()
         batch.put_if_not_exist(rev_key, coder.encode_rev_value(revision), ttl)
         batch.put(obj_key, value, ttl)
+        batch.put(LAST_REV_KEY, coder.encode_rev_value(revision))
         try:
             batch.commit()
             return
@@ -58,6 +60,7 @@ def create(store: KvStorage, user_key: bytes, value: bytes, revision: int) -> No
                 batch2 = store.begin_batch_write()
                 batch2.cas(rev_key, coder.encode_rev_value(revision), observed, ttl)
                 batch2.put(obj_key, value, ttl)
+                batch2.put(LAST_REV_KEY, coder.encode_rev_value(revision))
                 batch2.commit()  # CAS race here surfaces to caller
                 return
             raise KeyExistsError(user_key, old_rev) from e

@@ -40,13 +40,16 @@ class LockRecord:
     renewed_at: float
     lease_seconds: float
     tso: int  # storage logical clock at the last lock op
+    meta: dict | None = None  # holder-published metadata (e.g. client address)
 
     def to_bytes(self) -> bytes:
         return json.dumps(self.__dict__, sort_keys=True).encode()
 
     @classmethod
     def from_bytes(cls, raw: bytes) -> "LockRecord":
-        return cls(**json.loads(raw.decode()))
+        payload = json.loads(raw.decode())
+        payload.setdefault("meta", None)
+        return cls(**payload)
 
     def expired(self, now: float) -> bool:
         return now - self.renewed_at > self.lease_seconds
@@ -56,10 +59,17 @@ class ResourceLock:
     """CAS lock record manager (reference NewResourceLockManager,
     election.go:49-188)."""
 
-    def __init__(self, store: KvStorage, identity: str, key: bytes = ELECTION_KEY):
+    def __init__(
+        self,
+        store: KvStorage,
+        identity: str,
+        key: bytes = ELECTION_KEY,
+        meta: dict | None = None,
+    ):
         self._store = store
         self.identity = identity
         self._key = key
+        self.meta = meta or {}
 
     def get(self) -> LockRecord | None:
         try:
@@ -72,6 +82,7 @@ class ResourceLock:
         record = LockRecord(
             holder=self.identity, acquired_at=now, renewed_at=now,
             lease_seconds=lease_seconds, tso=self._store.get_timestamp_oracle(),
+            meta=self.meta,
         )
         batch = self._store.begin_batch_write()
         batch.put_if_not_exist(self._key, record.to_bytes())
@@ -137,6 +148,7 @@ class LeaderElection:
                 new = LockRecord(
                     holder=rec.holder, acquired_at=rec.acquired_at,
                     renewed_at=now, lease_seconds=self._lease, tso=rec.tso,
+                    meta=self._lock.meta,
                 )
                 self._current = self._lock.update(rec, new)
                 return True
@@ -144,6 +156,7 @@ class LeaderElection:
                 new = LockRecord(
                     holder=self._lock.identity, acquired_at=now,
                     renewed_at=now, lease_seconds=self._lease, tso=rec.tso,
+                    meta=self._lock.meta,
                 )
                 self._current = self._lock.update(rec, new)
                 return True

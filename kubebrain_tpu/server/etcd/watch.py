@@ -30,6 +30,13 @@ class WatchService:
         self.peers = peers
 
     def Watch(self, request_iterator, context):
+        if self.peers is not None and not self.peers.is_leader():
+            # followers serve watches from the leader's pipeline
+            # (reference etcd_proxy.go:239: watch forwarding)
+            forwarded = self.peers.forward_watch(request_iterator)
+            if forwarded is not None:
+                yield from forwarded
+                return
         out: queue.Queue = queue.Queue(maxsize=1024)
         session = _WatchSession(self.backend, out, context)
         reader = threading.Thread(

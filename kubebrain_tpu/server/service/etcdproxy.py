@@ -52,6 +52,25 @@ class EtcdProxy:
         except grpc.RpcError:
             return None
 
+    def forward_watch(self, request_iterator):
+        """Pipe a whole Watch stream through the leader (reference
+        etcd_proxy.go:239-288); returns a response iterator or None."""
+        target = self._get_leader()
+        if not target:
+            return None
+        with self._lock:
+            if target != self._target:
+                if self._channel is not None:
+                    self._channel.close()
+                self._channel = grpc.insecure_channel(target)
+                self._target = target
+            stream = self._channel.stream_stream(
+                "/etcdserverpb.Watch/Watch",
+                request_serializer=rpc_pb2.WatchRequest.SerializeToString,
+                response_deserializer=rpc_pb2.WatchResponse.FromString,
+            )
+        return stream(request_iterator)
+
     def close(self) -> None:
         with self._lock:
             if self._channel is not None:
@@ -64,6 +83,9 @@ class DisabledEtcdProxy:
     """No-op when --enable-etcd-proxy is off (reference etcdproxy/disabled.go)."""
 
     def forward_txn(self, request):  # noqa: ARG002
+        return None
+
+    def forward_watch(self, request_iterator):  # noqa: ARG002
         return None
 
     def close(self) -> None:

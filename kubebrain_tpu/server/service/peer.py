@@ -26,18 +26,33 @@ class PeerService:
         self.backend = backend
         self.identity = identity
         self._client_port = client_port
+        host = identity.rsplit(":", 1)[0]
         self.election = LeaderElection(
-            ResourceLock(backend.store, identity),
+            ResourceLock(
+                backend.store, identity,
+                meta={"client": f"{host}:{client_port}"},
+            ),
             on_started_leading=self._on_started_leading,
             on_stopped_leading=on_leader_change,
         )
         self.syncer = HttpRevisionSyncer(self.leader_peer_address, backend.set_current_revision)
         self.proxy = EtcdProxy(self.leader_client_address) if enable_proxy else DisabledEtcdProxy()
 
+    REVISION_GUARD = 1000  # headroom for revisions dealt-but-unpersisted by a crashed leader
+
     def _on_started_leading(self, start_revision: int) -> None:
-        """Seed the revision sequencer from the lock record's engine clock
-        (reference leader.go:96-107 → backend.SetCurrentRevision)."""
-        self.backend.set_current_revision(max(start_revision, self.backend.current_revision()))
+        """Seed the revision sequencer on taking leadership (reference
+        leader.go:96-107 → backend.SetCurrentRevision): the max of the lock
+        record's engine clock, the persisted last-committed-revision
+        watermark, and our local view — plus a guard so revisions a crashed
+        leader dealt to *failed* ops (never persisted anywhere) cannot be
+        re-dealt in the new term."""
+        seed = max(
+            start_revision,
+            self.backend.recover_revision(),
+            self.backend.current_revision(),
+        )
+        self.backend.set_current_revision(seed + self.REVISION_GUARD)
 
     # -------------------------------------------------------------- addresses
     def leader_peer_address(self) -> str | None:
@@ -46,10 +61,20 @@ class PeerService:
         return self.election.leader_identity()
 
     def leader_client_address(self) -> str | None:
-        peer = self.leader_peer_address()
-        if not peer:
+        """The leader's client (gRPC) address, published in the election
+        record meta; falls back to swapping the peer port for same-config
+        deployments."""
+        if self.election.is_leader():
+            host = self.identity.rsplit(":", 1)[0]
+            return f"{host}:{self._client_port}"
+        rec = self.election._lock.get()
+        import time as _time
+
+        if rec is None or rec.expired(_time.time()):
             return None
-        host = peer.rsplit(":", 1)[0]
+        if rec.meta and rec.meta.get("client"):
+            return rec.meta["client"]
+        host = rec.holder.rsplit(":", 1)[0]
         return f"{host}:{self._client_port}"
 
     # ------------------------------------------------------------- contract
@@ -68,6 +93,9 @@ class PeerService:
 
     def forward_txn(self, request):
         return self.proxy.forward_txn(request)
+
+    def forward_watch(self, request_iterator):
+        return self.proxy.forward_watch(request_iterator)
 
     def close(self) -> None:
         self.election.close()
@@ -92,6 +120,9 @@ class SingleNodePeerService:
         pass
 
     def forward_txn(self, request):  # noqa: ARG002
+        return None
+
+    def forward_watch(self, request_iterator):  # noqa: ARG002
         return None
 
     def leader_peer_address(self) -> str:

@@ -1,0 +1,243 @@
+"""``native`` engine: ctypes adapter over the C++ kbstore library.
+
+The embedded single-host engine (the role Badger plays for the reference,
+pkg/storage/badger) and the default authoritative host store under the TPU
+mirror. Build with ``make -C native``; the adapter auto-builds on first use
+when the toolchain is present.
+
+Mapping to the engine contract:
+- TSO            → kb_tso (commit counter; badger.go:41-46 uses ReadTs)
+- snapshot reads → kb_get / kb_iter_open(snap)
+- CAS batches    → kb_batch_* with conflict index + observed value
+- TTL            → native (support_ttl=True, entries expire server-side,
+                   badger.go:48)
+- partitions     → kb_split_keys sampling (the PD-region-map analogue)
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+from . import BatchWrite, Iter, KvStorage, Partition, register_engine
+from .errors import CASFailedError, Conflict, KeyNotFoundError
+
+_LIB_PATH = os.path.join(os.path.dirname(__file__), "..", "..", "native", "libkbstore.so")
+_lib = None
+_lib_lock = threading.Lock()
+
+
+def _load_lib() -> ctypes.CDLL:
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        path = os.path.abspath(_LIB_PATH)
+        if not os.path.exists(path):
+            subprocess.run(
+                ["make", "-C", os.path.dirname(path)], check=True, capture_output=True
+            )
+        lib = ctypes.CDLL(path)
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        lib.kb_open.restype = ctypes.c_void_p
+        lib.kb_close.argtypes = [ctypes.c_void_p]
+        lib.kb_tso.argtypes = [ctypes.c_void_p]
+        lib.kb_tso.restype = ctypes.c_uint64
+        lib.kb_get.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t, ctypes.c_uint64,
+            ctypes.POINTER(u8p), ctypes.POINTER(ctypes.c_size_t),
+        ]
+        lib.kb_free.argtypes = [ctypes.c_void_p]
+        lib.kb_batch_begin.argtypes = [ctypes.c_void_p]
+        lib.kb_batch_begin.restype = ctypes.c_void_p
+        for name, extra in [
+            ("kb_batch_put", [ctypes.c_char_p, ctypes.c_size_t, ctypes.c_int64]),
+            ("kb_batch_put_if_absent", [ctypes.c_char_p, ctypes.c_size_t, ctypes.c_int64]),
+        ]:
+            getattr(lib, name).argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t, *extra
+            ]
+        lib.kb_batch_cas.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t,
+            ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p, ctypes.c_size_t,
+            ctypes.c_int64,
+        ]
+        lib.kb_batch_del.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t]
+        lib.kb_batch_del_current.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t,
+            ctypes.c_char_p, ctypes.c_size_t,
+        ]
+        lib.kb_batch_abort.argtypes = [ctypes.c_void_p]
+        lib.kb_batch_commit.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(u8p),
+            ctypes.POINTER(ctypes.c_size_t), ctypes.POINTER(ctypes.c_int),
+        ]
+        lib.kb_iter_open.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t,
+            ctypes.c_char_p, ctypes.c_size_t, ctypes.c_uint64, ctypes.c_uint64,
+            ctypes.c_int,
+        ]
+        lib.kb_iter_open.restype = ctypes.c_void_p
+        lib.kb_iter_next.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(u8p), ctypes.POINTER(ctypes.c_size_t),
+            ctypes.POINTER(u8p), ctypes.POINTER(ctypes.c_size_t),
+        ]
+        lib.kb_iter_close.argtypes = [ctypes.c_void_p]
+        lib.kb_split_keys.argtypes = [
+            ctypes.c_void_p, ctypes.c_int, ctypes.c_char_p, ctypes.c_size_t,
+            ctypes.POINTER(ctypes.c_size_t),
+        ]
+        lib.kb_key_count.argtypes = [ctypes.c_void_p]
+        lib.kb_key_count.restype = ctypes.c_uint64
+        _lib = lib
+        return lib
+
+
+class NativeKv(KvStorage):
+    def __init__(self, partitions: int = 1):
+        self._lib = _load_lib()
+        self._store = ctypes.c_void_p(self._lib.kb_open())
+        self._n_parts = partitions
+
+    def get_timestamp_oracle(self) -> int:
+        return int(self._lib.kb_tso(self._store))
+
+    def get_partitions(self, start: bytes, end: bytes) -> list[Partition]:
+        n = self._n_parts
+        if n <= 1:
+            return [Partition(start, end)]
+        width = 256
+        borders_buf = ctypes.create_string_buffer(width * (n - 1))
+        lens = (ctypes.c_size_t * (n - 1))()
+        got = self._lib.kb_split_keys(self._store, n, borders_buf, width, lens)
+        borders = [start]
+        for i in range(got):
+            b = borders_buf.raw[i * width : i * width + lens[i]]
+            if borders[-1] < b and (not end or b < end):
+                borders.append(b)
+        borders.append(end)
+        return [Partition(borders[i], borders[i + 1]) for i in range(len(borders) - 1)]
+
+    def get(self, key: bytes, snapshot_ts: int | None = None) -> bytes:
+        out = ctypes.POINTER(ctypes.c_uint8)()
+        out_len = ctypes.c_size_t()
+        rc = self._lib.kb_get(
+            self._store, key, len(key), snapshot_ts or 0,
+            ctypes.byref(out), ctypes.byref(out_len),
+        )
+        if rc != 0:
+            raise KeyNotFoundError(key)
+        try:
+            return ctypes.string_at(out, out_len.value)
+        finally:
+            self._lib.kb_free(out)
+
+    def iter(self, start: bytes, end: bytes, snapshot_ts: int | None = None, limit: int = 0) -> Iter:
+        reverse = 1 if (end and start > end) else 0
+        handle = self._lib.kb_iter_open(
+            self._store, start, len(start), end, len(end),
+            snapshot_ts or 0, limit, reverse,
+        )
+        return _NativeIter(self._lib, handle)
+
+    def begin_batch_write(self) -> BatchWrite:
+        return _NativeBatch(self._lib, self._lib.kb_batch_begin(self._store))
+
+    def support_ttl(self) -> bool:
+        return True
+
+    def key_count(self) -> int:
+        return int(self._lib.kb_key_count(self._store))
+
+    def close(self) -> None:
+        if self._store:
+            self._lib.kb_close(self._store)
+            self._store = None
+
+
+class _NativeIter(Iter):
+    def __init__(self, lib, handle):
+        self._lib = lib
+        self._h = handle
+
+    def next(self) -> tuple[bytes, bytes]:
+        if self._h is None:
+            raise StopIteration
+        k = ctypes.POINTER(ctypes.c_uint8)()
+        kl = ctypes.c_size_t()
+        v = ctypes.POINTER(ctypes.c_uint8)()
+        vl = ctypes.c_size_t()
+        rc = self._lib.kb_iter_next(
+            self._h, ctypes.byref(k), ctypes.byref(kl), ctypes.byref(v), ctypes.byref(vl)
+        )
+        if rc != 0:
+            self.close()
+            raise StopIteration
+        return ctypes.string_at(k, kl.value), ctypes.string_at(v, vl.value)
+
+    def close(self) -> None:
+        if self._h is not None:
+            self._lib.kb_iter_close(self._h)
+            self._h = None
+
+    def __del__(self):
+        self.close()
+
+
+class _NativeBatch(BatchWrite):
+    def __init__(self, lib, handle):
+        self._lib = lib
+        self._h = handle
+        self._keys: list[bytes] = []
+
+    def put_if_not_exist(self, key, value, ttl_seconds=0):
+        self._keys.append(key)
+        self._lib.kb_batch_put_if_absent(self._h, key, len(key), value, len(value), ttl_seconds)
+
+    def cas(self, key, new_value, old_value, ttl_seconds=0):
+        self._keys.append(key)
+        self._lib.kb_batch_cas(
+            self._h, key, len(key), new_value, len(new_value),
+            old_value, len(old_value), ttl_seconds,
+        )
+
+    def put(self, key, value, ttl_seconds=0):
+        self._keys.append(key)
+        self._lib.kb_batch_put(self._h, key, len(key), value, len(value), ttl_seconds)
+
+    def delete(self, key):
+        self._keys.append(key)
+        self._lib.kb_batch_del(self._h, key, len(key))
+
+    def del_current(self, key, expected_value):
+        self._keys.append(key)
+        self._lib.kb_batch_del_current(self._h, key, len(key), expected_value, len(expected_value))
+
+    def commit(self):
+        idx = ctypes.c_int64(-1)
+        val = ctypes.POINTER(ctypes.c_uint8)()
+        vlen = ctypes.c_size_t()
+        has_val = ctypes.c_int(0)
+        rc = self._lib.kb_batch_commit(
+            self._h, ctypes.byref(idx), ctypes.byref(val),
+            ctypes.byref(vlen), ctypes.byref(has_val),
+        )
+        self._h = None  # commit consumes the batch
+        if rc != 0:
+            observed = None
+            if has_val.value:
+                observed = ctypes.string_at(val, vlen.value)
+                self._lib.kb_free(val)
+            i = int(idx.value)
+            key = self._keys[i] if 0 <= i < len(self._keys) else b""
+            raise CASFailedError(Conflict(i, key, observed))
+
+    def __del__(self):
+        if self._h is not None:
+            self._lib.kb_batch_abort(self._h)
+            self._h = None
+
+
+register_engine("native", NativeKv)

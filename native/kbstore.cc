@@ -1,0 +1,299 @@
+// kbstore — embedded versioned KV engine (C ABI for ctypes).
+//
+// The native host block manager of the framework (SURVEY §2.8): plays the
+// role Badger plays for the reference (embedded single-node engine,
+// pkg/storage/badger) and serves as the authoritative host store under the
+// TPU mirror engine (storage/tpu). Not a port of anything: an ordered map of
+// version chains with snapshot isolation, conditional write batches that
+// report CAS conflicts with the observed value, a logical commit clock
+// (timestamp oracle), native TTL, chunked snapshot iterators, and key-space
+// split sampling for partition-parallel scans.
+//
+// Engine contract (docs/storage_engine.md:3-15 of the reference): snapshot
+// reads, bidirectional traversal, CAS write transactions, exposed logical
+// clock; snapshot isolation + linearizable writes (one writer lock, readers
+// concurrent via shared_mutex).
+
+#include <cstdint>
+#include <cstring>
+#include <ctime>
+#include <map>
+#include <memory>
+#include <mutex>
+#include <shared_mutex>
+#include <string>
+#include <vector>
+
+namespace {
+
+struct Version {
+  uint64_t ts;
+  bool deleted;
+  double expire_at;  // 0 = no TTL
+  std::string value;
+};
+
+struct Store {
+  std::map<std::string, std::vector<Version>> data;
+  uint64_t ts = 0;
+  mutable std::shared_mutex mu;
+
+  const std::string* live(const std::string& key, uint64_t snap, double now) const {
+    auto it = data.find(key);
+    if (it == data.end()) return nullptr;
+    const auto& versions = it->second;
+    for (auto v = versions.rbegin(); v != versions.rend(); ++v) {
+      if (v->ts <= snap) {
+        if (v->deleted) return nullptr;
+        if (v->expire_at != 0 && now >= v->expire_at) return nullptr;
+        return &v->value;
+      }
+    }
+    return nullptr;
+  }
+};
+
+enum OpKind : int {
+  OP_PUT = 0,
+  OP_PUT_IF_ABSENT = 1,
+  OP_CAS = 2,
+  OP_DEL = 3,
+  OP_DEL_CURRENT = 4,
+};
+
+struct Op {
+  int kind;
+  std::string key;
+  std::string value;     // new value for puts
+  std::string expected;  // old value for CAS / DelCurrent
+  int64_t ttl_seconds;
+};
+
+struct Batch {
+  Store* store;
+  std::vector<Op> ops;
+};
+
+struct Iter {
+  std::vector<std::pair<std::string, std::string>> buf;
+  size_t pos = 0;
+};
+
+double wallclock() { return static_cast<double>(time(nullptr)); }
+
+}  // namespace
+
+extern "C" {
+
+void* kb_open() { return new Store(); }
+
+void kb_close(void* s) { delete static_cast<Store*>(s); }
+
+uint64_t kb_tso(void* s) {
+  Store* st = static_cast<Store*>(s);
+  std::shared_lock<std::shared_mutex> lock(st->mu);
+  return st->ts;
+}
+
+// Point get at a snapshot (snap = 0 means latest). Returns 0 and copies the
+// value into a malloc'd buffer on hit; 1 on miss.
+int kb_get(void* s, const uint8_t* key, size_t klen, uint64_t snap,
+           uint8_t** out, size_t* out_len) {
+  Store* st = static_cast<Store*>(s);
+  std::shared_lock<std::shared_mutex> lock(st->mu);
+  std::string k(reinterpret_cast<const char*>(key), klen);
+  const std::string* v = st->live(k, snap ? snap : st->ts, wallclock());
+  if (v == nullptr) return 1;
+  *out = static_cast<uint8_t*>(malloc(v->size()));
+  memcpy(*out, v->data(), v->size());
+  *out_len = v->size();
+  return 0;
+}
+
+void kb_free(void* p) { free(p); }
+
+// ------------------------------------------------------------------ batches
+void* kb_batch_begin(void* s) {
+  Batch* b = new Batch();
+  b->store = static_cast<Store*>(s);
+  return b;
+}
+
+static void push_op(void* b, int kind, const uint8_t* key, size_t klen,
+                    const uint8_t* val, size_t vlen, const uint8_t* exp,
+                    size_t elen, int64_t ttl) {
+  Batch* batch = static_cast<Batch*>(b);
+  Op op;
+  op.kind = kind;
+  op.key.assign(reinterpret_cast<const char*>(key), klen);
+  if (val) op.value.assign(reinterpret_cast<const char*>(val), vlen);
+  if (exp) op.expected.assign(reinterpret_cast<const char*>(exp), elen);
+  op.ttl_seconds = ttl;
+  batch->ops.push_back(std::move(op));
+}
+
+void kb_batch_put(void* b, const uint8_t* k, size_t kl, const uint8_t* v,
+                  size_t vl, int64_t ttl) {
+  push_op(b, OP_PUT, k, kl, v, vl, nullptr, 0, ttl);
+}
+
+void kb_batch_put_if_absent(void* b, const uint8_t* k, size_t kl,
+                            const uint8_t* v, size_t vl, int64_t ttl) {
+  push_op(b, OP_PUT_IF_ABSENT, k, kl, v, vl, nullptr, 0, ttl);
+}
+
+void kb_batch_cas(void* b, const uint8_t* k, size_t kl, const uint8_t* nv,
+                  size_t nvl, const uint8_t* ov, size_t ovl, int64_t ttl) {
+  push_op(b, OP_CAS, k, kl, nv, nvl, ov, ovl, ttl);
+}
+
+void kb_batch_del(void* b, const uint8_t* k, size_t kl) {
+  push_op(b, OP_DEL, k, kl, nullptr, 0, nullptr, 0, 0);
+}
+
+void kb_batch_del_current(void* b, const uint8_t* k, size_t kl,
+                          const uint8_t* exp, size_t el) {
+  push_op(b, OP_DEL_CURRENT, k, kl, nullptr, 0, exp, el, 0);
+}
+
+void kb_batch_abort(void* b) { delete static_cast<Batch*>(b); }
+
+// Commit: all-or-nothing under the writer lock. Returns 0 on success; 1 on
+// conditional-op conflict, filling conflict_idx and (when the key had a live
+// value) a malloc'd copy of the observed value (conflict_has_val = 1).
+// The batch is freed either way.
+int kb_batch_commit(void* b, int64_t* conflict_idx, uint8_t** conflict_val,
+                    size_t* conflict_len, int* conflict_has_val) {
+  std::unique_ptr<Batch> batch(static_cast<Batch*>(b));
+  Store* st = batch->store;
+  double now = wallclock();
+  std::unique_lock<std::shared_mutex> lock(st->mu);
+  // validate conditions against latest state
+  for (size_t i = 0; i < batch->ops.size(); ++i) {
+    const Op& op = batch->ops[i];
+    if (op.kind == OP_PUT || op.kind == OP_DEL) continue;
+    const std::string* cur = st->live(op.key, st->ts, now);
+    bool ok = true;
+    if (op.kind == OP_PUT_IF_ABSENT) {
+      ok = (cur == nullptr);
+    } else if (op.kind == OP_CAS || op.kind == OP_DEL_CURRENT) {
+      ok = (cur != nullptr && *cur == op.expected);
+    }
+    if (!ok) {
+      *conflict_idx = static_cast<int64_t>(i);
+      if (cur != nullptr) {
+        *conflict_val = static_cast<uint8_t*>(malloc(cur->size()));
+        memcpy(*conflict_val, cur->data(), cur->size());
+        *conflict_len = cur->size();
+        *conflict_has_val = 1;
+      } else {
+        *conflict_has_val = 0;
+      }
+      return 1;
+    }
+  }
+  uint64_t ts = ++st->ts;
+  for (const Op& op : batch->ops) {
+    Version v;
+    v.ts = ts;
+    if (op.kind == OP_DEL || op.kind == OP_DEL_CURRENT) {
+      v.deleted = true;
+      v.expire_at = 0;
+    } else {
+      v.deleted = false;
+      v.expire_at = op.ttl_seconds ? now + static_cast<double>(op.ttl_seconds) : 0;
+      v.value = op.value;
+    }
+    st->data[op.key].push_back(std::move(v));
+  }
+  return 0;
+}
+
+// --------------------------------------------------------------- iteration
+// Snapshot range iterator, buffered at open (consistent view without holding
+// the lock across the drain). Forward: [start, end) ascending; reverse
+// (reverse=1): [end, start] descending — the engine-contract shape the
+// backend's point-get path expects.
+void* kb_iter_open(void* s, const uint8_t* start, size_t slen,
+                   const uint8_t* end, size_t elen, uint64_t snap,
+                   uint64_t limit, int reverse) {
+  Store* st = static_cast<Store*>(s);
+  std::string lo(reinterpret_cast<const char*>(start), slen);
+  std::string hi(reinterpret_cast<const char*>(end), elen);
+  Iter* it = new Iter();
+  double now = wallclock();
+  std::shared_lock<std::shared_mutex> lock(st->mu);
+  uint64_t at = snap ? snap : st->ts;
+  if (!reverse) {
+    auto b = st->data.lower_bound(lo);
+    auto e = hi.empty() ? st->data.end() : st->data.lower_bound(hi);
+    for (auto cur = b; cur != e; ++cur) {
+      const std::string* v = st->live(cur->first, at, now);
+      if (v == nullptr) continue;
+      it->buf.emplace_back(cur->first, *v);
+      if (limit && it->buf.size() >= limit) break;
+    }
+  } else {
+    // reverse contract: keys k with hi <= k <= lo, descending (lo=start)
+    auto b = st->data.lower_bound(hi);
+    auto e = st->data.upper_bound(lo);
+    for (auto cur = e; cur != b;) {
+      --cur;
+      const std::string* v = st->live(cur->first, at, now);
+      if (v == nullptr) continue;
+      it->buf.emplace_back(cur->first, *v);
+      if (limit && it->buf.size() >= limit) break;
+    }
+  }
+  return it;
+}
+
+int kb_iter_next(void* itp, const uint8_t** key, size_t* klen,
+                 const uint8_t** val, size_t* vlen) {
+  Iter* it = static_cast<Iter*>(itp);
+  if (it->pos >= it->buf.size()) return 1;
+  const auto& kv = it->buf[it->pos++];
+  *key = reinterpret_cast<const uint8_t*>(kv.first.data());
+  *klen = kv.first.size();
+  *val = reinterpret_cast<const uint8_t*>(kv.second.data());
+  *vlen = kv.second.size();
+  return 0;
+}
+
+void kb_iter_close(void* itp) { delete static_cast<Iter*>(itp); }
+
+// ------------------------------------------------------------- partitions
+// Sample n_parts-1 evenly spaced live keys as split borders (the shard map
+// the reference gets from PD ScanRegions, pkg/storage/tikv/tikv.go:123-153).
+// Borders are written into caller-provided fixed-width rows; returns the
+// number of borders produced.
+int kb_split_keys(void* s, int n_parts, uint8_t* borders, size_t row_width,
+                  size_t* border_lens) {
+  Store* st = static_cast<Store*>(s);
+  std::shared_lock<std::shared_mutex> lock(st->mu);
+  size_t n = st->data.size();
+  if (n_parts <= 1 || n < static_cast<size_t>(n_parts)) return 0;
+  size_t stride = n / static_cast<size_t>(n_parts);
+  int produced = 0;
+  size_t i = 0;
+  for (const auto& entry : st->data) {
+    if (produced >= n_parts - 1) break;
+    if (i > 0 && i % stride == 0) {
+      size_t copy = entry.first.size() < row_width ? entry.first.size() : row_width;
+      memcpy(borders + static_cast<size_t>(produced) * row_width,
+             entry.first.data(), copy);
+      border_lens[produced] = copy;
+      ++produced;
+    }
+    ++i;
+  }
+  return produced;
+}
+
+uint64_t kb_key_count(void* s) {
+  Store* st = static_cast<Store*>(s);
+  std::shared_lock<std::shared_mutex> lock(st->mu);
+  return st->data.size();
+}
+
+}  // extern "C"
