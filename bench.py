@@ -143,11 +143,39 @@ def main() -> None:
     print(f"[bench] CPU numpy: {cpu_dt:.2f}s = {cpu_rate/1e6:.1f}M rows/s "
           f"(visible {cpu_visible})", file=sys.stderr)
 
-    # ---- device kernel
-    @jax.jit
-    def scan_count(keys, a, b, t, nv, s, e, hi, lo):
-        mask = visibility_mask(keys, a, b, t, nv, s, e, jnp.asarray(False), hi, lo)
-        return jnp.sum(mask, dtype=jnp.int64 if jax.config.x64_enabled else jnp.int32)
+    # ---- device kernel (jnp/XLA by default; KB_BENCH_PALLAS=1 for the
+    # explicit chunk-major Pallas kernel)
+    use_pallas = os.environ.get("KB_BENCH_PALLAS") == "1"
+    if use_pallas:
+        from kubebrain_tpu.ops import scan_pallas as sp
+
+        revs_u64 = ((rh.astype(np.uint64) << np.uint64(32)) | rl.astype(np.uint64))
+        keys_t, rh31, rl31, tomb8, n_real = sp.prepare_blocks(chunks, revs_u64, tomb)
+        qhi31, qlo31 = sp.split_revs31(np.array([int(read_rev)], dtype=np.uint64))
+        s_f = sp.pack_bound_flipped(start)
+        e_f = sp.pack_bound_flipped(end)
+        p_args = [jax.device_put(jnp.asarray(x), dev) for x in (keys_t, rh31, rl31, tomb8)]
+        p_bounds = [jax.device_put(jnp.asarray(x), dev) for x in (s_f, e_f)]
+
+        interp = dev.platform not in ("tpu", "axon")  # pallas needs interpret off-TPU
+
+        @jax.jit
+        def scan_count_pallas_sum(kt, a, b, t, s, e):
+            mask = sp.scan_mask_pallas(
+                kt, a, b, t, np.int32(n_real), s, e,
+                np.int32(0), np.int32(qhi31[0]), np.int32(qlo31[0]),
+                interpret=interp,
+            )
+            return jnp.sum(mask, dtype=jnp.int32)
+
+        def scan_count(*_ignored):
+            return scan_count_pallas_sum(*p_args, *p_bounds)
+
+    else:
+        @jax.jit
+        def scan_count(keys, a, b, t, nv, s, e, hi, lo):
+            mask = visibility_mask(keys, a, b, t, nv, s, e, jnp.asarray(False), hi, lo)
+            return jnp.sum(mask, dtype=jnp.int32)
 
     d_args = [jax.device_put(x, dev) for x in (chunks, rh, rl, tomb)]
     s_dev, e_dev = jax.device_put(start, dev), jax.device_put(end, dev)

@@ -1,0 +1,184 @@
+"""Pallas TPU kernel for the MVCC visibility scan.
+
+Same math as ops.scan.visibility_mask, tiled explicitly for the TPU VPU:
+
+- **chunk-major layout** ``int32[C, N]``: rows ride the 128-wide lane axis,
+  key chunks ride sublanes, so per-row reductions (lex compare, equality)
+  are cheap sublane reductions instead of cross-lane ones;
+- **sign-flipped chunks**: packed big-endian u32 chunks XOR 0x8000_0000 make
+  signed int32 order equal unsigned byte order — Mosaic-native compares;
+- **31-bit revision split** (hi = rev >> 31, lo = rev & 0x7fff_ffff): both
+  halves non-negative int32, so revision compares stay signed-safe;
+- **reverse-tile grid + carry**: "is this row superseded?" looks at the NEXT
+  row, so tiles run last→first and a VMEM/SMEM scratch carries the next
+  tile's first key/candidate across grid steps (TPU grid iterations are
+  sequential, so the carry is well-defined — the Pallas analogue of the scan
+  worker's prev-key carry, scanner.go:408-414);
+- the lex compare avoids argmax/gather: first-differing-chunk selection via
+  an exclusive cumsum over the not-equal mask.
+
+Falls back to interpret mode off-TPU (tests run it on CPU against the jnp
+kernel as oracle).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+LANE_TILE = 1024  # rows per grid step
+
+
+def flip_sign(chunks: np.ndarray) -> np.ndarray:
+    """uint32 chunks -> order-preserving int32 (big-endian unsigned order)."""
+    return (chunks.astype(np.uint32) ^ np.uint32(0x80000000)).view(np.int32)
+
+
+def split_revs31(revs: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """uint64 -> (hi, lo) non-negative int32 halves (31-bit low split)."""
+    revs = np.asarray(revs, dtype=np.uint64)
+    hi = (revs >> np.uint64(31)).astype(np.int64)
+    if (hi >= 2**31).any():
+        raise ValueError("revision exceeds 2^62")
+    return hi.astype(np.int32), (revs & np.uint64(0x7FFFFFFF)).astype(np.int32)
+
+
+def _lex_less(keys, bound, neq, lt):
+    """columns of keys < bound, via exclusive-cumsum first-diff selection.
+
+    keys/neq/lt: [C, T]; bound: [C, 1]. Returns [1, T] bool.
+    """
+    del keys, bound
+    before = jnp.cumsum(neq.astype(jnp.int32), axis=0) - neq.astype(jnp.int32)
+    first_diff = neq & (before == 0)
+    return jnp.any(first_diff & lt, axis=0, keepdims=True)
+
+
+def _kernel(scal_ref, start_ref, end_ref,
+            keys_ref, rh_ref, rl_ref, tomb_ref,
+            mask_ref,
+            carry_key, carry_flag):
+    i = pl.program_id(0)
+    nt = pl.num_programs(0)
+    t = nt - 1 - i  # reversed tile order
+
+    n_valid = scal_ref[0]
+    unbounded = scal_ref[1]
+    qhi = scal_ref[2]
+    qlo = scal_ref[3]
+
+    keys = keys_ref[:, :]          # [C, T] int32 (sign-flipped chunks)
+    rh = rh_ref[:, :]              # [1, T]
+    rl = rl_ref[:, :]
+    tomb = tomb_ref[:, :] != 0     # [1, T]
+    c, tile = keys.shape
+
+    start = start_ref[:, :]        # [C, 1]
+    end = end_ref[:, :]
+
+    neq_s = keys != start
+    lt_s = keys < start
+    less_start = _lex_less(keys, start, neq_s, lt_s)
+    neq_e = keys != end
+    lt_e = keys < end
+    less_end = _lex_less(keys, end, neq_e, lt_e)
+    in_range = (~less_start) & ((unbounded != 0) | less_end)
+
+    rev_le = (rh < qhi) | ((rh == qhi) & (rl <= qlo))
+
+    lane = jax.lax.broadcasted_iota(jnp.int32, (1, tile), 1)
+    idx = t * tile + lane
+    valid = idx < n_valid
+
+    cand = valid & in_range & rev_le & True
+
+    # same-key-as-next within the tile; the last column compares against the
+    # carried first key of the NEXT tile (processed in the previous step)
+    nxt_keys = jnp.roll(keys, -1, axis=1)
+    carried = carry_key[:, :]  # [C, 1]
+    is_last_col = lane == (tile - 1)
+    nxt_keys = jnp.where(is_last_col, carried, nxt_keys)
+    same_next = jnp.all(keys == nxt_keys, axis=0, keepdims=True)
+    have_next = (t + 1) * tile < n_valid
+    same_next = same_next & (~is_last_col | have_next)
+
+    cand_next = jnp.roll(cand, -1, axis=1)
+    carried_cand = carry_flag[0] != 0
+    cand_next = jnp.where(is_last_col, carried_cand & have_next, cand_next)
+
+    visible = cand & ~(same_next & cand_next) & ~tomb
+    mask_ref[:, :] = visible.astype(jnp.int8)
+
+    # publish this tile's first column for the next grid step (tile t-1)
+    carry_key[:, :] = keys[:, 0:1]
+    carry_flag[0] = cand[0, 0].astype(jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def scan_mask_pallas(keys_t, rh31, rl31, tomb, n_valid, start, end, unbounded,
+                     qhi31, qlo31, interpret=False):
+    """Visibility mask via the Pallas kernel.
+
+    keys_t: int32[C, N] chunk-major sign-flipped; rh31/rl31: int32[N];
+    tomb: int8[N]; start/end: int32[C] sign-flipped bounds;
+    scalars: n_valid, unbounded, qhi31, qlo31.
+    Returns bool[N].
+    """
+    c, n = keys_t.shape
+    assert n % LANE_TILE == 0, "pad rows to LANE_TILE"
+    nt = n // LANE_TILE
+    scal = jnp.stack([
+        jnp.asarray(n_valid, jnp.int32),
+        jnp.asarray(unbounded, jnp.int32),
+        jnp.asarray(qhi31, jnp.int32),
+        jnp.asarray(qlo31, jnp.int32),
+    ])
+    rev_map = lambda i: (0, nt - 1 - i)
+    mask = pl.pallas_call(
+        _kernel,
+        grid=(nt,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),          # scalars
+            pl.BlockSpec((c, 1), lambda i: (0, 0)),          # start bound
+            pl.BlockSpec((c, 1), lambda i: (0, 0)),          # end bound
+            pl.BlockSpec((c, LANE_TILE), rev_map),           # keys
+            pl.BlockSpec((1, LANE_TILE), rev_map),           # rev hi
+            pl.BlockSpec((1, LANE_TILE), rev_map),           # rev lo
+            pl.BlockSpec((1, LANE_TILE), rev_map),           # tombstones
+        ],
+        out_specs=pl.BlockSpec((1, LANE_TILE), rev_map),
+        out_shape=jax.ShapeDtypeStruct((1, n), jnp.int8),
+        scratch_shapes=[
+            pltpu.VMEM((c, 1), jnp.int32),                   # carried first key
+            pltpu.SMEM((1,), jnp.int32),                     # carried first cand
+        ],
+        interpret=interpret,
+    )(
+        scal,
+        start.reshape(c, 1), end.reshape(c, 1),
+        keys_t, rh31.reshape(1, n), rl31.reshape(1, n), tomb.reshape(1, n),
+    )
+    return mask.reshape(n) != 0
+
+
+def prepare_blocks(chunks: np.ndarray, revs: np.ndarray, tomb: np.ndarray,
+                   tile: int = LANE_TILE):
+    """Row-major uint32 blocks -> pallas layout (padded, chunk-major)."""
+    n, c = chunks.shape
+    pad = (-n) % tile
+    if pad:
+        chunks = np.pad(chunks, ((0, pad), (0, 0)))
+        revs = np.pad(revs, (0, pad))
+        tomb = np.pad(tomb, (0, pad))
+    keys_t = np.ascontiguousarray(flip_sign(chunks).T)
+    rh31, rl31 = split_revs31(revs)
+    return keys_t, rh31, rl31, tomb.astype(np.int8), n
+
+
+def pack_bound_flipped(bound_chunks: np.ndarray) -> np.ndarray:
+    return flip_sign(bound_chunks.reshape(1, -1)).reshape(-1)

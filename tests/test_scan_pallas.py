@@ -1,0 +1,94 @@
+"""Pallas scan kernel vs the jnp kernel (oracle), interpret mode on CPU."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from kubebrain_tpu.ops import keys as keyops
+from kubebrain_tpu.ops.scan import visibility_mask
+from kubebrain_tpu.ops import scan_pallas as sp
+
+
+def build(seed, n_keys=300, revs_max=5):
+    rng = np.random.RandomState(seed)
+    keys = sorted(
+        {b"/reg/" + bytes(rng.randint(97, 123, rng.randint(2, 20), dtype=np.uint8)) for _ in range(n_keys)}
+    )
+    rows, rev = [], 0
+    for k in keys:
+        for _ in range(rng.randint(1, revs_max)):
+            rev += 1
+            rows.append((k, rev, rng.rand() < 0.15))
+    chunks, _ = keyops.pack_keys([r[0] for r in rows], 64)
+    revs = np.array([r[1] for r in rows], dtype=np.uint64)
+    tomb = np.array([r[2] for r in rows])
+    return rows, chunks, revs, tomb, rev
+
+
+@pytest.mark.parametrize("seed", [0, 3])
+@pytest.mark.parametrize("bounds", [
+    (b"", b""),
+    (b"/reg/f", b"/reg/q"),
+    (b"/reg/zzzz", b""),
+])
+def test_pallas_matches_jnp(seed, bounds):
+    rows, chunks, revs, tomb, max_rev = build(seed)
+    start, end = bounds
+    read_rev = max_rev * 2 // 3 or 1
+
+    # oracle: jnp kernel on unpadded rows
+    hi, lo = keyops.split_revs(revs)
+    want = np.asarray(
+        visibility_mask(
+            jnp.asarray(chunks), jnp.asarray(hi), jnp.asarray(lo), jnp.asarray(tomb),
+            jnp.asarray(np.int32(len(rows))),
+            jnp.asarray(keyops.pack_one(start, 64)),
+            jnp.asarray(keyops.pack_one(end, 64)),
+            jnp.asarray(not end),
+            *[jnp.asarray(x[0]) for x in keyops.split_revs(np.array([read_rev], dtype=np.uint64))],
+        )
+    )
+
+    keys_t, rh31, rl31, tomb8, n = sp.prepare_blocks(chunks, revs, tomb)
+    qhi31, qlo31 = sp.split_revs31(np.array([read_rev], dtype=np.uint64))
+    got = np.asarray(
+        sp.scan_mask_pallas(
+            jnp.asarray(keys_t), jnp.asarray(rh31), jnp.asarray(rl31), jnp.asarray(tomb8),
+            np.int32(n),
+            jnp.asarray(sp.pack_bound_flipped(keyops.pack_one(start, 64))),
+            jnp.asarray(sp.pack_bound_flipped(keyops.pack_one(end, 64))),
+            np.int32(not end), np.int32(qhi31[0]), np.int32(qlo31[0]),
+            interpret=True,
+        )
+    )[:n]
+    assert (got == want).all(), f"mismatch at {np.nonzero(got != want)[0][:10]}"
+
+
+def test_pallas_cross_tile_carry():
+    """A version chain straddling the tile boundary must resolve through the
+    carry: the superseded row sits at the end of one tile, its successor at
+    the start of the next."""
+    tile = sp.LANE_TILE
+    n = 2 * tile
+    keys = [b"/reg/k%08d" % (i // 2) for i in range(n)]  # 2 revs per key
+    chunks, _ = keyops.pack_keys(keys, 64)
+    revs = np.arange(1, n + 1, dtype=np.uint64)
+    tomb = np.zeros(n, dtype=bool)
+    keys_t, rh31, rl31, tomb8, nn = sp.prepare_blocks(chunks, revs, tomb)
+    qhi31, qlo31 = sp.split_revs31(np.array([n], dtype=np.uint64))
+    got = np.asarray(
+        sp.scan_mask_pallas(
+            jnp.asarray(keys_t), jnp.asarray(rh31), jnp.asarray(rl31), jnp.asarray(tomb8),
+            np.int32(nn),
+            jnp.asarray(sp.pack_bound_flipped(keyops.pack_one(b"", 64))),
+            jnp.asarray(sp.pack_bound_flipped(keyops.pack_one(b"", 64))),
+            np.int32(1), np.int32(qhi31[0]), np.int32(qlo31[0]),
+            interpret=True,
+        )
+    )[:nn]
+    # exactly every second row visible (the rev-2 of each key), including the
+    # pair straddling the boundary
+    want = np.zeros(n, dtype=bool)
+    want[1::2] = True
+    assert (got == want).all()
