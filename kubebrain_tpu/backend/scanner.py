@@ -408,13 +408,31 @@ class Scanner:
         flush_group()
 
         # batched deletes: unconditional for superseded rows, guarded
-        # (delete-if-unchanged) for revision records
+        # (delete-if-unchanged) for revision records. Each batch retries with
+        # backoff like the scan workers (scanner.go:351-387) — deletes are
+        # idempotent, so re-running a batch is safe.
         BATCH = 256
         for i in range(0, len(plain_victims), BATCH):
-            b = store.begin_batch_write()
-            for k in plain_victims[i : i + BATCH]:
-                b.delete(k)
-            b.commit()
+            chunk = plain_victims[i : i + BATCH]
+
+            def commit_chunk() -> None:
+                b = store.begin_batch_write()
+                for k in chunk:
+                    b.delete(k)
+                b.commit()
+
+            backoff = 0.01
+            for attempt in range(WORKER_RETRIES):
+                try:
+                    commit_chunk()
+                    break
+                except CASFailedError:
+                    raise
+                except Exception:
+                    if attempt == WORKER_RETRIES - 1:
+                        raise
+                    time.sleep(backoff)
+                    backoff *= 2
         for rev_key, expected in guarded_victims:
             try:
                 store.del_current(rev_key, expected)

@@ -1,0 +1,131 @@
+"""Native brain protocol surface tests (reference pkg/server/brain)."""
+
+import queue
+import threading
+
+import grpc
+import pytest
+
+from kubebrain_tpu.cli import build_endpoint, build_parser
+from kubebrain_tpu.proto import brain_pb2
+
+from test_etcd_server import free_port
+
+
+class BrainClient:
+    def __init__(self, target):
+        self.ch = grpc.insecure_channel(target)
+        p = brain_pb2
+
+        def u(name, req, resp):
+            return self.ch.unary_unary(
+                f"/brainpb.Brain/{name}",
+                request_serializer=req.SerializeToString,
+                response_deserializer=resp.FromString,
+            )
+
+        def us(name, req, resp):
+            return self.ch.unary_stream(
+                f"/brainpb.Brain/{name}",
+                request_serializer=req.SerializeToString,
+                response_deserializer=resp.FromString,
+            )
+
+        self.create = u("Create", p.CreateRequest, p.CreateResponse)
+        self.update = u("Update", p.UpdateRequest, p.UpdateResponse)
+        self.delete = u("Delete", p.BrainDeleteRequest, p.BrainDeleteResponse)
+        self.compact = u("Compact", p.BrainCompactRequest, p.BrainCompactResponse)
+        self.get = u("Get", p.GetRequest, p.GetResponse)
+        self.range = u("Range", p.BrainRangeRequest, p.BrainRangeResponse)
+        self.range_stream = us("RangeStream", p.BrainRangeRequest, p.BrainRangeResponse)
+        self.count = u("Count", p.CountRequest, p.CountResponse)
+        self.list_partition = u("ListPartition", p.ListPartitionRequest, p.ListPartitionResponse)
+        self.watch = us("Watch", p.BrainWatchRequest, p.BrainWatchResponse)
+
+    def close(self):
+        self.ch.close()
+
+
+@pytest.fixture(scope="module")
+def brain():
+    port = free_port()
+    args = build_parser().parse_args([
+        "--single-node", "--storage", "memkv", "--host", "127.0.0.1",
+        "--client-port", str(port),
+        "--peer-port", str(free_port()), "--info-port", str(free_port()),
+    ])
+    endpoint, backend, store = build_endpoint(args)
+    endpoint.run()
+    client = BrainClient(f"127.0.0.1:{port}")
+    yield client, backend
+    client.close()
+    endpoint.close()
+    backend.close()
+    store.close()
+
+
+def test_brain_crud(brain):
+    c, _ = brain
+    r = c.create(brain_pb2.CreateRequest(key=b"/k", value=b"v1"))
+    assert r.succeeded and r.revision > 0
+    rev1 = r.revision
+    dup = c.create(brain_pb2.CreateRequest(key=b"/k", value=b"x"))
+    assert not dup.succeeded and dup.revision == rev1
+
+    g = c.get(brain_pb2.GetRequest(key=b"/k"))
+    assert g.kv.value == b"v1" and g.kv.revision == rev1
+
+    u = c.update(brain_pb2.UpdateRequest(key=b"/k", value=b"v2", expected_revision=rev1))
+    assert u.succeeded
+    stale = c.update(brain_pb2.UpdateRequest(key=b"/k", value=b"x", expected_revision=rev1))
+    assert not stale.succeeded and stale.latest.value == b"v2"
+
+    d = c.delete(brain_pb2.BrainDeleteRequest(key=b"/k"))
+    assert d.succeeded and d.prev_kv.value == b"v2"
+    g = c.get(brain_pb2.GetRequest(key=b"/k"))
+    assert not g.HasField("kv")
+
+
+def test_brain_range_stream_count_partitions(brain):
+    c, _ = brain
+    for i in range(25):
+        c.create(brain_pb2.CreateRequest(key=b"/data/i%03d" % i, value=b"v"))
+    r = c.range(brain_pb2.BrainRangeRequest(start=b"/data/", end=b"/data0", limit=10))
+    assert len(r.kvs) == 10 and r.more
+    total = []
+    for resp in c.range_stream(brain_pb2.BrainRangeRequest(start=b"/data/", end=b"/data0")):
+        total.extend(resp.kvs)
+    assert len(total) == 25
+    cnt = c.count(brain_pb2.CountRequest(start=b"/data/", end=b"/data0"))
+    assert cnt.count == 25
+    lp = c.list_partition(brain_pb2.ListPartitionRequest(start=b"/data/", end=b"/data0"))
+    assert lp.borders[0] == b"/data/" and lp.borders[-1] == b"/data0"
+
+
+def test_brain_watch_and_compact(brain):
+    c, backend = brain
+    events = []
+    started = threading.Event()
+
+    def consume():
+        stream = c.watch(brain_pb2.BrainWatchRequest(prefix=b"/watched/"))
+        started.set()
+        for resp in stream:
+            events.extend(resp.events)
+            if len(events) >= 2:
+                return
+
+    t = threading.Thread(target=consume, daemon=True)
+    t.start()
+    started.wait(5)
+    import time
+
+    time.sleep(0.2)  # let the server register the watcher
+    r = c.create(brain_pb2.CreateRequest(key=b"/watched/a", value=b"v1"))
+    c.update(brain_pb2.UpdateRequest(key=b"/watched/a", value=b"v2", expected_revision=r.revision))
+    t.join(timeout=5)
+    assert [e.type for e in events[:2]] == [brain_pb2.CREATE, brain_pb2.PUT]
+    assert events[1].prev_revision == r.revision
+
+    done = c.compact(brain_pb2.BrainCompactRequest(revision=backend.current_revision()))
+    assert done.compacted_revision == backend.current_revision()

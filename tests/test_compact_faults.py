@@ -1,0 +1,187 @@
+"""Compaction consistency under injected engine failures + TTL expiry via
+the compactor.
+
+Reference: compact_test.go (storageWrapper failing Del/DelCurrent on the
+Nth call, TestCompactConsistence :134-160) and expire_test.go
+(TestCompactExpiredEvents :32 with eventsTTL shrunk).
+"""
+
+import time
+
+import pytest
+
+from kubebrain_tpu import coder
+from kubebrain_tpu.backend import Backend, BackendConfig, wait_for_revision
+from kubebrain_tpu.backend import scanner as scanner_mod
+from kubebrain_tpu.storage import new_storage
+from kubebrain_tpu.storage.errors import KeyNotFoundError, StorageError
+
+
+class FailNthDelete:
+    """Engine decorator: the Nth batch containing deletes fails once
+    (fault injection by decoration, compact_test.go:83-132)."""
+
+    def __init__(self, store, fail_on_call=1):
+        self._store = store
+        self.calls = 0
+        self.fail_on = fail_on_call
+
+    def __getattr__(self, name):
+        return getattr(self._store, name)
+
+    def exclusive_client(self):
+        return self
+
+    def begin_batch_write(self):
+        real = self._store.begin_batch_write()
+        outer = self
+
+        class B:
+            def __init__(self):
+                self.has_delete = False
+
+            def __getattr__(self, name):
+                if name == "delete":
+                    def d(key):
+                        self.has_delete = True
+                        real.delete(key)
+                    return d
+                return getattr(real, name)
+
+            def commit(self):
+                if self.has_delete:
+                    outer.calls += 1
+                    if outer.calls == outer.fail_on:
+                        raise StorageError("injected delete failure")
+                real.commit()
+
+        return B()
+
+
+def test_compact_retries_through_transient_failure():
+    """A transient engine failure during GC must not corrupt state: the
+    partition worker retries with backoff (scanner.go:351-387) and the data
+    remains correct afterwards."""
+    inner = new_storage("memkv")
+    store = FailNthDelete(inner, fail_on_call=1)
+    b = Backend(store, BackendConfig(event_ring_capacity=2048))
+    K = b"/registry/pods/a"
+    r1 = b.create(K, b"v1")
+    r2 = b.update(K, b"v2", r1)
+    assert wait_for_revision(b, r2)
+    done = b.compact(r2)  # first delete batch fails, retry succeeds
+    assert done == r2
+    assert store.calls >= 1
+    with pytest.raises(KeyNotFoundError):
+        inner.get(coder.encode_object_key(K, r1))
+    assert b.get(K).value == b"v2"
+    b.close()
+    inner.close()
+
+
+def test_compact_consistence_after_permanent_failure():
+    """Even if a GC batch fails every retry, reads stay consistent: the
+    compact watermark fences stale reads and live data survives."""
+    inner = new_storage("memkv")
+    store = FailNthDelete(inner, fail_on_call=0)  # never matches -> no failure
+
+    class AlwaysFail(FailNthDelete):
+        def begin_batch_write(self):
+            real = self._store.begin_batch_write()
+
+            class B:
+                def __init__(self):
+                    self.has_delete = False
+
+                def __getattr__(self, name):
+                    if name == "delete":
+                        def d(key):
+                            self.has_delete = True
+                            real.delete(key)
+                        return d
+                    return getattr(real, name)
+
+                def commit(self):
+                    if self.has_delete:
+                        raise StorageError("permanent delete failure")
+                    real.commit()
+
+            return B()
+
+    store = AlwaysFail(inner)
+    b = Backend(store, BackendConfig(event_ring_capacity=2048))
+    K = b"/registry/pods/a"
+    r1 = b.create(K, b"v1")
+    r2 = b.update(K, b"v2", r1)
+    assert wait_for_revision(b, r2)
+    with pytest.raises(StorageError):
+        b.compact(r2)
+    # watermark was persisted before the GC pass -> stale reads fenced
+    from kubebrain_tpu.backend import CompactedError
+
+    with pytest.raises(CompactedError):
+        b.get(K, revision=r1)
+    # live data untouched (GC never deleted anything)
+    assert b.get(K).value == b"v2"
+    assert inner.get(coder.encode_object_key(K, r1)) == b"v1"
+    b.close()
+    inner.close()
+
+
+def test_ttl_expiry_via_compaction(monkeypatch):
+    """Engine without native TTL: /events/ keys are expired by the compactor
+    using the compact-history cutoff (scanner.go:566-591; expire_test.go)."""
+    store = new_storage("memkv", ttl_supported=False)
+    b = Backend(store, BackendConfig(event_ring_capacity=2048))
+    KE = b"/events/ev1"
+    KN = b"/registry/pods/a"
+    r1 = b.create(KE, b"event-payload")
+    r2 = b.create(KN, b"pod")
+    assert wait_for_revision(b, r2)
+
+    # first compaction logs (rev, now); pretend TTL elapsed, then compact again
+    done = b.compact(r2)
+    assert done == r2
+    assert b.get(KE).value == b"event-payload"  # not expired yet
+
+    hist = b.scanner.compact_history
+    now = time.time()
+    monkeypatch.setattr(scanner_mod, "EVENTS_TTL_SECONDS", 0.5)
+    # age the history entries past the (shrunk) TTL
+    with hist._lock:
+        hist._entries = [(rev, t - 3600) for rev, t in hist._entries]
+
+    r3 = b.create(b"/registry/pods/b", b"x")
+    assert wait_for_revision(b, r3)
+    b.compact(r3)
+    # the events key is gone entirely; the normal key survives
+    with pytest.raises(KeyNotFoundError):
+        b.get(KE)
+    with pytest.raises(KeyNotFoundError):
+        store.get(coder.encode_revision_key(KE))
+    assert b.get(KN).value == b"pod"
+    b.close()
+    store.close()
+
+
+def test_skip_prefixes_excluded_from_compaction():
+    """--skip-prefixes punch holes in the compact borders
+    (compact.go:107-126, TestConstructCompactBordersWithSkippedPrefixOption)."""
+    store = new_storage("memkv")
+    b = Backend(
+        store,
+        BackendConfig(event_ring_capacity=2048, skip_prefixes=[b"/skipme/"]),
+    )
+    r1 = b.create(b"/registry/a", b"v1")
+    r2 = b.update(b"/registry/a", b"v2", r1)
+    s1 = b.create(b"/skipme/x", b"s1")
+    s2 = b.update(b"/skipme/x", b"s2", s1)
+    assert wait_for_revision(b, s2)
+    b.compact(s2)
+    # /registry superseded version GC'd...
+    with pytest.raises(KeyNotFoundError):
+        store.get(coder.encode_object_key(b"/registry/a", r1))
+    # ...but the skipped prefix keeps its full history
+    assert store.get(coder.encode_object_key(b"/skipme/x", s1)) == b"s1"
+    b.close()
+    store.close()
