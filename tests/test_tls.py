@@ -1,0 +1,108 @@
+"""TLS endpoint tests: secure gRPC + HTTPS control plane with generated
+certs (reference: pkg/util/auth testdata + endpoint_test.go TestRunEndpoint
+hitting /health over http and https)."""
+
+import datetime
+import os
+import ssl
+import urllib.request
+
+import grpc
+import pytest
+
+from kubebrain_tpu.cli import build_endpoint, build_parser
+from kubebrain_tpu.proto import rpc_pb2
+
+from test_etcd_server import EtcdClient, free_port
+
+
+@pytest.fixture(scope="module")
+def certs(tmp_path_factory):
+    """Self-signed server cert for 127.0.0.1 (the gen-certs.sh analogue)."""
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import rsa
+    from cryptography.x509.oid import NameOID
+
+    d = tmp_path_factory.mktemp("certs")
+    key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    name = x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, "kubebrain-tpu-test")])
+    now = datetime.datetime.now(datetime.timezone.utc)
+    cert = (
+        x509.CertificateBuilder()
+        .subject_name(name)
+        .issuer_name(name)
+        .public_key(key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(now - datetime.timedelta(minutes=5))
+        .not_valid_after(now + datetime.timedelta(days=1))
+        .add_extension(
+            x509.SubjectAlternativeName([
+                x509.DNSName("localhost"),
+                x509.IPAddress(__import__("ipaddress").ip_address("127.0.0.1")),
+            ]),
+            critical=False,
+        )
+        .sign(key, hashes.SHA256())
+    )
+    cert_file = os.path.join(d, "server.crt")
+    key_file = os.path.join(d, "server.key")
+    with open(cert_file, "wb") as f:
+        f.write(cert.public_bytes(serialization.Encoding.PEM))
+    with open(key_file, "wb") as f:
+        f.write(key.private_bytes(
+            serialization.Encoding.PEM,
+            serialization.PrivateFormat.TraditionalOpenSSL,
+            serialization.NoEncryption(),
+        ))
+    return cert_file, key_file
+
+
+def test_secure_grpc_and_https(certs):
+    cert_file, key_file = certs
+    port, peer, info = free_port(), free_port(), free_port()
+    args = build_parser().parse_args([
+        "--single-node", "--storage", "memkv", "--host", "127.0.0.1",
+        "--client-port", str(port), "--peer-port", str(peer), "--info-port", str(info),
+        "--cert-file", cert_file, "--key-file", key_file,
+    ])
+    endpoint, backend, store = build_endpoint(args)
+    endpoint.config.insecure = False  # secure-only mode
+    endpoint.run()
+    try:
+        with open(cert_file, "rb") as f:
+            creds = grpc.ssl_channel_credentials(root_certificates=f.read())
+        ch = grpc.secure_channel(f"127.0.0.1:{port}", creds)
+        txn = ch.unary_unary(
+            "/etcdserverpb.KV/Txn",
+            request_serializer=rpc_pb2.TxnRequest.SerializeToString,
+            response_deserializer=rpc_pb2.TxnResponse.FromString,
+        )
+        req = rpc_pb2.TxnRequest()
+        c = req.compare.add()
+        c.result = rpc_pb2.Compare.EQUAL
+        c.target = rpc_pb2.Compare.MOD
+        c.key = b"/tls/k"
+        c.mod_revision = 0
+        req.success.add().request_put.CopyFrom(rpc_pb2.PutRequest(key=b"/tls/k", value=b"v"))
+        resp = txn(req, timeout=5)
+        assert resp.succeeded
+        ch.close()
+
+        # plaintext client must NOT work in secure-only mode
+        insecure = EtcdClient(f"127.0.0.1:{port}")
+        with pytest.raises(grpc.RpcError):
+            insecure.create(b"/tls/x", b"v")
+        insecure.close()
+
+        # HTTPS control plane
+        ctx = ssl.create_default_context(cafile=cert_file)
+        ctx.check_hostname = False
+        with urllib.request.urlopen(
+            f"https://127.0.0.1:{peer}/health", timeout=5, context=ctx
+        ) as resp:
+            assert b"true" in resp.read()
+    finally:
+        endpoint.close()
+        backend.close()
+        store.close()
