@@ -27,6 +27,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="storage engine (reference: build-tag selected TiKV/Badger)")
     p.add_argument("--inner-storage", default="memkv",
                    help="host engine backing the tpu mirror (tpu engine only)")
+    p.add_argument("--data-dir", default="",
+                   help="durable storage dir for the native engine (WAL + "
+                        "snapshot); empty = in-memory")
+    p.add_argument("--fsync", action="store_true",
+                   help="fsync the WAL on every commit")
     p.add_argument("--host", default="0.0.0.0")
     p.add_argument("--client-port", type=int, default=2379)
     p.add_argument("--peer-port", type=int, default=2380)
@@ -76,8 +81,14 @@ def build_endpoint(args):
     from .util.net import get_host
 
     metrics = new_metrics(args.cluster_name)
+    native_kw = {}
+    if getattr(args, "data_dir", ""):
+        native_kw = {"data_dir": args.data_dir, "fsync": args.fsync}
     if args.storage == "tpu":
-        store = new_storage("tpu", inner=args.inner_storage)
+        inner_kw = native_kw if args.inner_storage == "native" else {}
+        store = new_storage("tpu", inner=args.inner_storage, **inner_kw)
+    elif args.storage == "native":
+        store = new_storage("native", **native_kw)
     else:
         store = new_storage(args.storage)
     if args.enable_storage_metrics:

@@ -13,7 +13,8 @@ if _here not in sys.path:
     sys.path.insert(0, _here)
 
 import brain_pb2  # noqa: E402
+import health_pb2  # noqa: E402
 import kv_pb2  # noqa: E402
 import rpc_pb2  # noqa: E402
 
-__all__ = ["kv_pb2", "rpc_pb2", "brain_pb2"]
+__all__ = ["kv_pb2", "rpc_pb2", "brain_pb2", "health_pb2"]

@@ -34,9 +34,58 @@ class Server:
         self.metrics = metrics or NoopMetrics()
         self.identity = identity
         self.brain = BrainServer(backend, peers)
-        self.grpc_handlers = make_etcd_handlers(
-            backend, peers, identity, client_urls or []
-        ) + make_brain_handlers(self.brain)
+        self.grpc_handlers = (
+            make_etcd_handlers(backend, peers, identity, client_urls or [])
+            + make_brain_handlers(self.brain)
+            + [self._health_handler()]
+        )
+
+    def _health_handler(self):
+        """grpc.health.v1 terminal; the "leader" service reflects leadership
+        (reference wires election callbacks into grpc-health, server.go:72-78)."""
+        import grpc
+
+        from ..proto import health_pb2
+
+        def check(request, context):
+            if request.service in ("", "etcd", "brain"):
+                status = health_pb2.HealthCheckResponse.SERVING
+            elif request.service == "leader":
+                status = (
+                    health_pb2.HealthCheckResponse.SERVING
+                    if self.peers.is_leader()
+                    else health_pb2.HealthCheckResponse.NOT_SERVING
+                )
+            else:
+                context.abort(grpc.StatusCode.NOT_FOUND, "unknown service")
+            return health_pb2.HealthCheckResponse(status=status)
+
+        def watch(request, context):
+            """Long-lived status stream (grpc.health.v1 contract): emit the
+            current status, then only on change, until the client departs."""
+            import time as _time
+
+            last = check(request, context)
+            yield last
+            while context.is_active():
+                _time.sleep(0.5)
+                cur = check(request, context)
+                if cur.status != last.status:
+                    last = cur
+                    yield cur
+
+        return grpc.method_handlers_generic_handler("grpc.health.v1.Health", {
+            "Check": grpc.unary_unary_rpc_method_handler(
+                check,
+                request_deserializer=health_pb2.HealthCheckRequest.FromString,
+                response_serializer=health_pb2.HealthCheckResponse.SerializeToString,
+            ),
+            "Watch": grpc.unary_stream_rpc_method_handler(
+                watch,
+                request_deserializer=health_pb2.HealthCheckRequest.FromString,
+                response_serializer=health_pb2.HealthCheckResponse.SerializeToString,
+            ),
+        })
 
     def start_background(self) -> None:
         self.brain.start_background()
@@ -50,6 +99,7 @@ class Server:
             "/status": self._status,
             "/election": self._election,
             "/debug/threads": self._threads,
+            "/debug/jax-profile": self._jax_profile,
         }
 
     def _health(self):
@@ -85,6 +135,32 @@ class Server:
             out.append(f"--- thread {name} ---")
             out.extend(line.rstrip() for line in traceback.format_stack(frame))
         return "text/plain", "\n".join(out).encode()
+
+    _profile_lock = threading.Lock()
+
+    def _jax_profile(self):
+        """Capture a 2s jax profiler trace of the data plane (the kernel
+        analogue of the reference's pprof mounts, pkg/endpoint/pprof.go;
+        inspect with tensorboard or xprof). One capture at a time — an
+        overlapping request would stop the in-flight trace mid-capture."""
+        import time
+
+        import jax
+
+        if not self._profile_lock.acquire(blocking=False):
+            return "application/json", json.dumps(
+                {"error": "profile capture already in progress"}
+            ).encode()
+        try:
+            out_dir = f"/tmp/kb-jax-profile-{int(time.time())}"
+            jax.profiler.start_trace(out_dir)
+            try:
+                time.sleep(2.0)
+            finally:
+                jax.profiler.stop_trace()
+            return "application/json", json.dumps({"trace_dir": out_dir}).encode()
+        finally:
+            self._profile_lock.release()
 
     def close(self) -> None:
         self.brain.close()

@@ -59,6 +59,12 @@ class KVService:
             range_end = b""
         try:
             if request.count_only:
+                if not self.backend.config.enable_etcd_compatibility:
+                    # Count is an etcd-compat feature (reference range.go:188)
+                    context.abort(
+                        grpc.StatusCode.UNIMPLEMENTED,
+                        "etcdserver: count requires etcd compatibility mode",
+                    )
                 if single_key:
                     try:
                         self.backend.get(request.key, request.revision)

@@ -22,7 +22,7 @@ import subprocess
 import threading
 
 from . import BatchWrite, Iter, KvStorage, Partition, register_engine
-from .errors import CASFailedError, Conflict, KeyNotFoundError
+from .errors import CASFailedError, Conflict, KeyNotFoundError, StorageError
 
 _LIB_PATH = os.path.join(os.path.dirname(__file__), "..", "..", "native", "libkbstore.so")
 _lib = None
@@ -42,6 +42,9 @@ def _load_lib() -> ctypes.CDLL:
         lib = ctypes.CDLL(path)
         u8p = ctypes.POINTER(ctypes.c_uint8)
         lib.kb_open.restype = ctypes.c_void_p
+        lib.kb_open_at.argtypes = [ctypes.c_char_p, ctypes.c_int]
+        lib.kb_open_at.restype = ctypes.c_void_p
+        lib.kb_checkpoint.argtypes = [ctypes.c_void_p]
         lib.kb_close.argtypes = [ctypes.c_void_p]
         lib.kb_tso.argtypes = [ctypes.c_void_p]
         lib.kb_tso.restype = ctypes.c_uint64
@@ -96,10 +99,23 @@ def _load_lib() -> ctypes.CDLL:
 
 
 class NativeKv(KvStorage):
-    def __init__(self, partitions: int = 1):
+    def __init__(self, partitions: int = 1, data_dir: str = "", fsync: bool = False):
         self._lib = _load_lib()
-        self._store = ctypes.c_void_p(self._lib.kb_open())
+        if data_dir:
+            os.makedirs(data_dir, exist_ok=True)
+            self._store = ctypes.c_void_p(
+                self._lib.kb_open_at(data_dir.encode(), 1 if fsync else 0)
+            )
+            if not self._store:
+                raise StorageError(f"failed to open/recover store at {data_dir}")
+        else:
+            self._store = ctypes.c_void_p(self._lib.kb_open())
         self._n_parts = partitions
+
+    def checkpoint(self) -> None:
+        """Write a latest-only snapshot and truncate the WAL."""
+        if self._lib.kb_checkpoint(self._store) != 0:
+            raise StorageError("checkpoint failed (snapshot write or WAL reopen)")
 
     def get_timestamp_oracle(self) -> int:
         return int(self._lib.kb_tso(self._store))
@@ -225,6 +241,8 @@ class _NativeBatch(BatchWrite):
             ctypes.byref(vlen), ctypes.byref(has_val),
         )
         self._h = None  # commit consumes the batch
+        if rc == 2:
+            raise StorageError("WAL append failed; commit aborted")
         if rc != 0:
             observed = None
             if has_val.value:

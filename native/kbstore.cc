@@ -15,8 +15,12 @@
 // concurrent via shared_mutex).
 
 #include <cstdint>
+#include <cstdio>
 #include <cstring>
 #include <ctime>
+#ifdef __unix__
+#include <unistd.h>
+#endif
 #include <map>
 #include <memory>
 #include <mutex>
@@ -37,6 +41,11 @@ struct Store {
   std::map<std::string, std::vector<Version>> data;
   uint64_t ts = 0;
   mutable std::shared_mutex mu;
+  // durability (optional): write-ahead log appended per commit; snapshot
+  // rewrites latest-only state and truncates the log (kb_checkpoint).
+  std::string dir;     // empty = in-memory only
+  FILE* wal = nullptr;
+  bool fsync_commits = false;
 
   const std::string* live(const std::string& key, uint64_t snap, double now) const {
     auto it = data.find(key);
@@ -81,13 +90,182 @@ struct Iter {
 
 double wallclock() { return static_cast<double>(time(nullptr)); }
 
+// --------------------------------------------------------------- durability
+// Log record: [u32 KBW1][u64 ts][u32 nops] then per op:
+// [u8 kind(0=put,1=del)][u32 klen][u32 vlen][f64 expire_at][key][val].
+// Replay stops at the first torn/malformed record (crash-safe tail).
+constexpr uint32_t kWalMagic = 0x4b425731;
+
+struct AppliedOp {
+  uint8_t kind;  // 0 put, 1 del
+  std::string key;
+  std::string value;
+  double expire_at;
+};
+
+bool write_record(FILE* f, uint64_t ts, const std::vector<AppliedOp>& ops) {
+  uint32_t magic = kWalMagic;
+  uint32_t nops = static_cast<uint32_t>(ops.size());
+  if (fwrite(&magic, 4, 1, f) != 1) return false;
+  if (fwrite(&ts, 8, 1, f) != 1) return false;
+  if (fwrite(&nops, 4, 1, f) != 1) return false;
+  for (const auto& op : ops) {
+    uint32_t klen = op.key.size(), vlen = op.value.size();
+    if (fwrite(&op.kind, 1, 1, f) != 1) return false;
+    if (fwrite(&klen, 4, 1, f) != 1) return false;
+    if (fwrite(&vlen, 4, 1, f) != 1) return false;
+    if (fwrite(&op.expire_at, 8, 1, f) != 1) return false;
+    if (klen && fwrite(op.key.data(), 1, klen, f) != klen) return false;
+    if (vlen && fwrite(op.value.data(), 1, vlen, f) != vlen) return false;
+  }
+  return true;
+}
+
+// Replay records with ts > min_ts (records at or below min_ts are already
+// covered by the snapshot — replaying them would push stale versions AFTER
+// newer ones in the per-key vectors and corrupt live()).
+void replay_file(Store* st, const std::string& path, uint64_t min_ts = 0) {
+  FILE* f = fopen(path.c_str(), "rb");
+  if (f == nullptr) return;
+  while (true) {
+    uint32_t magic = 0, nops = 0;
+    uint64_t ts = 0;
+    if (fread(&magic, 4, 1, f) != 1 || magic != kWalMagic) break;
+    if (fread(&ts, 8, 1, f) != 1) break;
+    if (fread(&nops, 4, 1, f) != 1) break;
+    std::vector<AppliedOp> ops;
+    ops.reserve(nops);
+    bool ok = true;
+    for (uint32_t i = 0; i < nops && ok; ++i) {
+      AppliedOp op;
+      uint32_t klen = 0, vlen = 0;
+      ok = fread(&op.kind, 1, 1, f) == 1 && fread(&klen, 4, 1, f) == 1 &&
+           fread(&vlen, 4, 1, f) == 1 && fread(&op.expire_at, 8, 1, f) == 1;
+      if (ok && klen) {
+        op.key.resize(klen);
+        ok = fread(&op.key[0], 1, klen, f) == klen;
+      }
+      if (ok && vlen) {
+        op.value.resize(vlen);
+        ok = fread(&op.value[0], 1, vlen, f) == vlen;
+      }
+      if (ok) ops.push_back(std::move(op));
+    }
+    if (!ok) break;  // torn tail: discard the partial record
+    if (ts > min_ts) {
+      for (const auto& op : ops) {
+        Version v;
+        v.ts = ts;
+        v.deleted = op.kind == 1;
+        v.expire_at = op.expire_at;
+        v.value = op.value;
+        st->data[op.key].push_back(std::move(v));
+      }
+    }
+    if (ts > st->ts) st->ts = ts;
+  }
+  fclose(f);
+}
+
+void fsync_dir(const std::string& dir) {
+#ifdef __unix__
+  FILE* d = fopen(dir.c_str(), "rb");
+  if (d != nullptr) {
+    fsync(fileno(d));
+    fclose(d);
+  }
+#else
+  (void)dir;
+#endif
+}
+
+int checkpoint_locked(Store* st) {
+  // latest-only snapshot at the current clock; history before it only
+  // matters to in-flight snapshots, which do not survive a restart anyway
+  std::string snap_tmp = st->dir + "/snapshot.kb.tmp";
+  std::string snap = st->dir + "/snapshot.kb";
+  std::string wal_path = st->dir + "/wal.kb";
+  FILE* f = fopen(snap_tmp.c_str(), "wb");
+  if (f == nullptr) return 1;
+  double now = wallclock();
+  std::vector<AppliedOp> ops;
+  ops.reserve(st->data.size());
+  for (const auto& entry : st->data) {
+    const std::string* v = st->live(entry.first, st->ts, now);
+    if (v == nullptr) continue;
+    AppliedOp op;
+    op.kind = 0;
+    op.key = entry.first;
+    op.value = *v;
+    op.expire_at = entry.second.back().expire_at;
+    ops.push_back(std::move(op));
+  }
+  bool ok = write_record(f, st->ts, ops);
+  fflush(f);
+#ifdef __unix__
+  if (ok) ok = fsync(fileno(f)) == 0;  // snapshot bytes durable before rename
+#endif
+  fclose(f);
+  if (!ok) return 1;
+  if (rename(snap_tmp.c_str(), snap.c_str()) != 0) return 1;
+  fsync_dir(st->dir);  // rename durable before the WAL is truncated
+  if (st->wal != nullptr) fclose(st->wal);
+  st->wal = fopen(wal_path.c_str(), "wb");  // truncate: snapshot covers it
+  if (st->wal == nullptr) return 1;
+  fflush(st->wal);
+#ifdef __unix__
+  fsync(fileno(st->wal));
+#endif
+  return 0;
+}
+
 }  // namespace
 
 extern "C" {
 
 void* kb_open() { return new Store(); }
 
-void kb_close(void* s) { delete static_cast<Store*>(s); }
+// Durable open: load snapshot + replay WAL from dir, then append new commits
+// to the WAL (fsync per commit when fsync_commits != 0).
+void* kb_open_at(const char* dir, int fsync_commits) {
+  Store* st = new Store();
+  if (dir != nullptr && dir[0] != '\0') {
+    st->dir = dir;
+    st->fsync_commits = fsync_commits != 0;
+    replay_file(st, st->dir + "/snapshot.kb");
+    uint64_t snap_ts = st->ts;
+    // skip WAL records the snapshot already covers (a crash between the
+    // snapshot rename and the WAL truncation leaves them behind)
+    replay_file(st, st->dir + "/wal.kb", snap_ts);
+    // checkpoint immediately: writes a clean snapshot and truncates the WAL,
+    // so a torn tail left by a crash is never appended after
+    if (checkpoint_locked(st) != 0) {
+      delete st;
+      return nullptr;
+    }
+  }
+  return st;
+}
+
+int kb_checkpoint(void* s) {
+  Store* st = static_cast<Store*>(s);
+  if (st->dir.empty()) return 0;
+  std::unique_lock<std::shared_mutex> lock(st->mu);
+  return checkpoint_locked(st);
+}
+
+void kb_close(void* s) {
+  Store* st = static_cast<Store*>(s);
+  if (!st->dir.empty()) {
+    std::unique_lock<std::shared_mutex> lock(st->mu);
+    checkpoint_locked(st);
+    if (st->wal != nullptr) {
+      fclose(st->wal);
+      st->wal = nullptr;
+    }
+  }
+  delete st;
+}
 
 uint64_t kb_tso(void* s) {
   Store* st = static_cast<Store*>(s);
@@ -193,18 +371,53 @@ int kb_batch_commit(void* b, int64_t* conflict_idx, uint8_t** conflict_val,
     }
   }
   uint64_t ts = ++st->ts;
+  std::vector<AppliedOp> applied;
+  applied.reserve(batch->ops.size());
   for (const Op& op : batch->ops) {
+    AppliedOp a;
+    a.key = op.key;
+    if (op.kind == OP_DEL || op.kind == OP_DEL_CURRENT) {
+      a.kind = 1;
+      a.expire_at = 0;
+    } else {
+      a.kind = 0;
+      a.expire_at = op.ttl_seconds ? now + static_cast<double>(op.ttl_seconds) : 0;
+      a.value = op.value;
+    }
+    applied.push_back(std::move(a));
+  }
+  // write-ahead: the record hits the log before memory state mutates; a
+  // failed append rolls the log back to the record start and FAILS the
+  // commit (rc 2) — an acknowledged write must be replayable
+  if (st->wal != nullptr) {
+    long rec_start = ftell(st->wal);
+    bool logged = write_record(st->wal, ts, applied);
+    if (logged) logged = fflush(st->wal) == 0;
+    if (logged && st->fsync_commits) {
+#ifdef __unix__
+      logged = fsync(fileno(st->wal)) == 0;
+#endif
+    }
+    if (!logged) {
+      fflush(st->wal);
+#ifdef __unix__
+      if (rec_start >= 0) {
+        if (ftruncate(fileno(st->wal), rec_start) == 0) {
+          fseek(st->wal, rec_start, SEEK_SET);
+        }
+      }
+#endif
+      --st->ts;  // the failed commit's timestamp was never observable
+      return 2;
+    }
+  }
+  for (const AppliedOp& a : applied) {
     Version v;
     v.ts = ts;
-    if (op.kind == OP_DEL || op.kind == OP_DEL_CURRENT) {
-      v.deleted = true;
-      v.expire_at = 0;
-    } else {
-      v.deleted = false;
-      v.expire_at = op.ttl_seconds ? now + static_cast<double>(op.ttl_seconds) : 0;
-      v.value = op.value;
-    }
-    st->data[op.key].push_back(std::move(v));
+    v.deleted = a.kind == 1;
+    v.expire_at = a.expire_at;
+    v.value = a.value;
+    st->data[a.key].push_back(std::move(v));
   }
   return 0;
 }
