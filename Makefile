@@ -1,0 +1,30 @@
+# Build / test / bench entry points (reference analogue: makefile +
+# build/build-*.sh; engine choice is a runtime flag here, not a build tag).
+
+.PHONY: all native test bench bench-all run clean protos
+
+all: native
+
+native:
+	$(MAKE) -C native
+
+protos:
+	cd kubebrain_tpu/proto && protoc --python_out=. kv.proto rpc.proto brain.proto health.proto
+
+test: native
+	python -m pytest tests/ -q
+
+bench: native
+	python bench.py
+
+bench-all: native
+	python bench.py
+	KB_BENCH_METRIC=fanout python bench.py
+	KB_BENCH_METRIC=compact python bench.py
+	KB_BENCH_METRIC=insert python bench.py
+
+run: native
+	python -m kubebrain_tpu.cli --single-node --storage=tpu --inner-storage=native
+
+clean:
+	$(MAKE) -C native clean

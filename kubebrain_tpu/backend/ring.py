@@ -8,7 +8,6 @@ the oldest cached event must re-list (backend/watch.go:78-84).
 
 from __future__ import annotations
 
-import bisect
 import threading
 
 from .common import WatchEvent
@@ -19,6 +18,11 @@ class RingOverflowError(Exception):
 
 
 class Ring:
+    """Circular buffer of events in strictly increasing revision order (the
+    single sequencer is the only writer). ``find_events`` binary-searches the
+    rotated array in place — no O(cache) copy under the lock at 200k events
+    (a watch registration holds the hub lock while replaying)."""
+
     def __init__(self, capacity: int):
         assert capacity > 0
         self._cap = capacity
@@ -34,15 +38,13 @@ class Ring:
                 self._buf[self._start] = event
                 self._start = (self._start + 1) % self._cap
 
-    def _ordered(self) -> list[WatchEvent]:
-        return self._buf[self._start :] + self._buf[: self._start]
+    def _at(self, logical_index: int) -> WatchEvent:
+        return self._buf[(self._start + logical_index) % len(self._buf)]
 
     def oldest_revision(self) -> int:
         """0 when empty."""
         with self._lock:
-            if not self._buf:
-                return 0
-            return self._buf[self._start].revision
+            return self._buf[self._start].revision if self._buf else 0
 
     def latest_revision(self) -> int:
         with self._lock:
@@ -53,13 +55,19 @@ class Ring:
     def find_events(self, revision: int) -> list[WatchEvent]:
         """All cached events with event.revision >= revision, in order.
 
-        Reference ring.go:84-118 (sort.Search + suffix copy).
+        Reference ring.go:84-118 (sort.Search + suffix copy) — binary search
+        over the rotated array, copying out only the matching suffix.
         """
         with self._lock:
-            ordered = self._ordered()
-            revs = [e.revision for e in ordered]
-            idx = bisect.bisect_left(revs, revision)
-            return ordered[idx:]
+            n = len(self._buf)
+            lo, hi = 0, n
+            while lo < hi:
+                mid = (lo + hi) // 2
+                if self._at(mid).revision < revision:
+                    lo = mid + 1
+                else:
+                    hi = mid
+            return [self._at(i) for i in range(lo, n)]
 
     def __len__(self) -> int:
         with self._lock:

@@ -1,0 +1,54 @@
+"""Multi-host deployment: ICI/DCN split and process-group initialization.
+
+The scale model (SURVEY §2.10): inside one slice, the data plane moves over
+**ICI** — scan masks, counts (psum), fan-out masks all run under shard_map
+on the global mesh, with XLA inserting the collectives. Across hosts, the
+**control plane** rides DCN exactly like the reference's gRPC/HTTP plumbing:
+leader election through the storage layer, follower revision sync over
+HTTP /status, write/watch forwarding over gRPC. Storage partitions map onto
+the mesh's ``part`` axis so data placement follows key-space sharding on
+every host.
+
+``init_multihost`` wraps jax.distributed initialization; on a pod slice each
+host then sees the global device set and builds the same Mesh from
+``jax.devices()`` — the kernels in kubebrain_tpu.ops need no changes (they
+are written against a mesh, not a device count). Single-host development and
+the CI virtual CPU mesh go through the same code path with n_processes=1.
+"""
+
+from __future__ import annotations
+
+import jax
+
+from .mesh import make_mesh
+
+
+def init_multihost(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> None:
+    """Join the jax distributed process group (no-op for single-process).
+
+    On TPU pods the three arguments are inferred from the environment;
+    elsewhere pass them explicitly (coordinator host:port, world size, rank).
+    """
+    if num_processes is not None and num_processes <= 1:
+        return
+    kwargs = {}
+    if coordinator_address is not None:
+        kwargs["coordinator_address"] = coordinator_address
+    if num_processes is not None:
+        kwargs["num_processes"] = num_processes
+    if process_id is not None:
+        kwargs["process_id"] = process_id
+    jax.distributed.initialize(**kwargs)
+
+
+def global_data_plane_mesh(wat_axis: int = 1):
+    """The full-slice mesh: ``part`` shards the key space across every chip
+    on every host (collectives ride ICI within the slice), ``wat`` shards
+    the watcher table / replicates blocks for read scaling."""
+    n = len(jax.devices())
+    assert n % wat_axis == 0, "wat axis must divide the device count"
+    return make_mesh(axes=("part", "wat"), shape=(n // wat_axis, wat_axis))
