@@ -50,15 +50,40 @@ def bytes_to_chunks(rows: np.ndarray) -> np.ndarray:
     return (be[..., 0] << 24) | (be[..., 1] << 16) | (be[..., 2] << 8) | be[..., 3]
 
 
-def chunks_to_bytes(chunks: np.ndarray, lens: np.ndarray) -> list[bytes]:
-    """Inverse of pack_keys for host-side materialization."""
+def chunks_to_u8(chunks: np.ndarray) -> np.ndarray:
+    """big-endian uint32[N, C] → uint8[N, C*4] (inverse of bytes_to_chunks)."""
     n, c = chunks.shape
     out = np.zeros((n, c * 4), dtype=np.uint8)
     out[:, 0::4] = (chunks >> 24) & 0xFF
     out[:, 1::4] = (chunks >> 16) & 0xFF
     out[:, 2::4] = (chunks >> 8) & 0xFF
     out[:, 3::4] = chunks & 0xFF
-    return [out[i, : lens[i]].tobytes() for i in range(n)]
+    return out
+
+
+def chunks_to_bytes(chunks: np.ndarray, lens: np.ndarray) -> list[bytes]:
+    """Inverse of pack_keys for host-side materialization."""
+    out = chunks_to_u8(chunks)
+    return [out[i, : lens[i]].tobytes() for i in range(len(out))]
+
+
+def gather_arena(arena: np.ndarray, offsets: np.ndarray, perm: np.ndarray):
+    """Reorder variable-length records of a byte arena by ``perm``.
+
+    Returns (new_arena uint8[∑len], new_offsets uint64[len(perm)+1]) —
+    fully vectorized (per-row source ranges expanded with repeat+arange).
+    """
+    offsets = offsets.astype(np.int64)
+    lens = (offsets[1:] - offsets[:-1])[perm]
+    new_offsets = np.zeros(len(perm) + 1, dtype=np.int64)
+    np.cumsum(lens, out=new_offsets[1:])
+    total = int(new_offsets[-1])
+    if total == 0:
+        return np.zeros(0, dtype=np.uint8), new_offsets.astype(np.uint64)
+    starts = offsets[:-1][perm]
+    idx = np.arange(total, dtype=np.int64)
+    idx += np.repeat(starts - new_offsets[:-1], lens)
+    return arena[idx], new_offsets.astype(np.uint64)
 
 
 def pack_one(key: bytes, width: int = KEY_WIDTH) -> np.ndarray:

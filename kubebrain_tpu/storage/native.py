@@ -94,6 +94,22 @@ def _load_lib() -> ctypes.CDLL:
         ]
         lib.kb_key_count.argtypes = [ctypes.c_void_p]
         lib.kb_key_count.restype = ctypes.c_uint64
+        lib.kb_mvcc_export_stats.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t,
+            ctypes.c_char_p, ctypes.c_size_t, ctypes.c_uint64,
+            ctypes.c_char_p, ctypes.c_size_t,
+            ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_uint64),
+        ]
+        lib.kb_mvcc_export_fill.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t,
+            ctypes.c_char_p, ctypes.c_size_t, ctypes.c_uint64,
+            ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p, ctypes.c_size_t,
+            ctypes.c_size_t, ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_uint8),
+            ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_uint64),
+        ]
+        lib.kb_mvcc_export_fill.restype = ctypes.c_uint64
         _lib = lib
         return lib
 
@@ -166,6 +182,52 @@ class NativeKv(KvStorage):
 
     def key_count(self) -> int:
         return int(self._lib.kb_key_count(self._store))
+
+    def export_mvcc(
+        self,
+        start: bytes,
+        end: bytes,
+        snapshot_ts: int,
+        key_width: int,
+        magic: bytes,
+        tombstone: bytes,
+    ):
+        """Bulk-export version rows as numpy arrays (the TPU-mirror rebuild
+        fast path): (keys uint8[N, W], lens int32[N], revs uint64[N],
+        tomb bool[N], value_arena bytes, offsets uint64[N+1])."""
+        import numpy as np
+
+        n_rows = ctypes.c_uint64()
+        val_bytes = ctypes.c_uint64()
+        self._lib.kb_mvcc_export_stats(
+            self._store, start, len(start), end, len(end), snapshot_ts,
+            magic, len(magic), ctypes.byref(n_rows), ctypes.byref(val_bytes),
+        )
+        n = int(n_rows.value)
+        keys = np.zeros((n, key_width), dtype=np.uint8)
+        lens = np.zeros(n, dtype=np.int32)
+        revs = np.zeros(n, dtype=np.uint64)
+        tomb = np.zeros(n, dtype=np.uint8)
+        arena = np.zeros(int(val_bytes.value), dtype=np.uint8)
+        offsets = np.zeros(n + 1, dtype=np.uint64)
+        if n:
+            got = self._lib.kb_mvcc_export_fill(
+                self._store, start, len(start), end, len(end), snapshot_ts,
+                magic, len(magic), tombstone, len(tombstone),
+                key_width, n,
+                keys.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+                lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+                revs.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+                tomb.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+                arena.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+                offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_uint64)),
+            )
+            if got == 2**64 - 1:
+                raise StorageError("export overflow (key wider than key_width?)")
+            if got < n:  # rows vanished between the two passes: trim
+                keys, lens, revs, tomb = keys[:got], lens[:got], revs[:got], tomb[:got]
+                offsets = offsets[: got + 1]
+        return keys, lens, revs, tomb.astype(bool), arena, offsets
 
     def close(self) -> None:
         if self._store:

@@ -6,8 +6,8 @@ the *scan-hot columns* (packed user key, revision, tombstone flag) are
 mirrored into device HBM as P sorted partitions, padded to a common row
 count and sharded over the mesh's ``part`` axis. Values never leave the
 host — kernels decide *which* rows are visible; the host materializes bytes
-by row index (the same division of labor as reference workers streaming
-KVs out of engine iterators, scanner.go:395-427).
+by row index from per-partition byte arenas (no per-row Python objects, so
+a million-row mirror rebuild is numpy memcpy, not object churn).
 
 Partition borders are always user-key-aligned (adjustPartitionBorders,
 scanner.go:202-225) so no version chain straddles devices and shard-local
@@ -24,6 +24,8 @@ from jax.sharding import NamedSharding, PartitionSpec
 
 from ...ops import keys as keyops
 
+TTL_PREFIX = b"/events/"
+
 
 @dataclass
 class Mirror:
@@ -36,11 +38,13 @@ class Mirror:
     n_valid_dev: jax.Array  # int32[P]
     # host copies (row-aligned with device arrays)
     keys_host: np.ndarray   # uint32[P, N, C]
+    lens_host: np.ndarray   # int32[P, N]
     revs_host: np.ndarray   # uint64[P, N]
     tomb_host: np.ndarray   # bool[P, N]
     n_valid: np.ndarray     # int32[P]
-    user_keys: list[list[bytes]]   # per partition, per row
-    values: list[list[bytes]]      # per partition, per row
+    # values: one byte arena + offsets per partition
+    val_arena: list[np.ndarray]    # uint8[...]
+    val_offsets: list[np.ndarray]  # uint64[nv+1]
     snapshot_ts: int
     max_rev: int
 
@@ -52,14 +56,172 @@ class Mirror:
     def rows(self) -> int:
         return int(self.n_valid.sum())
 
+    def user_key(self, p: int, i: int) -> bytes:
+        row = keyops.chunks_to_u8(self.keys_host[p, i : i + 1])[0]
+        return row[: int(self.lens_host[p, i])].tobytes()
+
+    def value(self, p: int, i: int) -> bytes:
+        o = self.val_offsets[p]
+        return self.val_arena[p][int(o[i]) : int(o[i + 1])].tobytes()
+
     def partition_first_keys(self) -> list[bytes]:
-        out = []
+        return [
+            self.user_key(p, 0) if self.n_valid[p] > 0 else b""
+            for p in range(self.partitions)
+        ]
+
+    def flat_arrays(self):
+        """Valid rows of every partition, concatenated in order:
+        (keys_u8[N, W], lens, revs, tomb, arena, offsets)."""
+        parts_u8, parts_lens, parts_revs, parts_tomb = [], [], [], []
+        arenas, lens_list = [], []
         for p in range(self.partitions):
-            out.append(self.user_keys[p][0] if self.n_valid[p] > 0 else b"")
-        return out
+            nv = int(self.n_valid[p])
+            parts_u8.append(keyops.chunks_to_u8(self.keys_host[p, :nv]))
+            parts_lens.append(self.lens_host[p, :nv])
+            parts_revs.append(self.revs_host[p, :nv])
+            parts_tomb.append(self.tomb_host[p, :nv])
+            arenas.append(self.val_arena[p][: int(self.val_offsets[p][nv])])
+            o = self.val_offsets[p].astype(np.int64)
+            lens_list.append(o[1 : nv + 1] - o[:nv])
+        keys_u8 = np.concatenate(parts_u8) if parts_u8 else np.zeros((0, 4), np.uint8)
+        arena = np.concatenate(arenas) if arenas else np.zeros(0, np.uint8)
+        row_lens = np.concatenate(lens_list) if lens_list else np.zeros(0, np.int64)
+        offsets = np.zeros(len(row_lens) + 1, dtype=np.uint64)
+        offsets[1:] = np.cumsum(row_lens).astype(np.uint64)
+        return (
+            keys_u8,
+            np.concatenate(parts_lens) if parts_lens else np.zeros(0, np.int32),
+            np.concatenate(parts_revs) if parts_revs else np.zeros(0, np.uint64),
+            np.concatenate(parts_tomb) if parts_tomb else np.zeros(0, bool),
+            arena,
+            offsets,
+        )
 
 
-TTL_PREFIX = b"/events/"
+def rows_to_arrays(rows: list[tuple[bytes, int, bytes]], width: int):
+    """Python (user_key, rev, value) rows → the array quintuple."""
+    n = len(rows)
+    keys_u8 = np.zeros((n, width), dtype=np.uint8)
+    lens = np.zeros(n, dtype=np.int32)
+    revs = np.zeros(n, dtype=np.uint64)
+    from ...backend.common import TOMBSTONE
+
+    tomb = np.zeros(n, dtype=bool)
+    offsets = np.zeros(n + 1, dtype=np.uint64)
+    chunks_vals = []
+    off = 0
+    for i, (k, rev, v) in enumerate(rows):
+        keys_u8[i, : len(k)] = np.frombuffer(k, dtype=np.uint8)
+        lens[i] = len(k)
+        revs[i] = rev
+        tomb[i] = v == TOMBSTONE
+        chunks_vals.append(v)
+        off += len(v)
+        offsets[i + 1] = off
+    arena = np.frombuffer(b"".join(chunks_vals), dtype=np.uint8).copy() if rows else np.zeros(0, np.uint8)
+    return keys_u8, lens, revs, tomb, arena, offsets
+
+
+def merge_sorted_arrays(a, b):
+    """Merge two row-array quintuples into one, sorted by (key, revision).
+
+    Sort key = raw key bytes + big-endian revision, compared as a void
+    scalar (memcmp) — a single numpy argsort, no Python comparisons.
+    """
+    keys_u8 = np.concatenate([a[0], b[0]])
+    lens = np.concatenate([a[1], b[1]])
+    revs = np.concatenate([a[2], b[2]])
+    tomb = np.concatenate([a[3], b[3]])
+    n, w = keys_u8.shape
+    rev_be = revs[:, None].astype(">u8").view(np.uint8).reshape(n, 8)
+    sort_rows = np.ascontiguousarray(np.concatenate([keys_u8, rev_be], axis=1))
+    void = sort_rows.view([("v", f"V{w + 8}")]).reshape(n)
+    perm = np.argsort(void, kind="stable")
+    # merge arenas, then reorder by perm
+    arena = np.concatenate([a[4], b[4]])
+    off_b = b[5].astype(np.int64) + int(a[5][-1])
+    offsets = np.concatenate([a[5].astype(np.int64)[:-1], off_b]).astype(np.uint64)
+    new_arena, new_offsets = keyops.gather_arena(arena, offsets, perm)
+    return keys_u8[perm], lens[perm], revs[perm], tomb[perm], new_arena, new_offsets
+
+
+def build_mirror_from_arrays(
+    keys_u8: np.ndarray,
+    lens: np.ndarray,
+    revs: np.ndarray,
+    tomb: np.ndarray,
+    arena: np.ndarray,
+    offsets: np.ndarray,
+    mesh,
+    key_width: int,
+    snapshot_ts: int,
+) -> Mirror:
+    """Sorted row arrays → partitioned, padded, device-resident Mirror."""
+    n_parts = int(np.prod(mesh.devices.shape)) if mesh is not None else 1
+    n = len(keys_u8)
+    if keys_u8.shape[1] != key_width:
+        padded = np.zeros((n, key_width), dtype=np.uint8)
+        padded[:, : keys_u8.shape[1]] = keys_u8[:, :key_width]
+        keys_u8 = padded
+
+    # user-key-aligned balanced split offsets (vectorized boundary detect)
+    if n:
+        same_prev = np.zeros(n, dtype=bool)
+        same_prev[1:] = (keys_u8[1:] == keys_u8[:-1]).all(axis=1)
+    splits = [0]
+    target = max(1, (n + n_parts - 1) // n_parts)
+    for p in range(1, n_parts):
+        pos = min(p * target, n)
+        while 0 < pos < n and same_prev[pos]:
+            pos += 1
+        splits.append(max(pos, splits[-1]))
+    splits.append(n)
+    counts = [splits[i + 1] - splits[i] for i in range(n_parts)]
+    n_max = max(max(counts), 8) if counts else 8
+
+    c = key_width // 4
+    keys_h = np.zeros((n_parts, n_max, c), dtype=np.uint32)
+    lens_h = np.zeros((n_parts, n_max), dtype=np.int32)
+    revs_h = np.zeros((n_parts, n_max), dtype=np.uint64)
+    tomb_h = np.zeros((n_parts, n_max), dtype=bool)
+    ttl_h = np.zeros((n_parts, n_max), dtype=bool)
+    arenas, offs = [], []
+    ttl_pref = np.frombuffer(TTL_PREFIX, dtype=np.uint8)
+
+    off64 = offsets.astype(np.int64)
+    for p in range(n_parts):
+        lo, hi = splits[p], splits[p + 1]
+        nv = hi - lo
+        if nv:
+            keys_h[p, :nv] = keyops.bytes_to_chunks(keys_u8[lo:hi])
+            lens_h[p, :nv] = lens[lo:hi]
+            revs_h[p, :nv] = revs[lo:hi]
+            tomb_h[p, :nv] = tomb[lo:hi]
+            pref = keys_u8[lo:hi, : len(ttl_pref)]
+            ttl_h[p, :nv] = (pref == ttl_pref).all(axis=1) & (lens[lo:hi] >= len(ttl_pref))
+        arenas.append(arena[off64[lo] : off64[hi]].copy())
+        offs.append((off64[lo : hi + 1] - off64[lo]).astype(np.uint64))
+
+    rh, rl = keyops.split_revs(revs_h.reshape(-1))
+    rh = rh.reshape(n_parts, n_max)
+    rl = rl.reshape(n_parts, n_max)
+    n_valid = np.array(counts, dtype=np.int32)
+
+    def put(arr):
+        if mesh is None:
+            return jax.device_put(arr)
+        spec = PartitionSpec("part", *(None,) * (arr.ndim - 1))
+        return jax.device_put(arr, NamedSharding(mesh, spec))
+
+    return Mirror(
+        keys_dev=put(keys_h), rh_dev=put(rh), rl_dev=put(rl),
+        tomb_dev=put(tomb_h), ttl_dev=put(ttl_h), n_valid_dev=put(n_valid),
+        keys_host=keys_h, lens_host=lens_h, revs_host=revs_h, tomb_host=tomb_h,
+        n_valid=n_valid, val_arena=arenas, val_offsets=offs,
+        snapshot_ts=snapshot_ts,
+        max_rev=int(revs.max()) if n else 0,
+    )
 
 
 def build_mirror(
@@ -68,75 +230,7 @@ def build_mirror(
     key_width: int,
     snapshot_ts: int,
 ) -> Mirror:
-    """Build a Mirror from sorted (user_key, revision, value) version rows.
-
-    Splits into P = mesh-size partitions balanced by row count, never
-    splitting a user key's version chain across partitions.
-    """
-    n_parts = int(np.prod(mesh.devices.shape)) if mesh is not None else 1
-    n = len(rows)
-    # choose user-key-aligned split offsets
-    offsets = [0]
-    target = max(1, (n + n_parts - 1) // n_parts)
-    for p in range(1, n_parts):
-        pos = min(p * target, n)
-        while 0 < pos < n and rows[pos][0] == rows[pos - 1][0]:
-            pos += 1  # don't split a version chain
-        pos = max(pos, offsets[-1])
-        offsets.append(pos)
-    offsets.append(n)
-    counts = [offsets[i + 1] - offsets[i] for i in range(n_parts)]
-    n_max = max(max(counts), 8)
-
-    c = key_width // 4
-    keys_h = np.zeros((n_parts, n_max, c), dtype=np.uint32)
-    revs_h = np.zeros((n_parts, n_max), dtype=np.uint64)
-    tomb_h = np.zeros((n_parts, n_max), dtype=bool)
-    ttl_h = np.zeros((n_parts, n_max), dtype=bool)
-    user_keys: list[list[bytes]] = []
-    values: list[list[bytes]] = []
-    max_rev = 0
-
-    from ...backend.common import TOMBSTONE
-
-    for p in range(n_parts):
-        part_rows = rows[offsets[p] : offsets[p + 1]]
-        uks = [r[0] for r in part_rows]
-        if part_rows:
-            packed, _ = keyops.pack_keys(uks, key_width)
-            keys_h[p, : len(part_rows)] = packed
-            revs = np.array([r[1] for r in part_rows], dtype=np.uint64)
-            revs_h[p, : len(part_rows)] = revs
-            tomb_h[p, : len(part_rows)] = [r[2] == TOMBSTONE for r in part_rows]
-            ttl_h[p, : len(part_rows)] = [uk.startswith(TTL_PREFIX) for uk in uks]
-            max_rev = max(max_rev, int(revs.max()))
-        user_keys.append(uks)
-        values.append([r[2] for r in part_rows])
-
-    rh, rl = keyops.split_revs(revs_h.reshape(-1))
-    rh = rh.reshape(n_parts, n_max)
-    rl = rl.reshape(n_parts, n_max)
-    n_valid = np.array(counts, dtype=np.int32)
-
-    def put(arr, *trailing_none):
-        if mesh is None:
-            return jax.device_put(arr)
-        spec = PartitionSpec("part", *(None,) * (arr.ndim - 1))
-        return jax.device_put(arr, NamedSharding(mesh, spec))
-
-    return Mirror(
-        keys_dev=put(keys_h),
-        rh_dev=put(rh),
-        rl_dev=put(rl),
-        tomb_dev=put(tomb_h),
-        ttl_dev=put(ttl_h),
-        n_valid_dev=put(n_valid),
-        keys_host=keys_h,
-        revs_host=revs_h,
-        tomb_host=tomb_h,
-        n_valid=n_valid,
-        user_keys=user_keys,
-        values=values,
-        snapshot_ts=snapshot_ts,
-        max_rev=max_rev,
+    """Python-row convenience path (tests / generic engines)."""
+    return build_mirror_from_arrays(
+        *rows_to_arrays(rows, key_width), mesh, key_width, snapshot_ts
     )

@@ -22,7 +22,6 @@ storage sharding through GetPartitions).
 
 from __future__ import annotations
 
-import bisect
 import threading
 from functools import partial
 
@@ -39,7 +38,13 @@ from ...ops.scan import lex_geq, lex_less, visibility_mask
 from ...parallel.mesh import make_mesh
 from .. import BatchWrite, CASFailedError, KvStorage, Partition, register_engine
 from ..errors import UncertainResultError
-from .blocks import TTL_PREFIX, Mirror, build_mirror
+from .blocks import (
+    Mirror,
+    build_mirror,
+    build_mirror_from_arrays,
+    merge_sorted_arrays,
+    rows_to_arrays,
+)
 
 
 @jax.jit
@@ -108,26 +113,35 @@ class TpuScanner(Scanner):
     def _rebuild_from_store(self) -> None:
         snapshot = self._store.get_timestamp_oracle()
         lo, hi = coder.internal_range(b"", b"")
-        rows: list[tuple[bytes, int, bytes]] = []
-        for ikey, value in self._store.iter(lo, hi, snapshot_ts=snapshot):
-            ukey, rev = coder.decode(ikey)
-            if rev != 0:
-                rows.append((ukey, rev, value))
-        self._mirror = build_mirror(rows, self._mesh, self._kw, snapshot)
+        exporter = getattr(self._store, "untracked", lambda: self._store)()
+        if hasattr(exporter, "export_mvcc"):
+            # C++ host-shim bulk export: numpy arrays straight from the
+            # engine, no per-row Python (SURVEY §2.8 fast path)
+            from ...backend.common import TOMBSTONE
+
+            arrays = exporter.export_mvcc(
+                lo, hi, snapshot, self._kw, coder.MAGIC, TOMBSTONE
+            )
+            self._mirror = build_mirror_from_arrays(
+                *arrays, self._mesh, self._kw, snapshot
+            )
+        else:
+            rows: list[tuple[bytes, int, bytes]] = []
+            for ikey, value in self._store.iter(lo, hi, snapshot_ts=snapshot):
+                ukey, rev = coder.decode(ikey)
+                if rev != 0:
+                    rows.append((ukey, rev, value))
+            self._mirror = build_mirror(rows, self._mesh, self._kw, snapshot)
         self._delta = []
         self._force_rebuild = False
 
     def _merge_delta(self) -> None:
-        m = self._mirror
-        old_rows: list[tuple[bytes, int, bytes]] = []
-        for p in range(m.partitions):
-            nv = int(m.n_valid[p])
-            old_rows.extend(
-                (m.user_keys[p][i], int(m.revs_host[p][i]), m.values[p][i])
-                for i in range(nv)
-            )
-        merged = sorted(old_rows + self._delta, key=lambda r: (r[0], r[1]))
-        self._mirror = build_mirror(merged, self._mesh, self._kw, self._store.get_timestamp_oracle())
+        merged = merge_sorted_arrays(
+            self._mirror.flat_arrays(), rows_to_arrays(self._delta, self._kw)
+        )
+        self._mirror = build_mirror_from_arrays(
+            *merged, self._mesh, self._kw, self._store.get_timestamp_oracle()
+        )
         self._delta = []
 
     def publish(self) -> None:
@@ -183,10 +197,11 @@ class TpuScanner(Scanner):
         kvs: list[KeyValue] = []
         for p in range(mirror.partitions):
             for i in np.nonzero(mask[p])[0]:
-                uk = mirror.user_keys[p][i]
+                i = int(i)
+                uk = mirror.user_key(p, i)
                 if uk in overlay:
                     continue  # delta supersedes
-                kvs.append(KeyValue(uk, mirror.values[p][i], int(mirror.revs_host[p][i])))
+                kvs.append(KeyValue(uk, mirror.value(p, i), int(mirror.revs_host[p][i])))
         for uk, entry in overlay.items():
             if entry is not None:
                 kvs.append(KeyValue(uk, entry[1], entry[0]))
@@ -213,16 +228,22 @@ class TpuScanner(Scanner):
         return total
 
     def _host_visible(self, mirror: Mirror, ukey: bytes, read_rev: int) -> bool:
-        """Host-side point visibility check against the published mirror."""
+        """Host-side point visibility check against the published mirror
+        (accessor-based binary search; rows are sorted by (key, rev))."""
         p = self._partition_of(mirror, ukey)
-        uks = mirror.user_keys[p]
         nv = int(mirror.n_valid[p])
-        lo = bisect.bisect_left(uks, ukey, 0, nv)
-        hi = bisect.bisect_right(uks, ukey, 0, nv)
+        lo, hi = 0, nv
+        while lo < hi:  # first row with key >= ukey
+            mid = (lo + hi) // 2
+            if mirror.user_key(p, mid) < ukey:
+                lo = mid + 1
+            else:
+                hi = mid
         best = None
-        for i in range(lo, hi):
-            rev = int(mirror.revs_host[p][i])
-            if rev <= read_rev:
+        for i in range(lo, nv):
+            if mirror.user_key(p, i) != ukey:
+                break
+            if int(mirror.revs_host[p][i]) <= read_rev:
                 best = i
         return best is not None and not bool(mirror.tomb_host[p][best])
 
@@ -274,47 +295,63 @@ class TpuScanner(Scanner):
         retry_min = self._retry_min_revision()
         BATCH = 256
         pending: list[bytes] = []
-        surviving: list[tuple[bytes, int, bytes]] = []
+        surviving_parts = []
         for p in range(mirror.partitions):
             nv = int(mirror.n_valid[p])
-            uks = mirror.user_keys[p]
-            i = 0
-            while i < nv:
-                j = i
-                while j < nv and uks[j] == uks[i]:
-                    j += 1
-                group_doomed = 0
-                for r in range(i, j):
-                    if mask[p][r]:
-                        rev = int(mirror.revs_host[p][r])
-                        pending.append(coder.encode_object_key(uks[r], rev))
-                        group_doomed += 1
-                        if mirror.tomb_host[p][r]:
-                            stats.deleted_tombstones += 1
-                        elif r < j - 1:
-                            stats.deleted_versions += 1
-                        else:
-                            stats.expired_ttl += 1
-                    else:
-                        surviving.append(
-                            (uks[r], int(mirror.revs_host[p][r]), mirror.values[p][r])
-                        )
-                # rev-record GC: the whole group is gone and its last row was
-                # a tombstone or TTL-expired (scanner.go:472-491)
-                if group_doomed == j - i and group_doomed > 0:
-                    last_rev = int(mirror.revs_host[p][j - 1])
-                    uncertain_inflight = retry_min and last_rev >= retry_min
-                    if not uncertain_inflight:
-                        raw = coder.encode_rev_value(last_rev, deleted=bool(mirror.tomb_host[p][j - 1]))
-                        try:
-                            store.del_current(coder.encode_revision_key(uks[i]), raw)
-                            stats.deleted_rev_records += 1
-                        except CASFailedError:
-                            # rewritten since the mirror snapshot: keep rows?
-                            # the version rows are still safely deletable
-                            # (superseded/tombstone at <= compact_revision)
-                            pass
-                i = j
+            if nv == 0:
+                continue
+            pmask = mask[p][:nv]
+            keys_p = mirror.keys_host[p, :nv]
+            # group structure (one group = one user key's version chain)
+            same_prev = np.zeros(nv, dtype=bool)
+            same_prev[1:] = (keys_p[1:] == keys_p[:-1]).all(axis=1)
+            group_starts = np.nonzero(~same_prev)[0]
+            group_ends = np.append(group_starts[1:], nv)
+            group_sizes = group_ends - group_starts
+            doomed_per_group = np.add.reduceat(pmask.astype(np.int64), group_starts)
+            last_idx = group_ends - 1
+
+            # victims: object-row deletes + stats (victim count is GC-bounded)
+            for i in np.nonzero(pmask)[0]:
+                i = int(i)
+                rev = int(mirror.revs_host[p][i])
+                pending.append(coder.encode_object_key(mirror.user_key(p, i), rev))
+                g = int(np.searchsorted(group_starts, i, side="right") - 1)
+                if bool(mirror.tomb_host[p][i]):
+                    stats.deleted_tombstones += 1
+                elif i < int(last_idx[g]):
+                    stats.deleted_versions += 1
+                else:
+                    stats.expired_ttl += 1
+
+            # rev-record GC: fully-doomed groups (scanner.go:472-491)
+            for g in np.nonzero(doomed_per_group == group_sizes)[0]:
+                g = int(g)
+                li = int(last_idx[g])
+                last_rev = int(mirror.revs_host[p][li])
+                if retry_min and last_rev >= retry_min:
+                    continue  # uncertain write in flight below this revision
+                raw = coder.encode_rev_value(
+                    last_rev, deleted=bool(mirror.tomb_host[p][li])
+                )
+                uk = mirror.user_key(p, int(group_starts[g]))
+                try:
+                    store.del_current(coder.encode_revision_key(uk), raw)
+                    stats.deleted_rev_records += 1
+                except CASFailedError:
+                    pass  # rewritten since the mirror snapshot: rows still deletable
+
+            # surviving rows as arrays (numpy gather — no Python objects)
+            keep = np.nonzero(~pmask)[0]
+            k_u8 = keyops.chunks_to_u8(keys_p)[keep]
+            arena_p, off_p = keyops.gather_arena(
+                mirror.val_arena[p], mirror.val_offsets[p][: nv + 1], keep
+            )
+            surviving_parts.append((
+                k_u8, mirror.lens_host[p, :nv][keep],
+                mirror.revs_host[p, :nv][keep], mirror.tomb_host[p, :nv][keep],
+                arena_p, off_p,
+            ))
         for b0 in range(0, len(pending), BATCH):
             batch = store.begin_batch_write()
             for k in pending[b0 : b0 + BATCH]:
@@ -324,9 +361,27 @@ class TpuScanner(Scanner):
         # shrink the mirror in place from the surviving rows + any delta
         with self._mlock:
             if self._mirror is mirror:
-                merged = sorted(surviving + self._delta, key=lambda r: (r[0], r[1]))
-                self._mirror = build_mirror(
-                    merged, self._mesh, self._kw, self._store.get_timestamp_oracle()
+                empty = rows_to_arrays([], self._kw)
+                # surviving parts are already in global sorted order:
+                # concatenate columns and rebuild the arena offsets
+                if surviving_parts:
+                    keys_u8 = np.concatenate([sp[0] for sp in surviving_parts])
+                    lens = np.concatenate([sp[1] for sp in surviving_parts])
+                    revs = np.concatenate([sp[2] for sp in surviving_parts])
+                    tombs = np.concatenate([sp[3] for sp in surviving_parts])
+                    arena = np.concatenate([sp[4] for sp in surviving_parts])
+                    row_lens = np.concatenate([
+                        sp[5].astype(np.int64)[1:] - sp[5].astype(np.int64)[:-1]
+                        for sp in surviving_parts
+                    ])
+                    offsets = np.zeros(len(row_lens) + 1, dtype=np.uint64)
+                    offsets[1:] = np.cumsum(row_lens).astype(np.uint64)
+                    surv = (keys_u8, lens, revs, tombs, arena, offsets)
+                else:
+                    surv = empty
+                merged = merge_sorted_arrays(surv, rows_to_arrays(self._delta, self._kw))
+                self._mirror = build_mirror_from_arrays(
+                    *merged, self._mesh, self._kw, self._store.get_timestamp_oracle()
                 )
                 self._delta = []
         return stats

@@ -509,4 +509,94 @@ uint64_t kb_key_count(void* s) {
   return st->data.size();
 }
 
+// ------------------------------------------------------- MVCC bulk export
+// Host-shim fast path for the TPU mirror (SURVEY §2.8): walk the MVCC
+// internal keyspace (magic + user_key + NUL + big-endian u64 revision) at a
+// snapshot and fill caller-provided numpy-ready buffers — padded user keys,
+// lengths, revisions, tombstone flags, and a value arena with offsets — so
+// mirror rebuilds never round-trip per row through Python.
+
+static bool parse_internal(const std::string& k, const uint8_t* magic,
+                           size_t magic_len, size_t* key_len, uint64_t* rev) {
+  if (k.size() < magic_len + 1 + 8 + 1) return false;
+  if (memcmp(k.data(), magic, magic_len) != 0) return false;
+  if (static_cast<uint8_t>(k[k.size() - 9]) != 0) return false;
+  *key_len = k.size() - magic_len - 9;
+  uint64_t r = 0;
+  for (int i = 0; i < 8; ++i) {
+    r = (r << 8) | static_cast<uint8_t>(k[k.size() - 8 + i]);
+  }
+  *rev = r;
+  return true;
+}
+
+// Pass 1: count version rows and total value bytes in [start, end) at snap.
+void kb_mvcc_export_stats(void* s, const uint8_t* start, size_t slen,
+                          const uint8_t* end, size_t elen, uint64_t snap,
+                          const uint8_t* magic, size_t magic_len,
+                          uint64_t* n_rows, uint64_t* val_bytes) {
+  Store* st = static_cast<Store*>(s);
+  std::shared_lock<std::shared_mutex> lock(st->mu);
+  uint64_t at = snap ? snap : st->ts;
+  double now = wallclock();
+  std::string lo(reinterpret_cast<const char*>(start), slen);
+  std::string hi(reinterpret_cast<const char*>(end), elen);
+  *n_rows = 0;
+  *val_bytes = 0;
+  auto b = st->data.lower_bound(lo);
+  auto e = hi.empty() ? st->data.end() : st->data.lower_bound(hi);
+  for (auto cur = b; cur != e; ++cur) {
+    size_t klen;
+    uint64_t rev;
+    if (!parse_internal(cur->first, magic, magic_len, &klen, &rev)) continue;
+    if (rev == 0) continue;
+    const std::string* v = st->live(cur->first, at, now);
+    if (v == nullptr) continue;
+    ++*n_rows;
+    *val_bytes += v->size();
+  }
+}
+
+// Pass 2: fill buffers sized from pass 1. keys_buf is n_rows * key_width
+// zero-initialized by the caller; keys longer than key_width are rejected
+// (returns the number of rows written, or UINT64_MAX on overflow).
+uint64_t kb_mvcc_export_fill(void* s, const uint8_t* start, size_t slen,
+                             const uint8_t* end, size_t elen, uint64_t snap,
+                             const uint8_t* magic, size_t magic_len,
+                             const uint8_t* tombstone, size_t tomb_len,
+                             size_t key_width, uint64_t max_rows,
+                             uint8_t* keys_buf, int32_t* lens_buf,
+                             uint64_t* revs_buf, uint8_t* tomb_buf,
+                             uint8_t* val_arena, uint64_t* val_offsets) {
+  Store* st = static_cast<Store*>(s);
+  std::shared_lock<std::shared_mutex> lock(st->mu);
+  uint64_t at = snap ? snap : st->ts;
+  double now = wallclock();
+  std::string lo(reinterpret_cast<const char*>(start), slen);
+  std::string hi(reinterpret_cast<const char*>(end), elen);
+  std::string tomb(reinterpret_cast<const char*>(tombstone), tomb_len);
+  uint64_t row = 0, off = 0;
+  val_offsets[0] = 0;
+  auto b = st->data.lower_bound(lo);
+  auto e = hi.empty() ? st->data.end() : st->data.lower_bound(hi);
+  for (auto cur = b; cur != e; ++cur) {
+    size_t klen;
+    uint64_t rev;
+    if (!parse_internal(cur->first, magic, magic_len, &klen, &rev)) continue;
+    if (rev == 0) continue;
+    const std::string* v = st->live(cur->first, at, now);
+    if (v == nullptr) continue;
+    if (row >= max_rows || klen > key_width) return UINT64_MAX;
+    memcpy(keys_buf + row * key_width, cur->first.data() + magic_len, klen);
+    lens_buf[row] = static_cast<int32_t>(klen);
+    revs_buf[row] = rev;
+    tomb_buf[row] = (*v == tomb) ? 1 : 0;
+    memcpy(val_arena + off, v->data(), v->size());
+    off += v->size();
+    val_offsets[row + 1] = off;
+    ++row;
+  }
+  return row;
+}
+
 }  // extern "C"
