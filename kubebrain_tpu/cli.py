@@ -69,9 +69,31 @@ def apply_jax_platform(platform: str) -> None:
     jax.config.update("jax_platforms", platform)
 
 
+def validate_args(args) -> None:
+    """Flag validation (reference KubeBrainOption.Validate, option.go:207)."""
+    ports = [args.client_port, args.peer_port, args.info_port]
+    if len(set(ports)) != len(ports):
+        raise SystemExit(f"client/peer/info ports must be distinct, got {ports}")
+    for p in ports:
+        if not 0 < p < 65536:
+            raise SystemExit(f"invalid port {p}")
+    if bool(args.cert_file) != bool(args.key_file):
+        raise SystemExit("--cert-file and --key-file must be set together")
+    for f in (args.cert_file, args.key_file, args.ca_file):
+        if f and not os.path.exists(f):
+            raise SystemExit(f"TLS file not found: {f}")
+    if args.storage == "tpu" and args.inner_storage == "tpu":
+        raise SystemExit("--inner-storage cannot be tpu")
+    if args.data_dir and not (
+        args.storage == "native" or (args.storage == "tpu" and args.inner_storage == "native")
+    ):
+        raise SystemExit("--data-dir requires --storage=native (or tpu over native)")
+
+
 def build_endpoint(args):
     """Dependency wiring (reference KubeBrainOption.Run, option.go:230-259):
     storage → [metrics decorator] → backend → server → endpoint."""
+    validate_args(args)
     from .backend import Backend, BackendConfig
     from .endpoint import Endpoint, EndpointConfig
     from .metrics import new_metrics

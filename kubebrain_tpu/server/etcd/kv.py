@@ -119,7 +119,14 @@ class KVService:
         resp = rpc_pb2.RangeResponse(
             header=shim.header(res.revision), more=res.more, count=len(res.kvs)
         )
-        for kv in res.kvs:
+        kvs = res.kvs
+        # results are produced key-ascending; honor the sort options clients
+        # like etcdctl send (kube-apiserver always uses the default)
+        if request.sort_target == rpc_pb2.RangeRequest.MOD:
+            kvs = sorted(kvs, key=lambda kv: kv.revision)
+        if request.sort_order == rpc_pb2.RangeRequest.DESCEND:
+            kvs = list(reversed(kvs))
+        for kv in kvs:
             if request.keys_only:
                 kv = type(kv)(kv.key, b"", kv.revision)
             resp.kvs.append(shim.to_kv(kv))

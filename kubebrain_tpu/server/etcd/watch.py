@@ -135,19 +135,40 @@ class _WatchSession:
         no_delete = rpc_pb2.WatchCreateRequest.NODELETE in creq.filters
         pump = threading.Thread(
             target=self._pump,
-            args=(watch_id, wid, q, stop, bool(creq.prev_kv), no_put, no_delete),
+            args=(watch_id, wid, q, stop, bool(creq.prev_kv), no_put, no_delete,
+                  bool(creq.progress_notify)),
             daemon=True,
         )
         pump.start()
 
     # ----------------------------------------------------------------- pumps
-    def _pump(self, watch_id, wid, q, stop, want_prev, no_put, no_delete) -> None:
+    PROGRESS_INTERVAL = 60.0  # etcd sends ~10min; apiserver only needs "periodic"
+
+    def _pump(self, watch_id, wid, q, stop, want_prev, no_put, no_delete,
+              progress_notify=False) -> None:
+        import time as _time
+
         from ...proto import kv_pb2
 
+        last_sent = _time.monotonic()
         while not stop.is_set():
             try:
                 batch = q.get(timeout=0.5)
             except queue.Empty:
+                if (
+                    progress_notify
+                    and _time.monotonic() - last_sent >= self.PROGRESS_INTERVAL
+                ):
+                    # watch bookmark: bare header so the client can advance
+                    # its resourceVersion without events (apiserver
+                    # watchcache progress notify)
+                    last_sent = _time.monotonic()
+                    self._send(
+                        rpc_pb2.WatchResponse(
+                            header=shim.header(self.backend.current_revision()),
+                            watch_id=watch_id,
+                        )
+                    )
                 continue
             if batch is None:
                 # hub dropped us (slow consumer) or backend closed: cancel so
@@ -173,6 +194,7 @@ class _WatchSession:
                     continue
                 resp.events.append(pe)
             if resp.events:
+                last_sent = _time.monotonic()
                 self._send(resp)
 
     def _range_stream(self, creq, watch_id: int) -> None:
