@@ -84,6 +84,8 @@ class Backend:
         )
         # engines with their own scan offload (tpu) supply the scanner
         self.scanner = store.make_scanner(**scanner_kw) or Scanner(store, **scanner_kw)
+        # single-FFI-call write fast path when the engine provides it
+        self._mvcc_write = getattr(store, "mvcc_write", None)
         # compact watermark cache: -1 unknown; refreshed at most once per
         # COMPACT_CACHE_TTL so hot reads don't pay an engine round-trip
         # (local compactions update it synchronously; the TTL bounds follower
@@ -97,6 +99,7 @@ class Backend:
         self._ring: list[WatchEvent | None] = [None] * self._ring_cap
         self._ring_cond = threading.Condition()
         self._next_rev = 1  # next revision the sequencer expects
+        self._draining = False  # exactly one drainer sequences at a time
         self._closed = False
 
         # resume the revision sequence on restart over an existing store
@@ -122,13 +125,43 @@ class Backend:
             return 0
 
     # =================================================================== writes
+    def _commit_write(
+        self,
+        user_key: bytes,
+        revision: int,
+        new_record: bytes,
+        expected_record: bytes | None,
+        obj_value: bytes,
+        ttl: int,
+    ) -> None:
+        """Record + object row + watermark as one atomic engine write.
+        expected_record None ⇒ put-if-not-exist on the revision record.
+        Uses the engine's single-call fast path when available."""
+        rev_key = coder.encode_revision_key(user_key)
+        obj_key = coder.encode_object_key(user_key, revision)
+        last_val = coder.encode_rev_value(revision)
+        if self._mvcc_write is not None:
+            self._mvcc_write(
+                rev_key, new_record, expected_record, obj_key, obj_value,
+                LAST_REV_KEY, last_val, ttl,
+            )
+            return
+        batch = self.store.begin_batch_write()
+        if expected_record is None:
+            batch.put_if_not_exist(rev_key, new_record, ttl)
+        else:
+            batch.cas(rev_key, new_record, expected_record, ttl)
+        batch.put(obj_key, obj_value, ttl)
+        batch.put(LAST_REV_KEY, last_val)
+        batch.commit()
+
     def create(self, user_key: bytes, value: bytes) -> int:
         """Insert; returns the new revision. KeyExistsError carries the live
         revision on conflict. Reference txn.go:33 + creator/naive.go:53."""
         rev = self.tso.deal()
         event = WatchEvent(revision=rev, verb=Verb.CREATE, key=user_key, value=value, valid=False)
         try:
-            creator.create(self.store, user_key, value, rev)
+            creator.create(self._commit_write, user_key, value, rev)
             event.valid = True
             return rev
         except UncertainResultError as e:
@@ -149,18 +182,13 @@ class Backend:
             prev_revision=expected_revision, valid=False,
         )
         ttl = creator.ttl_for_key(user_key)
-        rev_key = coder.encode_revision_key(user_key)
         try:
-            batch = self.store.begin_batch_write()
-            batch.cas(
-                rev_key,
+            self._commit_write(
+                user_key, rev,
                 coder.encode_rev_value(rev),
                 coder.encode_rev_value(expected_revision),
-                ttl,
+                value, ttl,
             )
-            batch.put(coder.encode_object_key(user_key, rev), value, ttl)
-            batch.put(LAST_REV_KEY, coder.encode_rev_value(rev))
-            batch.commit()
             event.valid = True
             return rev
         except CASFailedError as e:
@@ -205,15 +233,12 @@ class Backend:
                 # notify-protected region so the dealt revision is still
                 # sequenced and the pipeline never stalls
                 raise FutureRevisionError(rev, latest_rev)
-            batch = self.store.begin_batch_write()
-            batch.cas(
-                coder.encode_revision_key(user_key),
+            self._commit_write(
+                user_key, rev,
                 coder.encode_rev_value(rev, deleted=True),
                 coder.encode_rev_value(latest_rev),
+                TOMBSTONE, 0,
             )
-            batch.put(coder.encode_object_key(user_key, rev), TOMBSTONE)
-            batch.put(LAST_REV_KEY, coder.encode_rev_value(rev))
-            batch.commit()
             event.valid = True
             return rev, KeyValue(user_key, prev_value or b"", latest_rev)
         except CASFailedError as e:
@@ -244,6 +269,11 @@ class Backend:
         with self._ring_cond:
             if revision + 1 > self._next_rev:
                 self._next_rev = revision + 1
+                # drop events below the new term's floor — they would never
+                # be drained and would poison the wrap check
+                for i, ev in enumerate(self._ring):
+                    if ev is not None and ev.revision < self._next_rev:
+                        self._ring[i] = None
             self._ring_cond.notify_all()
 
     def get(self, user_key: bytes, revision: int = 0) -> KeyValue:
@@ -406,46 +436,74 @@ class Backend:
 
     # ========================================================== event pipeline
     def _notify(self, event: WatchEvent) -> None:
-        """Post one event into the revision-indexed ring (txn.go:267-293).
-        Raises if the ring wraps — the invariant crash the reference keeps
-        (panic "watch push buffer full", txn.go:287-290)."""
+        """Post one event into the revision-indexed ring (txn.go:267-293) and
+        opportunistically sequence it inline. Raises if the ring wraps — the
+        invariant crash the reference keeps (panic "watch push buffer full",
+        txn.go:287-290)."""
         idx = event.revision % self._ring_cap
         with self._ring_cond:
             if self._ring[idx] is not None:
                 raise RuntimeError("event ring wrapped: sequencer too far behind")
             self._ring[idx] = event
             self._ring_cond.notify_all()
+        # inline drain: in the common (uncontended) case the writer sequences
+        # its own event synchronously, skipping a cross-thread wakeup —
+        # functionally the reference's always-hot spin sequencer
+        # (backend.go:212-224) without burning a core
+        self._drain()
 
-    def _collect_events(self) -> None:
-        """THE single sequencer (reference collectStorageWriteEvents,
-        backend.go:208-270): consume ring slots strictly in revision order."""
-        batch: list[WatchEvent] = []
+    def _drain(self) -> None:
+        """Consume contiguous ready revisions in order. Exactly one drainer
+        runs at a time (ordering through cache + hub must match revision
+        order); others return immediately — their events are picked up by
+        the active drainer's re-check loop."""
         while True:
             with self._ring_cond:
-                idx = self._next_rev % self._ring_cap
-                while self._ring[idx] is None and not self._closed:
-                    if batch:
-                        break  # drain pending batch while the ring is quiet
-                    self._ring_cond.wait(timeout=0.5)
-                    idx = self._next_rev % self._ring_cap
-                if self._closed:
+                if self._draining or self._closed:
                     return
-                event = self._ring[idx]
-                if event is not None:
+                ready: list[WatchEvent] = []
+                while True:
+                    idx = self._next_rev % self._ring_cap
+                    ev = self._ring[idx]
+                    if ev is None or ev.revision != self._next_rev:
+                        break
                     self._ring[idx] = None
                     self._next_rev += 1
-            if event is None:
+                    ready.append(ev)
+                if not ready:
+                    return
+                self._draining = True
+            try:
+                batch: list[WatchEvent] = []
+                for event in ready:
+                    self.tso.commit(event.revision)
+                    if event.err is not None and isinstance(event.err, UncertainResultError):
+                        self.retry.append(event)
+                    elif event.valid:
+                        batch.append(event)
+                    if len(batch) >= EVENT_BATCH:
+                        self._flush(batch)
+                        batch = []
                 self._flush(batch)
-                batch = []
-                continue
-            self.tso.commit(event.revision)
-            if event.err is not None and isinstance(event.err, UncertainResultError):
-                self.retry.append(event)
-            elif event.valid:
-                batch.append(event)
-            if len(batch) >= EVENT_BATCH:
-                self._flush(batch)
-                batch = []
+            finally:
+                with self._ring_cond:
+                    self._draining = False
+            # loop: events may have landed while we processed
+
+    def _collect_events(self) -> None:
+        """Background drainer (reference collectStorageWriteEvents,
+        backend.go:208-270): picks up whatever writers didn't sequence
+        inline (e.g. events posted while another drainer was mid-flush)."""
+        while True:
+            with self._ring_cond:
+                if self._closed:
+                    return
+                idx = self._next_rev % self._ring_cap
+                if self._ring[idx] is None:
+                    self._ring_cond.wait(timeout=0.2)
+            if self._closed:
+                return
+            self._drain()
 
     def _flush(self, batch: list[WatchEvent]) -> None:
         if not batch:
@@ -482,18 +540,13 @@ class Backend:
             prev_revision=old_rev, valid=False,
         )
         try:
-            batch = self.store.begin_batch_write()
-            batch.cas(
-                coder.encode_revision_key(event.key),
+            self._commit_write(
+                event.key, rev,
                 coder.encode_rev_value(rev, deleted=deleted),
                 coder.encode_rev_value(old_rev, deleted=deleted),
+                TOMBSTONE if deleted else event.value,
                 creator.ttl_for_key(event.key),
             )
-            value = TOMBSTONE if deleted else event.value
-            batch.put(coder.encode_object_key(event.key, rev), value,
-                      creator.ttl_for_key(event.key))
-            batch.put(LAST_REV_KEY, coder.encode_rev_value(rev))
-            batch.commit()
             new_event.valid = True
         except CASFailedError:
             pass  # superseded meanwhile: nothing to repair

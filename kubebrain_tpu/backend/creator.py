@@ -1,6 +1,6 @@
 """Create (insert) path.
 
-Reference: pkg/backend/creator/naive.go:53-98. A create is the atomic batch
+Reference: pkg/backend/creator/naive.go:53-98. A create is the atomic write
 
     PutIfNotExist(revision_key, rev_value(new_rev)) + Put(object_key, value)
 
@@ -14,13 +14,16 @@ On CAS conflict the engine hands back the observed revision record
 
 A live record means the key exists: surface ``KeyExistsError`` with the
 existing revision so the etcd shim can return txn-failed + current kv.
+
+``commit_write(user_key, revision, new_record, expected_record, obj_value,
+ttl)`` is the backend's atomic record+object+watermark writer
+(Backend._commit_write) — batch-based or the engine's single-call fast path.
 """
 
 from __future__ import annotations
 
 from .. import coder
-from ..storage import CASFailedError, KvStorage
-from .common import LAST_REV_KEY
+from ..storage import CASFailedError
 from .errors import KeyExistsError
 
 EVENTS_TTL_PREFIX = b"/events/"
@@ -32,19 +35,14 @@ def ttl_for_key(user_key: bytes) -> int:
     return EVENTS_TTL_SECONDS if user_key.startswith(EVENTS_TTL_PREFIX) else 0
 
 
-def create(store: KvStorage, user_key: bytes, value: bytes, revision: int) -> None:
+def create(commit_write, user_key: bytes, value: bytes, revision: int) -> None:
     """Insert ``user_key``=``value`` at ``revision``; raises KeyExistsError
     (with the live revision) or propagates engine errors (incl. uncertain)."""
     ttl = ttl_for_key(user_key)
-    rev_key = coder.encode_revision_key(user_key)
-    obj_key = coder.encode_object_key(user_key, revision)
+    new_record = coder.encode_rev_value(revision)
     for _attempt in range(2):
-        batch = store.begin_batch_write()
-        batch.put_if_not_exist(rev_key, coder.encode_rev_value(revision), ttl)
-        batch.put(obj_key, value, ttl)
-        batch.put(LAST_REV_KEY, coder.encode_rev_value(revision))
         try:
-            batch.commit()
+            commit_write(user_key, revision, new_record, None, value, ttl)
             return
         except CASFailedError as e:
             observed = e.conflict.value if e.conflict else None
@@ -57,11 +55,7 @@ def create(store: KvStorage, user_key: bytes, value: bytes, revision: int) -> No
                 raise KeyExistsError(user_key, 0) from e
             if deleted and old_rev < revision:
                 # deleted key: create becomes an update over the tombstone
-                batch2 = store.begin_batch_write()
-                batch2.cas(rev_key, coder.encode_rev_value(revision), observed, ttl)
-                batch2.put(obj_key, value, ttl)
-                batch2.put(LAST_REV_KEY, coder.encode_rev_value(revision))
-                batch2.commit()  # CAS race here surfaces to caller
+                commit_write(user_key, revision, new_record, observed, value, ttl)
                 return
             raise KeyExistsError(user_key, old_rev) from e
     raise KeyExistsError(user_key, 0)

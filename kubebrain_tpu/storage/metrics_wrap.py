@@ -15,6 +15,12 @@ class MetricsKvStorage(KvStorage):
     def __init__(self, inner: KvStorage, metrics: Metrics):
         self._inner = inner
         self._m = metrics
+        if hasattr(inner, "mvcc_write"):
+            self.mvcc_write = self._mvcc_write_timed
+
+    def _mvcc_write_timed(self, *args, **kwargs):
+        with self._m.timed("storage.mvcc_write"):
+            return self._inner.mvcc_write(*args, **kwargs)
 
     def get_timestamp_oracle(self) -> int:
         return self._inner.get_timestamp_oracle()

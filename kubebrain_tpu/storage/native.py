@@ -110,6 +110,19 @@ def _load_lib() -> ctypes.CDLL:
             ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_uint64),
         ]
         lib.kb_mvcc_export_fill.restype = ctypes.c_uint64
+        lib.kb_mvcc_write.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_char_p, ctypes.c_size_t,  # rev_key
+            ctypes.c_char_p, ctypes.c_size_t,  # rev_val
+            ctypes.c_char_p, ctypes.c_size_t, ctypes.c_int,  # expected
+            ctypes.c_char_p, ctypes.c_size_t,  # obj_key
+            ctypes.c_char_p, ctypes.c_size_t,  # obj_val
+            ctypes.c_char_p, ctypes.c_size_t,  # last_key
+            ctypes.c_char_p, ctypes.c_size_t,  # last_val
+            ctypes.c_int64,
+            ctypes.POINTER(u8p), ctypes.POINTER(ctypes.c_size_t),
+            ctypes.POINTER(ctypes.c_int),
+        ]
         _lib = lib
         return lib
 
@@ -182,6 +195,41 @@ class NativeKv(KvStorage):
 
     def key_count(self) -> int:
         return int(self._lib.kb_key_count(self._store))
+
+    def mvcc_write(
+        self,
+        rev_key: bytes,
+        rev_val: bytes,
+        expected: bytes | None,
+        obj_key: bytes,
+        obj_val: bytes,
+        last_key: bytes,
+        last_val: bytes,
+        ttl_seconds: int = 0,
+    ) -> None:
+        """One-FFI-call MVCC write: conditional revision record + object row
+        + last-revision watermark, atomic. Raises CASFailedError with the
+        observed record on conflict."""
+        cv = ctypes.POINTER(ctypes.c_uint8)()
+        cl = ctypes.c_size_t()
+        ch = ctypes.c_int(0)
+        rc = self._lib.kb_mvcc_write(
+            self._store,
+            rev_key, len(rev_key), rev_val, len(rev_val),
+            expected or b"", len(expected or b""), 1 if expected is not None else 0,
+            obj_key, len(obj_key), obj_val, len(obj_val),
+            last_key, len(last_key), last_val, len(last_val),
+            ttl_seconds,
+            ctypes.byref(cv), ctypes.byref(cl), ctypes.byref(ch),
+        )
+        if rc == 2:
+            raise StorageError("WAL append failed; commit aborted")
+        if rc == 1:
+            observed = None
+            if ch.value:
+                observed = ctypes.string_at(cv, cl.value)
+                self._lib.kb_free(cv)
+            raise CASFailedError(Conflict(0, rev_key, observed))
 
     def export_mvcc(
         self,

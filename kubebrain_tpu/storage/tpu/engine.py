@@ -400,6 +400,10 @@ class TpuKvStorage(KvStorage):
         self._kw = key_width
         self._scanner_kw = scanner_kw
         self._scanner: TpuScanner | None = None
+        # expose the single-call write fast path only when the host engine
+        # has it (instance attribute so hasattr() reflects capability)
+        if hasattr(inner, "mvcc_write"):
+            self.mvcc_write = self._mvcc_write_tracked
 
     # ---- scanner wiring (Backend calls make_scanner, storage/__init__.py)
     def make_scanner(self, **kw) -> TpuScanner:
@@ -449,6 +453,16 @@ class TpuKvStorage(KvStorage):
 
     def close(self) -> None:
         self._inner.close()
+
+    def _mvcc_write_tracked(self, rev_key, rev_val, expected, obj_key, obj_val,
+                            last_key, last_val, ttl_seconds=0):
+        self._inner.mvcc_write(
+            rev_key, rev_val, expected, obj_key, obj_val, last_key, last_val, ttl_seconds
+        )
+        if coder.is_internal_key(obj_key):
+            ukey, rev = coder.decode(obj_key)
+            if rev != 0:
+                self._on_committed([(ukey, rev, obj_val)])
 
     def _on_committed(self, rows: list[tuple[bytes, int, bytes]]) -> None:
         if self._scanner is not None and rows:

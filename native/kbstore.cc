@@ -509,6 +509,91 @@ uint64_t kb_key_count(void* s) {
   return st->data.size();
 }
 
+// ------------------------------------------------------------- MVCC write
+// The hot write path as ONE native call (conditional revision-record write +
+// object row + last-revision watermark, atomically): the Python MVCC layer
+// otherwise pays five FFI crossings per write. Returns 0 ok; 1 conflict
+// (conflict_val filled when the record exists); 2 WAL append failure.
+int kb_mvcc_write(void* s,
+                  const uint8_t* rev_key, size_t rkl,
+                  const uint8_t* rev_val, size_t rvl,
+                  const uint8_t* expected, size_t el, int has_expected,
+                  const uint8_t* obj_key, size_t okl,
+                  const uint8_t* obj_val, size_t ovl,
+                  const uint8_t* last_key, size_t lkl,
+                  const uint8_t* last_val, size_t lvl,
+                  int64_t ttl,
+                  uint8_t** conflict_val, size_t* conflict_len,
+                  int* conflict_has) {
+  Store* st = static_cast<Store*>(s);
+  double now = wallclock();
+  std::string rk(reinterpret_cast<const char*>(rev_key), rkl);
+  std::unique_lock<std::shared_mutex> lock(st->mu);
+  const std::string* cur = st->live(rk, st->ts, now);
+  bool ok;
+  if (has_expected) {
+    std::string exp(reinterpret_cast<const char*>(expected), el);
+    ok = (cur != nullptr && *cur == exp);
+  } else {
+    ok = (cur == nullptr);
+  }
+  if (!ok) {
+    if (cur != nullptr) {
+      *conflict_val = static_cast<uint8_t*>(malloc(cur->size()));
+      memcpy(*conflict_val, cur->data(), cur->size());
+      *conflict_len = cur->size();
+      *conflict_has = 1;
+    } else {
+      *conflict_has = 0;
+    }
+    return 1;
+  }
+  uint64_t ts = ++st->ts;
+  double expire = ttl ? now + static_cast<double>(ttl) : 0;
+  std::vector<AppliedOp> applied(3);
+  applied[0].kind = 0;
+  applied[0].key = rk;
+  applied[0].value.assign(reinterpret_cast<const char*>(rev_val), rvl);
+  applied[0].expire_at = expire;
+  applied[1].kind = 0;
+  applied[1].key.assign(reinterpret_cast<const char*>(obj_key), okl);
+  applied[1].value.assign(reinterpret_cast<const char*>(obj_val), ovl);
+  applied[1].expire_at = expire;
+  applied[2].kind = 0;
+  applied[2].key.assign(reinterpret_cast<const char*>(last_key), lkl);
+  applied[2].value.assign(reinterpret_cast<const char*>(last_val), lvl);
+  applied[2].expire_at = 0;
+  if (st->wal != nullptr) {
+    long rec_start = ftell(st->wal);
+    bool logged = write_record(st->wal, ts, applied);
+    if (logged) logged = fflush(st->wal) == 0;
+    if (logged && st->fsync_commits) {
+#ifdef __unix__
+      logged = fsync(fileno(st->wal)) == 0;
+#endif
+    }
+    if (!logged) {
+      fflush(st->wal);
+#ifdef __unix__
+      if (rec_start >= 0 && ftruncate(fileno(st->wal), rec_start) == 0) {
+        fseek(st->wal, rec_start, SEEK_SET);
+      }
+#endif
+      --st->ts;
+      return 2;
+    }
+  }
+  for (AppliedOp& a : applied) {
+    Version v;
+    v.ts = ts;
+    v.deleted = false;
+    v.expire_at = a.expire_at;
+    v.value = std::move(a.value);
+    st->data[a.key].push_back(std::move(v));
+  }
+  return 0;
+}
+
 // ------------------------------------------------------- MVCC bulk export
 // Host-shim fast path for the TPU mirror (SURVEY §2.8): walk the MVCC
 // internal keyspace (magic + user_key + NUL + big-endian u64 revision) at a
