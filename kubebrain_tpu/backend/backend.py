@@ -20,6 +20,7 @@ Threading model (mirrors the reference's goroutines, backend.go:178-183):
 
 from __future__ import annotations
 
+import sys
 import threading
 import time
 from dataclasses import dataclass, field
@@ -28,6 +29,7 @@ from typing import Iterator
 from .. import coder
 from ..storage import CASFailedError, KvStorage, Partition, UncertainResultError
 from ..storage.errors import KeyNotFoundError
+from ..util.env import txn_log
 from . import creator
 from .common import (
     COMPACT_KEY,
@@ -168,6 +170,7 @@ class Backend:
             event.err = e
             raise
         finally:
+            txn_log("create", user_key, rev, event.err or sys.exc_info()[1])
             self._notify(event)
             self.tso.wait_committed(rev, timeout=5.0)
 
@@ -183,6 +186,10 @@ class Backend:
         )
         ttl = creator.ttl_for_key(user_key)
         try:
+            if rev <= expected_revision:
+                # drift-back anomaly (reference txn.go:171-175): the dealt
+                # revision must exceed the record it supersedes
+                raise FutureRevisionError(rev, expected_revision)
             self._commit_write(
                 user_key, rev,
                 coder.encode_rev_value(rev),
@@ -206,6 +213,7 @@ class Backend:
             event.err = e
             raise
         finally:
+            txn_log("update", user_key, rev, event.err or sys.exc_info()[1])
             self._notify(event)
             self.tso.wait_committed(rev, timeout=5.0)
 
@@ -255,6 +263,7 @@ class Backend:
             event.err = e
             raise
         finally:
+            txn_log("delete", user_key, rev, event.err or sys.exc_info()[1])
             self._notify(event)
             self.tso.wait_committed(rev, timeout=5.0)
 
