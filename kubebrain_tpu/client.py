@@ -1,0 +1,331 @@
+"""Client library — the kubebrain-client module role (SURVEY §2.7).
+
+Two surfaces, matching the server:
+
+- ``EtcdCompatClient`` speaks the etcd3 subset (what kube-apiserver uses) and
+  adds the custom-apiserver extensions the reference supports: partition
+  borders via the magic revision (kv.go:33) and **partition-parallel
+  listing** over the list-over-watch stream protocol (negative start
+  revision, watch.go:150-152,204) — each partition streams concurrently,
+  the client merges in key order (SURVEY §5c);
+- ``BrainClient`` speaks the lean native protocol (Create/Update/Delete/
+  Compact/Get/Range/RangeStream/Count/ListPartition/Watch).
+
+No generated stubs: raw grpc channels + the protos in kubebrain_tpu.proto.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from dataclasses import dataclass
+from typing import Iterator
+
+import grpc
+
+from .proto import brain_pb2, kv_pb2, rpc_pb2
+
+PARTITION_MAGIC_REVISION = 1888
+
+
+@dataclass
+class ClientKV:
+    key: bytes
+    value: bytes
+    mod_revision: int
+
+
+class EtcdCompatClient:
+    def __init__(self, target: str, credentials: grpc.ChannelCredentials | None = None):
+        self.channel = (
+            grpc.secure_channel(target, credentials)
+            if credentials
+            else grpc.insecure_channel(target)
+        )
+        p = rpc_pb2
+        self._range = self._unary("/etcdserverpb.KV/Range", p.RangeRequest, p.RangeResponse)
+        self._txn = self._unary("/etcdserverpb.KV/Txn", p.TxnRequest, p.TxnResponse)
+        self._compact = self._unary("/etcdserverpb.KV/Compact", p.CompactionRequest, p.CompactionResponse)
+        self._watch = self.channel.stream_stream(
+            "/etcdserverpb.Watch/Watch",
+            request_serializer=p.WatchRequest.SerializeToString,
+            response_deserializer=p.WatchResponse.FromString,
+        )
+
+    def _unary(self, method, req, resp):
+        return self.channel.unary_unary(
+            method,
+            request_serializer=req.SerializeToString,
+            response_deserializer=resp.FromString,
+        )
+
+    # --------------------------------------------------------------- writes
+    def create(self, key: bytes, value: bytes) -> tuple[bool, int]:
+        """(succeeded, revision) — revision is the new mod revision on
+        success, the existing one on conflict."""
+        req = rpc_pb2.TxnRequest()
+        c = req.compare.add()
+        c.result, c.target, c.key, c.mod_revision = (
+            rpc_pb2.Compare.EQUAL, rpc_pb2.Compare.MOD, key, 0,
+        )
+        req.success.add().request_put.CopyFrom(rpc_pb2.PutRequest(key=key, value=value))
+        req.failure.add().request_range.CopyFrom(rpc_pb2.RangeRequest(key=key))
+        r = self._txn(req)
+        if r.succeeded:
+            return True, r.responses[0].response_put.header.revision
+        kvs = r.responses[0].response_range.kvs
+        return False, kvs[0].mod_revision if kvs else 0
+
+    def update(self, key: bytes, value: bytes, mod_revision: int) -> tuple[bool, int]:
+        req = rpc_pb2.TxnRequest()
+        c = req.compare.add()
+        c.result, c.target, c.key, c.mod_revision = (
+            rpc_pb2.Compare.EQUAL, rpc_pb2.Compare.MOD, key, mod_revision,
+        )
+        req.success.add().request_put.CopyFrom(rpc_pb2.PutRequest(key=key, value=value))
+        req.failure.add().request_range.CopyFrom(rpc_pb2.RangeRequest(key=key))
+        r = self._txn(req)
+        if r.succeeded:
+            return True, r.responses[0].response_put.header.revision
+        kvs = r.responses[0].response_range.kvs
+        return False, kvs[0].mod_revision if kvs else 0
+
+    def delete(self, key: bytes, mod_revision: int = 0) -> bool:
+        req = rpc_pb2.TxnRequest()
+        c = req.compare.add()
+        c.result, c.target, c.key, c.mod_revision = (
+            rpc_pb2.Compare.EQUAL, rpc_pb2.Compare.MOD, key, mod_revision,
+        )
+        if mod_revision == 0:
+            got = self.get(key)
+            if got is None:
+                return False
+            c.mod_revision = got.mod_revision
+        req.success.add().request_delete_range.CopyFrom(rpc_pb2.DeleteRangeRequest(key=key))
+        req.failure.add().request_range.CopyFrom(rpc_pb2.RangeRequest(key=key))
+        return self._txn(req).succeeded
+
+    def compact(self, revision: int) -> None:
+        self._compact(rpc_pb2.CompactionRequest(revision=revision))
+
+    # ---------------------------------------------------------------- reads
+    def get(self, key: bytes, revision: int = 0) -> ClientKV | None:
+        r = self._range(rpc_pb2.RangeRequest(key=key, revision=revision))
+        if not r.kvs:
+            return None
+        kv = r.kvs[0]
+        return ClientKV(kv.key, kv.value, kv.mod_revision)
+
+    def list(
+        self, start: bytes, end: bytes, revision: int = 0, limit: int = 0, page: int = 1000
+    ) -> tuple[list[ClientKV], int]:
+        """Paginated list; returns (kvs, list_revision)."""
+        out: list[ClientKV] = []
+        key = start
+        list_rev = revision
+        while True:
+            want = min(page, limit - len(out)) if limit else page
+            r = self._range(rpc_pb2.RangeRequest(
+                key=key, range_end=end, revision=list_rev, limit=want
+            ))
+            if list_rev == 0:
+                list_rev = r.header.revision  # pin the snapshot for later pages
+            out.extend(ClientKV(kv.key, kv.value, kv.mod_revision) for kv in r.kvs)
+            if not r.more or (limit and len(out) >= limit):
+                return out, list_rev
+            key = r.kvs[-1].key + b"\x00"
+
+    def count(self, start: bytes, end: bytes) -> int:
+        r = self._range(rpc_pb2.RangeRequest(key=start, range_end=end, count_only=True))
+        return r.count
+
+    def partition_borders(self, start: bytes, end: bytes) -> list[bytes]:
+        """Storage partition borders (magic revision; reference kv.go:33)."""
+        r = self._range(rpc_pb2.RangeRequest(
+            key=start, range_end=end, revision=PARTITION_MAGIC_REVISION
+        ))
+        return [kv.key for kv in r.kvs]
+
+    def parallel_list(
+        self, start: bytes, end: bytes, revision: int = 0
+    ) -> Iterator[ClientKV]:
+        """Partition-parallel listing: one list-over-watch stream per
+        partition, all concurrent, yielded in key order (the scale trick the
+        reference's custom apiserver uses for huge ranges, SURVEY §5c)."""
+        borders = self.partition_borders(start, end)
+        if len(borders) < 2:
+            kvs, _ = self.list(start, end, revision)
+            yield from kvs
+            return
+        rev = revision or self._range(
+            rpc_pb2.RangeRequest(key=start, range_end=end, limit=1)
+        ).header.revision
+        parts = list(zip(borders[:-1], borders[1:]))
+        results: list[list[ClientKV] | None] = [None] * len(parts)
+
+        def fetch(i, lo, hi):
+            results[i] = list(self._stream_partition(lo, hi, rev))
+
+        threads = [
+            threading.Thread(target=fetch, args=(i, lo, hi), daemon=True)
+            for i, (lo, hi) in enumerate(parts)
+        ]
+        for t in threads:
+            t.start()
+        for i, t in enumerate(threads):
+            t.join()
+            yield from results[i]  # partitions are key-ordered
+
+    def _stream_partition(self, lo: bytes, hi: bytes, revision: int):
+        """One list-over-watch range stream (negative start revision)."""
+        requests: queue.Queue = queue.Queue()
+        req = rpc_pb2.WatchRequest()
+        req.create_request.key = lo
+        req.create_request.range_end = hi
+        req.create_request.start_revision = -revision
+        requests.put(req)
+        responses = self._watch(iter(requests.get, None))
+        try:
+            for resp in responses:
+                for ev in resp.events:
+                    yield ClientKV(ev.kv.key, ev.kv.value, ev.kv.mod_revision)
+                if resp.canceled:
+                    return
+        finally:
+            requests.put(None)
+
+    # ---------------------------------------------------------------- watch
+    def watch(
+        self, key: bytes, range_end: bytes = b"", start_revision: int = 0,
+        prev_kv: bool = False,
+    ):
+        """Returns (events_iterator, cancel_fn). Events are (type, ClientKV,
+        prev ClientKV|None) tuples; the iterator ends on cancel."""
+        requests: queue.Queue = queue.Queue()
+        req = rpc_pb2.WatchRequest()
+        req.create_request.key = key
+        req.create_request.range_end = range_end
+        req.create_request.start_revision = start_revision
+        req.create_request.prev_kv = prev_kv
+        requests.put(req)
+        responses = self._watch(iter(requests.get, None))
+
+        def events():
+            try:
+                for resp in responses:
+                    if resp.canceled:
+                        return
+                    for ev in resp.events:
+                        kind = "DELETE" if ev.type == kv_pb2.Event.DELETE else "PUT"
+                        prev = (
+                            ClientKV(ev.prev_kv.key, ev.prev_kv.value, ev.prev_kv.mod_revision)
+                            if ev.HasField("prev_kv")
+                            else None
+                        )
+                        yield kind, ClientKV(ev.kv.key, ev.kv.value, ev.kv.mod_revision), prev
+            except grpc.RpcError:
+                return
+
+        def cancel():
+            requests.put(None)
+
+        return events(), cancel
+
+    def close(self) -> None:
+        self.channel.close()
+
+
+class BrainClient:
+    """Native protocol client (leaner than the etcd shim: explicit
+    revisions, no txn encoding)."""
+
+    def __init__(self, target: str, credentials: grpc.ChannelCredentials | None = None):
+        self.channel = (
+            grpc.secure_channel(target, credentials)
+            if credentials
+            else grpc.insecure_channel(target)
+        )
+        p = brain_pb2
+
+        def u(name, req, resp):
+            return self.channel.unary_unary(
+                f"/brainpb.Brain/{name}",
+                request_serializer=req.SerializeToString,
+                response_deserializer=resp.FromString,
+            )
+
+        def us(name, req, resp):
+            return self.channel.unary_stream(
+                f"/brainpb.Brain/{name}",
+                request_serializer=req.SerializeToString,
+                response_deserializer=resp.FromString,
+            )
+
+        self._create = u("Create", p.CreateRequest, p.CreateResponse)
+        self._update = u("Update", p.UpdateRequest, p.UpdateResponse)
+        self._delete = u("Delete", p.BrainDeleteRequest, p.BrainDeleteResponse)
+        self._compact = u("Compact", p.BrainCompactRequest, p.BrainCompactResponse)
+        self._get = u("Get", p.GetRequest, p.GetResponse)
+        self._range = u("Range", p.BrainRangeRequest, p.BrainRangeResponse)
+        self._range_stream = us("RangeStream", p.BrainRangeRequest, p.BrainRangeResponse)
+        self._count = u("Count", p.CountRequest, p.CountResponse)
+        self._list_partition = u("ListPartition", p.ListPartitionRequest, p.ListPartitionResponse)
+        self._watch = us("Watch", p.BrainWatchRequest, p.BrainWatchResponse)
+
+    def create(self, key: bytes, value: bytes):
+        r = self._create(brain_pb2.CreateRequest(key=key, value=value))
+        return r.succeeded, r.revision
+
+    def update(self, key: bytes, value: bytes, expected_revision: int):
+        r = self._update(brain_pb2.UpdateRequest(
+            key=key, value=value, expected_revision=expected_revision
+        ))
+        return r.succeeded, r.revision
+
+    def delete(self, key: bytes, expected_revision: int = 0):
+        r = self._delete(brain_pb2.BrainDeleteRequest(
+            key=key, expected_revision=expected_revision
+        ))
+        return r.succeeded, r.revision
+
+    def compact(self, revision: int) -> int:
+        return self._compact(brain_pb2.BrainCompactRequest(revision=revision)).compacted_revision
+
+    def get(self, key: bytes, revision: int = 0) -> ClientKV | None:
+        r = self._get(brain_pb2.GetRequest(key=key, revision=revision))
+        if not r.HasField("kv"):
+            return None
+        return ClientKV(r.kv.key, r.kv.value, r.kv.revision)
+
+    def range(self, start: bytes, end: bytes, revision: int = 0, limit: int = 0):
+        r = self._range(brain_pb2.BrainRangeRequest(
+            start=start, end=end, revision=revision, limit=limit
+        ))
+        return [ClientKV(kv.key, kv.value, kv.revision) for kv in r.kvs], r.more
+
+    def range_stream(self, start: bytes, end: bytes, revision: int = 0):
+        for resp in self._range_stream(brain_pb2.BrainRangeRequest(
+            start=start, end=end, revision=revision
+        )):
+            for kv in resp.kvs:
+                yield ClientKV(kv.key, kv.value, kv.revision)
+
+    def count(self, start: bytes, end: bytes) -> int:
+        return self._count(brain_pb2.CountRequest(start=start, end=end)).count
+
+    def list_partition(self, start: bytes, end: bytes) -> list[bytes]:
+        return list(self._list_partition(
+            brain_pb2.ListPartitionRequest(start=start, end=end)
+        ).borders)
+
+    def watch(self, prefix: bytes, start_revision: int = 0):
+        for resp in self._watch(brain_pb2.BrainWatchRequest(
+            prefix=prefix, start_revision=start_revision
+        )):
+            if resp.expired:
+                raise RuntimeError("watch expired; re-list required")
+            for ev in resp.events:
+                yield ev
+
+    def close(self) -> None:
+        self.channel.close()
