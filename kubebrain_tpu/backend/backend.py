@@ -110,8 +110,10 @@ class Backend:
             self.tso.init(recovered)
             self._next_rev = recovered + 1
 
+        from ..util.env import crash_guard
+
         self._seq_thread = threading.Thread(
-            target=self._collect_events, name="kb-sequencer", daemon=True
+            target=crash_guard(self._collect_events), name="kb-sequencer", daemon=True
         )
         self._seq_thread.start()
         self.retry.run()
@@ -565,6 +567,17 @@ class Backend:
             self._notify(new_event)
 
     # ================================================================ lifecycle
+    def reset_term(self) -> None:
+        """Leadership lost: wipe the watch pipeline so no stale state is ever
+        served. The reference panics the whole process for this ("simple and
+        rude", leader.go:109-118); dropping every watcher (poison pills force
+        clients to re-list/re-watch) and poisoning the scan mirror gives the
+        same observable contract without the restart."""
+        self.watcher_hub.close()
+        if hasattr(self.scanner, "mark_uncertain"):
+            self.scanner.mark_uncertain()
+        self._compact_rev_cache = -1  # re-read the watermark from storage
+
     def _read_revision_checked(self, revision: int) -> int:
         committed = self.tso.committed()
         read_rev = revision or committed

@@ -50,6 +50,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--cert-file", default="")
     p.add_argument("--key-file", default="")
     p.add_argument("--ca-file", default="")
+    p.add_argument("--secure-only", action="store_true",
+                   help="with TLS configured, refuse plaintext clients "
+                        "(reference endpoint secure modes, config.go:159)")
     p.add_argument("--cluster-name", default="")
     p.add_argument("--compact-interval", type=float, default=60.0)
     p.add_argument("--jax-platform", default=os.environ.get("KB_JAX_PLATFORM", ""),
@@ -79,6 +82,8 @@ def validate_args(args) -> None:
             raise SystemExit(f"invalid port {p}")
     if bool(args.cert_file) != bool(args.key_file):
         raise SystemExit("--cert-file and --key-file must be set together")
+    if args.secure_only and not args.cert_file:
+        raise SystemExit("--secure-only requires --cert-file/--key-file")
     for f in (args.cert_file, args.key_file, args.ca_file):
         if f and not os.path.exists(f):
             raise SystemExit(f"TLS file not found: {f}")
@@ -150,6 +155,7 @@ def build_endpoint(args):
         cert_file=args.cert_file,
         key_file=args.key_file,
         ca_file=args.ca_file,
+        insecure=not args.secure_only,
     ))
     return endpoint, backend, store
 

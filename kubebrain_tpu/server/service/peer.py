@@ -33,7 +33,9 @@ class PeerService:
                 meta={"client": f"{host}:{client_port}"},
             ),
             on_started_leading=self._on_started_leading,
-            on_stopped_leading=on_leader_change,
+            # default: reset the term (drop watchers, poison the scan
+            # mirror) — the reference's panic-on-leader-loss contract
+            on_stopped_leading=on_leader_change or backend.reset_term,
         )
         self.syncer = HttpRevisionSyncer(self.leader_peer_address, backend.set_current_revision)
         self.proxy = EtcdProxy(self.leader_client_address) if enable_proxy else DisabledEtcdProxy()

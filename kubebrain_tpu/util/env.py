@@ -38,3 +38,23 @@ def txn_log(verb: str, key: bytes, revision: int, err: BaseException | None) -> 
             logger.warning("txn %s key=%r rev=%d failed: %s", verb, key, revision, err)
     elif verbose():
         logger.info("txn %s key=%r rev=%d ok", verb, key, revision)
+
+
+def crash_guard(fn):
+    """Daemon-loop wrapper: an unhandled exception in a critical loop (the
+    sequencer, a campaign) must crash the process loudly rather than leave a
+    silently-stalled pipeline — the reference's util.Recover prints the stack
+    and os.Exit(2)s on goroutine panic (pkg/util/util.go:24-31)."""
+    import functools
+    import traceback
+
+    @functools.wraps(fn)
+    def wrapped(*args, **kwargs):
+        try:
+            return fn(*args, **kwargs)
+        except Exception:
+            traceback.print_exc()
+            logger.critical("critical loop %s crashed; exiting", fn.__name__)
+            os._exit(2)
+
+    return wrapped

@@ -154,3 +154,31 @@ def test_restart_resumes_revisions():
     assert b2.get(b"/k").value == b"v2"
     b2.close()
     store.close()
+
+
+def test_leader_loss_resets_watch_pipeline():
+    """Losing leadership drops every watcher (poison pills force clients to
+    re-watch) — the observable contract of the reference's
+    panic-on-leader-loss (leader.go:109-118)."""
+    store = new_storage("memkv")
+    b = Backend(store, BackendConfig(event_ring_capacity=2048))
+    wid, q = b.watch(b"/registry/")
+    b.create(b"/registry/a", b"v")
+    assert q.get(timeout=5) is not None
+    b.reset_term()
+    # the pill arrives (after any buffered events)
+    saw_pill = False
+    for _ in range(10):
+        item = q.get(timeout=2)
+        if item is None:
+            saw_pill = True
+            break
+    assert saw_pill
+    assert b.watcher_hub.watcher_count() == 0
+    # pipeline remains usable: new watch + write still flows
+    wid2, q2 = b.watch(b"/registry/")
+    b.create(b"/registry/b", b"v2")
+    batch = q2.get(timeout=5)
+    assert batch and batch[0].key == b"/registry/b"
+    b.close()
+    store.close()
