@@ -64,6 +64,18 @@ class Mirror:
         o = self.val_offsets[p]
         return self.val_arena[p][int(o[i]) : int(o[i + 1])].tobytes()
 
+    def materialize(self, p: int, rows: np.ndarray):
+        """Bulk (keys, values, revisions) for sorted row indices of one
+        partition — one vectorized unpack instead of per-row slicing."""
+        u8 = keyops.chunks_to_u8(self.keys_host[p][rows])
+        lens = self.lens_host[p][rows]
+        keys = [u8[j, : lens[j]].tobytes() for j in range(len(rows))]
+        o = self.val_offsets[p].astype(np.int64)
+        arena = self.val_arena[p]
+        values = [arena[o[i] : o[i + 1]].tobytes() for i in map(int, rows)]
+        revs = self.revs_host[p][rows]
+        return keys, values, revs
+
     def partition_first_keys(self) -> list[bytes]:
         return [
             self.user_key(p, 0) if self.n_valid[p] > 0 else b""

@@ -22,8 +22,8 @@ storage sharding through GetPartitions).
 
 from __future__ import annotations
 
+import functools
 import threading
-from functools import partial
 
 import jax
 import jax.numpy as jnp
@@ -53,6 +53,23 @@ def _vis_batch(keys, rh, rl, tomb, nv, start, end, unb, qhi, qlo):
     f = lambda k, a, b, t, n: visibility_mask(k, a, b, t, n, start, end, unb, qhi, qlo)
     mask = jax.vmap(f)(keys, rh, rl, tomb, nv)
     return mask, jnp.sum(mask, axis=1, dtype=jnp.int32)
+
+
+@jax.jit
+def _vis_count(keys, rh, rl, tomb, nv, start, end, unb, qhi, qlo):
+    _, counts = _vis_batch(keys, rh, rl, tomb, nv, start, end, unb, qhi, qlo)
+    return counts
+
+
+@functools.partial(jax.jit, static_argnames=("size",))
+def _vis_indices(keys, rh, rl, tomb, nv, start, end, unb, qhi, qlo, size):
+    """Flat indices (p*N + row) of visible rows, device-compacted so the
+    host transfer is O(results), not O(rows). ``size`` buckets to a power of
+    two to bound recompiles."""
+    mask, _ = _vis_batch(keys, rh, rl, tomb, nv, start, end, unb, qhi, qlo)
+    flat = mask.reshape(-1)
+    (idx,) = jnp.nonzero(flat, size=size, fill_value=flat.shape[0])
+    return idx
 
 
 @jax.jit
@@ -190,18 +207,36 @@ class TpuScanner(Scanner):
         with self._mlock:
             mirror = self._mirror
             delta = list(self._delta)
-        mask, _counts = self._device_visible(mirror, start, end, read_revision)
+        # two-phase device gather: counts first (tiny transfer), then the
+        # compacted index list sized to the next power of two — the host
+        # never pulls the full row mask
+        s, e, unb = self._query_bounds(start, end)
+        qhi, qlo = keyops.split_revs(np.array([read_revision], dtype=np.uint64))
+        args = (
+            mirror.keys_dev, mirror.rh_dev, mirror.rl_dev, mirror.tomb_dev,
+            mirror.n_valid_dev, s, e, unb,
+            jnp.asarray(qhi[0]), jnp.asarray(qlo[0]),
+        )
+        total = int(np.asarray(_vis_count(*args)).sum())
+        n_flat = mirror.keys_host.shape[0] * mirror.keys_host.shape[1]
+        bucket = 1
+        while bucket < max(total, 1):
+            bucket *= 2
+        bucket = min(bucket, n_flat)
+        idx = np.asarray(_vis_indices(*args, size=bucket))[:total]
+        n_rows = mirror.keys_host.shape[1]
         overlay = self._delta_overlay(delta, start, end, read_revision)
         from ...backend.common import KeyValue
 
         kvs: list[KeyValue] = []
-        for p in range(mirror.partitions):
-            for i in np.nonzero(mask[p])[0]:
-                i = int(i)
-                uk = mirror.user_key(p, i)
+        parts, rows = np.divmod(idx, n_rows)
+        for p in np.unique(parts):
+            p_rows = rows[parts == p]
+            keys, values, revs = mirror.materialize(int(p), p_rows)
+            for uk, val, rv in zip(keys, values, revs):
                 if uk in overlay:
                     continue  # delta supersedes
-                kvs.append(KeyValue(uk, mirror.value(p, i), int(mirror.revs_host[p][i])))
+                kvs.append(KeyValue(uk, val, int(rv)))
         for uk, entry in overlay.items():
             if entry is not None:
                 kvs.append(KeyValue(uk, entry[1], entry[0]))
