@@ -67,9 +67,7 @@ class Mirror:
     def materialize(self, p: int, rows: np.ndarray):
         """Bulk (keys, values, revisions) for sorted row indices of one
         partition — one vectorized unpack instead of per-row slicing."""
-        u8 = keyops.chunks_to_u8(self.keys_host[p][rows])
-        lens = self.lens_host[p][rows]
-        keys = [u8[j, : lens[j]].tobytes() for j in range(len(rows))]
+        keys = keyops.chunks_to_bytes(self.keys_host[p][rows], self.lens_host[p][rows])
         o = self.val_offsets[p].astype(np.int64)
         arena = self.val_arena[p]
         values = [arena[o[i] : o[i + 1]].tobytes() for i in map(int, rows)]

@@ -172,15 +172,16 @@ class TpuScanner(Scanner):
         e = jnp.asarray(keyops.pack_one(keyops.canonicalize_bound(end) if end else b"", self._kw))
         return s, e, jnp.asarray(unbounded)
 
-    def _device_visible(self, mirror: Mirror, start: bytes, end: bytes, read_rev: int):
+    def _vis_args(self, mirror: Mirror, start: bytes, end: bytes, read_rev: int):
+        """The (blocks..., bounds, revision) tuple every visibility kernel
+        takes — one assembly point so count/range can't diverge."""
         s, e, unb = self._query_bounds(start, end)
         qhi, qlo = keyops.split_revs(np.array([read_rev], dtype=np.uint64))
-        mask, counts = _vis_batch(
+        return (
             mirror.keys_dev, mirror.rh_dev, mirror.rl_dev, mirror.tomb_dev,
             mirror.n_valid_dev, s, e, unb,
             jnp.asarray(qhi[0]), jnp.asarray(qlo[0]),
         )
-        return np.asarray(mask), np.asarray(counts)
 
     def _delta_overlay(
         self, delta: list[tuple[bytes, int, bytes]], start: bytes, end: bytes, read_rev: int
@@ -210,13 +211,7 @@ class TpuScanner(Scanner):
         # two-phase device gather: counts first (tiny transfer), then the
         # compacted index list sized to the next power of two — the host
         # never pulls the full row mask
-        s, e, unb = self._query_bounds(start, end)
-        qhi, qlo = keyops.split_revs(np.array([read_revision], dtype=np.uint64))
-        args = (
-            mirror.keys_dev, mirror.rh_dev, mirror.rl_dev, mirror.tomb_dev,
-            mirror.n_valid_dev, s, e, unb,
-            jnp.asarray(qhi[0]), jnp.asarray(qlo[0]),
-        )
+        args = self._vis_args(mirror, start, end, read_revision)
         total = int(np.asarray(_vis_count(*args)).sum())
         n_flat = mirror.keys_host.shape[0] * mirror.keys_host.shape[1]
         bucket = 1
@@ -251,7 +246,7 @@ class TpuScanner(Scanner):
         with self._mlock:
             mirror = self._mirror
             delta = list(self._delta)
-        _mask, counts = self._device_visible(mirror, start, end, read_revision)
+        counts = np.asarray(_vis_count(*self._vis_args(mirror, start, end, read_revision)))
         total = int(counts.sum())
         overlay = self._delta_overlay(delta, start, end, read_revision)
         for uk, entry in overlay.items():
