@@ -146,6 +146,7 @@ def build_endpoint(args):
     server = Server(
         backend, peers, metrics, identity,
         client_urls=[f"http://{identity.rsplit(':', 1)[0]}:{args.client_port}"],
+        compact_interval=args.compact_interval,
     )
     endpoint = Endpoint(server, metrics, EndpointConfig(
         host=args.host,

@@ -28,12 +28,13 @@ class Server:
         metrics: Metrics | None = None,
         identity: str = "kubebrain-tpu",
         client_urls: list[str] | None = None,
+        compact_interval: float = 60.0,
     ):
         self.backend = backend
         self.peers = peers
         self.metrics = metrics or NoopMetrics()
         self.identity = identity
-        self.brain = BrainServer(backend, peers)
+        self.brain = BrainServer(backend, peers, compact_interval=compact_interval)
         self.grpc_handlers = (
             make_etcd_handlers(backend, peers, identity, client_urls or [])
             + make_brain_handlers(self.brain)

@@ -32,9 +32,17 @@ COMPACT_KEEP_REVISIONS = 1000
 
 
 class BrainServer:
-    def __init__(self, backend: Backend, peers=None):
+    def __init__(
+        self,
+        backend: Backend,
+        peers=None,
+        compact_interval: float = COMPACT_INTERVAL_SECONDS,
+        compact_keep: int = COMPACT_KEEP_REVISIONS,
+    ):
         self.backend = backend
         self.peers = peers
+        self._compact_interval = compact_interval
+        self._compact_keep = compact_keep
         self._stop = threading.Event()
         self._compact_thread: threading.Thread | None = None
 
@@ -49,10 +57,10 @@ class BrainServer:
         self._compact_thread.start()
 
     def _compact_loop(self) -> None:
-        while not self._stop.wait(COMPACT_INTERVAL_SECONDS):
+        while not self._stop.wait(self._compact_interval):
             if self.peers is not None and not self.peers.is_leader():
                 continue
-            target = self.backend.current_revision() - COMPACT_KEEP_REVISIONS
+            target = self.backend.current_revision() - self._compact_keep
             if target > 0:
                 try:
                     self.backend.compact(target)

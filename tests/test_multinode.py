@@ -182,3 +182,31 @@ def test_leader_loss_resets_watch_pipeline():
     assert batch and batch[0].key == b"/registry/b"
     b.close()
     store.close()
+
+
+def test_follower_read_fails_without_leader():
+    """Failure to sync the read revision fails the read (reference
+    brain/read.go:128-130) — a follower must not serve stale data silently."""
+    from kubebrain_tpu.server.service.revision import RevisionSyncError
+
+    store = new_storage("memkv")
+    # plant an unexpired lock record owned by an unreachable peer BEFORE the
+    # node starts campaigning, so it stays a follower of a dead leader
+    from kubebrain_tpu.backend.election import ResourceLock
+
+    dead = ResourceLock(store, "10.255.255.1:19999",
+                        meta={"client": "10.255.255.1:19998"})
+    import time as _t
+
+    dead.create(_t.time() + 3600)  # renewed far in the future
+    node = Node(store)
+    try:
+        _t.sleep(0.3)
+        assert not node.peers.is_leader()
+        with pytest.raises(grpc.RpcError):
+            node.client.range_(
+                rpc_pb2.RangeRequest(key=b"/registry/", range_end=b"/registry0")
+            )
+    finally:
+        node.close()
+        store.close()
