@@ -242,9 +242,10 @@ def bench_compact() -> None:
 
 
 def bench_insert() -> None:
-    """Reference headline: insert throughput through the full MVCC write
-    path (BASELINE.md: KubeBrain/TiKV 28.6k ops/s, etcd 10.2k) over the C++
-    native engine."""
+    """Reference headline: insert throughput + insert→event delivery latency
+    through the full MVCC write path (BASELINE.md: KubeBrain/TiKV 28.6k
+    ops/s, event latency avg 11.9-13.5ms p99 23-41ms) over the C++ engine."""
+    import queue as _q
     import threading
 
     from kubebrain_tpu.backend import Backend, BackendConfig
@@ -257,9 +258,32 @@ def bench_insert() -> None:
     value = b"x" * 512  # reference workload: 512B values
     per = n_ops // n_threads
 
+    # a watcher measuring write→event delivery latency (reference's "insert
+    # event" rows): writers stamp send time in the value
+    _, wq = backend.watch(b"/registry/pods/")
+    ev_lat: list[float] = []
+    stop_watch = threading.Event()
+
+    def watcher():
+        while not stop_watch.is_set():
+            try:
+                batch = wq.get(timeout=0.2)
+            except _q.Empty:
+                continue
+            if batch is None:
+                return
+            now = time.time()
+            for ev in batch:
+                sent = float(ev.value[:20])
+                ev_lat.append(now - sent)
+
+    wt = threading.Thread(target=watcher, daemon=True)
+    wt.start()
+
     def writer(w):
         for i in range(per):
-            backend.create(b"/registry/pods/bench-%02d-%06d" % (w, i), value)
+            stamped = (b"%020.6f" % time.time()) + value
+            backend.create(b"/registry/pods/bench-%02d-%06d" % (w, i), stamped)
 
     threads = [threading.Thread(target=writer, args=(w,)) for w in range(n_threads)]
     t0 = time.time()
@@ -269,15 +293,82 @@ def bench_insert() -> None:
         t.join()
     dt = time.time() - t0
     rate = per * n_threads / dt
+    time.sleep(0.5)
+    stop_watch.set()
     backend.close()
     store.close()
+    lat_sorted = sorted(ev_lat) or [0.0]
     print(json.dumps({
         "metric": "insert ops/sec",
         "value": round(rate),
         "unit": "ops/sec",
         "vs_baseline": round(rate / 28_644, 3),  # reference KubeBrain/TiKV insert
-        "detail": {"ops": per * n_threads, "threads": n_threads,
-                   "value_bytes": 512, "engine": "native(C++)"},
+        "detail": {
+            "ops": per * n_threads, "threads": n_threads,
+            "value_bytes": 512, "engine": "native(C++)",
+            "events_delivered": len(ev_lat),
+            "event_latency_avg_ms": round(sum(lat_sorted) / len(lat_sorted) * 1e3, 2),
+            "event_latency_p99_ms": round(lat_sorted[int(len(lat_sorted) * 0.99) - 1] * 1e3, 2),
+            "reference_event_latency": "avg 11.9-13.5ms p99 23-41ms",
+        },
+    }))
+
+
+def bench_grpc_insert() -> None:
+    """Over-the-wire insert throughput: concurrent etcd3 clients against a
+    live endpoint (the reference's benchmark methodology: 300 concurrent
+    etcd clients, 512B values, docs/benchmark.md:34-37)."""
+    import threading
+
+    from kubebrain_tpu.cli import build_endpoint, build_parser
+    from kubebrain_tpu.client import EtcdCompatClient
+
+    import socket
+
+    def free_port():
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        p = s.getsockname()[1]
+        s.close()
+        return p
+
+    n_ops = int(os.environ.get("KB_BENCH_OPS", 10_000))
+    n_clients = int(os.environ.get("KB_BENCH_CLIENTS", 32))
+    port = free_port()
+    args = build_parser().parse_args([
+        "--single-node", "--storage", "native", "--host", "127.0.0.1",
+        "--client-port", str(port),
+        "--peer-port", str(free_port()), "--info-port", str(free_port()),
+    ])
+    endpoint, backend, store = build_endpoint(args)
+    endpoint.run()
+    value = b"x" * 512
+    per = n_ops // n_clients
+
+    def client_writer(w):
+        c = EtcdCompatClient(f"127.0.0.1:{port}")
+        for i in range(per):
+            c.create(b"/registry/pods/g-%03d-%06d" % (w, i), value)
+        c.close()
+
+    threads = [threading.Thread(target=client_writer, args=(w,)) for w in range(n_clients)]
+    t0 = time.time()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    dt = time.time() - t0
+    rate = per * n_clients / dt
+    endpoint.close()
+    backend.close()
+    store.close()
+    print(json.dumps({
+        "metric": "grpc insert ops/sec",
+        "value": round(rate),
+        "unit": "ops/sec",
+        "vs_baseline": round(rate / 28_644, 3),
+        "detail": {"ops": per * n_clients, "clients": n_clients,
+                   "value_bytes": 512, "transport": "etcd3 gRPC"},
     }))
 
 
@@ -381,6 +472,8 @@ def main() -> None:
         return bench_compact()
     if metric == "insert":
         return bench_insert()
+    if metric == "grpc-insert":
+        return bench_grpc_insert()
     if metric == "sim":
         return bench_sim()
 

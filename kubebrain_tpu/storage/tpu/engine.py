@@ -240,6 +240,72 @@ class TpuScanner(Scanner):
             return kvs[:limit], len(kvs) > limit
         return kvs, False
 
+    def range_stream(self, start: bytes, end: bytes, read_revision: int, batch_size: int = 300):
+        """Device-indexed streaming list: bounded batches materialized on
+        demand from the index list (reference receiver.go:105-160), with the
+        delta overlay merged in key order — unbounded ranges never
+        materialize in full on the host."""
+        self._snapshot_checked(read_revision)
+        self._ensure_published()
+        with self._mlock:
+            mirror = self._mirror
+            delta = list(self._delta)
+        args = self._vis_args(mirror, start, end, read_revision)
+        total = int(np.asarray(_vis_count(*args)).sum())
+        n_flat = mirror.keys_host.shape[0] * mirror.keys_host.shape[1]
+        bucket = 1
+        while bucket < max(total, 1):
+            bucket *= 2
+        bucket = min(bucket, n_flat)
+        idx = np.asarray(_vis_indices(*args, size=bucket))[:total]
+        n_rows = mirror.keys_host.shape[1]
+        overlay = self._delta_overlay(delta, start, end, read_revision)
+        extra = sorted(
+            (k, v) for k, v in overlay.items() if v is not None
+        )  # (key, (rev, value)) insertions, key-ascending
+        from ...backend.common import KeyValue
+
+        def generate():
+            ei = 0
+            batch: list[KeyValue] = []
+
+            def push(kv):
+                nonlocal batch
+                batch.append(kv)
+                if len(batch) >= batch_size:
+                    out, batch = batch, []
+                    return out
+                return None
+
+            pos = 0
+            while pos < len(idx):
+                chunk = idx[pos : pos + 4096]
+                pos += 4096
+                parts, rows = np.divmod(chunk, n_rows)
+                for p in np.unique(parts):
+                    p_rows = rows[parts == p]
+                    keys, values, revs = mirror.materialize(int(p), p_rows)
+                    for uk, val, rv in zip(keys, values, revs):
+                        while ei < len(extra) and extra[ei][0] < uk:
+                            full = push(KeyValue(extra[ei][0], extra[ei][1][1], extra[ei][1][0]))
+                            if full:
+                                yield full
+                            ei += 1
+                        if uk in overlay:
+                            continue  # superseded or tombstoned by the delta
+                        full = push(KeyValue(uk, val, int(rv)))
+                        if full:
+                            yield full
+            while ei < len(extra):
+                full = push(KeyValue(extra[ei][0], extra[ei][1][1], extra[ei][1][0]))
+                if full:
+                    yield full
+                ei += 1
+            if batch:
+                yield batch
+
+        return generate()
+
     def count(self, start: bytes, end: bytes, read_revision: int) -> int:
         self._snapshot_checked(read_revision)
         self._ensure_published()

@@ -160,3 +160,22 @@ def test_partitions_align_with_mesh(tb):
     assert len(parts) >= 2
     assert parts[0].left == b"/registry/"
     assert parts[-1].right == b"/registry0"
+
+
+def test_range_stream_device_path(tb):
+    """Streaming list goes through the device index path and matches the
+    non-streaming result, including delta-overlay insertions/tombstones."""
+    for i in range(30):
+        tb.create(b"/registry/rs/p%03d" % i, b"v%d" % i)
+    tb.scanner.publish()
+    # leave fresh rows in the delta: an insert and a delete overlay
+    tb.scanner._merge_threshold = 10**9
+    tb.create(b"/registry/rs/extra", b"fresh")
+    tb.delete(b"/registry/rs/p005")
+    rev, stream = tb.list_by_stream(b"/registry/rs/", b"/registry/rs0")
+    streamed = [kv for batch in stream for kv in batch]
+    plain = tb.list_(b"/registry/rs/", b"/registry/rs0").kvs
+    assert [(kv.key, kv.value) for kv in streamed] == [(kv.key, kv.value) for kv in plain]
+    keys = [kv.key for kv in streamed]
+    assert keys == sorted(keys)
+    assert b"/registry/rs/extra" in keys and b"/registry/rs/p005" not in keys
