@@ -320,7 +320,6 @@ def bench_grpc_insert() -> None:
     etcd clients, 512B values, docs/benchmark.md:34-37)."""
     import threading
 
-    from kubebrain_tpu.cli import build_endpoint, build_parser
     from kubebrain_tpu.client import EtcdCompatClient
 
     import socket
@@ -335,15 +334,26 @@ def bench_grpc_insert() -> None:
     n_ops = int(os.environ.get("KB_BENCH_OPS", 10_000))
     n_clients = int(os.environ.get("KB_BENCH_CLIENTS", 32))
     port = free_port()
-    args = build_parser().parse_args([
-        "--single-node", "--storage", "native", "--host", "127.0.0.1",
-        "--client-port", str(port),
-        "--peer-port", str(free_port()), "--info-port", str(free_port()),
-    ])
-    endpoint, backend, store = build_endpoint(args)
-    endpoint.run()
+    # server in its own interpreter so client and server don't share a GIL
+    server = subprocess.Popen(
+        [sys.executable, "-m", "kubebrain_tpu.cli", "--single-node",
+         "--storage", "native", "--host", "127.0.0.1",
+         "--client-port", str(port),
+         "--peer-port", str(free_port()), "--info-port", str(free_port())],
+        cwd=os.path.dirname(os.path.abspath(__file__)),
+        stderr=subprocess.DEVNULL,
+    )
     value = b"x" * 512
     per = n_ops // n_clients
+    probe = EtcdCompatClient(f"127.0.0.1:{port}")
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        try:
+            probe.count(b"/x", b"/y")
+            break
+        except Exception:
+            time.sleep(0.2)
+    probe.close()
 
     def client_writer(w):
         c = EtcdCompatClient(f"127.0.0.1:{port}")
@@ -359,9 +369,8 @@ def bench_grpc_insert() -> None:
         t.join()
     dt = time.time() - t0
     rate = per * n_clients / dt
-    endpoint.close()
-    backend.close()
-    store.close()
+    server.terminate()
+    server.wait(timeout=10)
     print(json.dumps({
         "metric": "grpc insert ops/sec",
         "value": round(rate),
