@@ -1,0 +1,33 @@
+"""Multi-host worker: joins the jax.distributed process group and runs the
+full data-plane step over the GLOBAL mesh (spawned by test_multihost.py)."""
+
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from kubebrain_tpu.parallel.multihost import global_data_plane_mesh, init_multihost
+from kubebrain_tpu.parallel.step import make_data_plane_step, make_example_args
+
+
+def main() -> int:
+    pid, n, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+    init_multihost(f"127.0.0.1:{port}", num_processes=n, process_id=pid)
+    mesh = global_data_plane_mesh(wat_axis=2)
+    step = make_data_plane_step(mesh)
+    args = make_example_args(mesh, n_parts=mesh.shape["part"], watchers=8)
+    vis, total, victims, fmask = step(*args)
+    jax.block_until_ready(total)
+    print(f"MHRESULT pid={pid} devices={len(jax.devices())} total={int(total)}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
