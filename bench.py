@@ -314,6 +314,49 @@ def bench_insert() -> None:
     }))
 
 
+def bench_delete() -> None:
+    """The reference's documented weakness: delete throughput (published
+    4,847-5,028 ops/s vs etcd's 10.8k; read-before-delete + CAS,
+    benchmark.md:56-61). Here the whole sequence is one native call."""
+    import threading
+
+    from kubebrain_tpu.backend import Backend, BackendConfig
+    from kubebrain_tpu.storage import new_storage
+
+    n_ops = int(os.environ.get("KB_BENCH_OPS", 20_000))
+    n_threads = int(os.environ.get("KB_BENCH_THREADS", 8))
+    store = new_storage("native")
+    backend = Backend(store, BackendConfig(event_ring_capacity=300_000))
+    value = b"x" * 512
+    per = n_ops // n_threads
+    for w in range(n_threads):
+        for i in range(per):
+            backend.create(b"/registry/pods/del-%02d-%06d" % (w, i), value)
+
+    def deleter(w):
+        for i in range(per):
+            backend.delete(b"/registry/pods/del-%02d-%06d" % (w, i))
+
+    threads = [threading.Thread(target=deleter, args=(w,)) for w in range(n_threads)]
+    t0 = time.time()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    dt = time.time() - t0
+    rate = per * n_threads / dt
+    backend.close()
+    store.close()
+    print(json.dumps({
+        "metric": "delete ops/sec",
+        "value": round(rate),
+        "unit": "ops/sec",
+        "vs_baseline": round(rate / 5_028, 3),  # reference's published delete
+        "detail": {"ops": per * n_threads, "threads": n_threads,
+                   "engine": "native(C++)", "reference": "4.8-5.0k (KubeBrain), 10.8-11.2k (etcd)"},
+    }))
+
+
 def bench_grpc_insert() -> None:
     """Over-the-wire insert throughput: concurrent etcd3 clients against a
     live endpoint (the reference's benchmark methodology: 300 concurrent
@@ -481,6 +524,8 @@ def main() -> None:
         return bench_compact()
     if metric == "insert":
         return bench_insert()
+    if metric == "delete":
+        return bench_delete()
     if metric == "grpc-insert":
         return bench_grpc_insert()
     if metric == "sim":

@@ -86,8 +86,9 @@ class Backend:
         )
         # engines with their own scan offload (tpu) supply the scanner
         self.scanner = store.make_scanner(**scanner_kw) or Scanner(store, **scanner_kw)
-        # single-FFI-call write fast path when the engine provides it
+        # single-FFI-call write/delete fast paths when the engine provides them
         self._mvcc_write = getattr(store, "mvcc_write", None)
+        self._mvcc_delete = getattr(store, "mvcc_delete", None)
         # compact watermark cache: -1 unknown; refreshed at most once per
         # COMPACT_CACHE_TTL so hot reads don't pay an engine round-trip
         # (local compactions update it synchronously; the TTL bounds follower
@@ -220,10 +221,13 @@ class Backend:
             self.tso.wait_committed(rev, timeout=5.0)
 
     def delete(self, user_key: bytes, expected_revision: int = 0) -> tuple[int, KeyValue]:
-        """Tombstone write: CAS(revision_key → rev_value(new, deleted)) +
-        Put(object_key, TOMBSTONE). Reference txn.go:79-190 (read-before-delete
-        + CAS — the documented delete weakness, benchmark.md:56-61).
+        """Tombstone write. The reference pays three engine round-trips here
+        (read record, read previous value, CAS batch — its documented delete
+        weakness, txn.go:79-190, benchmark.md:56-61); with a native engine the
+        whole read-validate-tombstone sequence is one call.
         Returns (new_revision, previous KeyValue)."""
+        if self._mvcc_delete is not None:
+            return self._delete_fast(user_key, expected_revision)
         record = self._read_rev_record(user_key)
         if record is None or record[1]:
             raise KeyNotFoundError(user_key)
@@ -261,6 +265,37 @@ class Backend:
                 except coder.CodecError:
                     pass
             raise CASRevisionMismatchError(user_key, lr, lv) from e
+        except UncertainResultError as e:
+            event.err = e
+            raise
+        finally:
+            txn_log("delete", user_key, rev, event.err or sys.exc_info()[1])
+            self._notify(event)
+            self.tso.wait_committed(rev, timeout=5.0)
+
+    def _delete_fast(self, user_key: bytes, expected_revision: int) -> tuple[int, KeyValue]:
+        """Single-call delete via the engine (read+validate+tombstone under
+        one lock). Failed deletes consume a revision here (dealt up front) —
+        etcd semantics allow revision gaps."""
+        rev = self.tso.deal()
+        event = WatchEvent(revision=rev, verb=Verb.DELETE, key=user_key, valid=False)
+        try:
+            outcome, prev, latest = self._mvcc_delete(
+                coder.encode_revision_key(user_key),
+                expected_revision, rev,
+                coder.encode_rev_value(rev, deleted=True),
+                TOMBSTONE, LAST_REV_KEY, coder.encode_rev_value(rev),
+            )
+            if outcome == "not_found":
+                raise KeyNotFoundError(user_key)
+            if outcome == "mismatch":
+                raise CASRevisionMismatchError(
+                    user_key, latest, None if prev == TOMBSTONE else prev
+                )
+            event.prev_revision = latest
+            event.prev_value = prev
+            event.valid = True
+            return rev, KeyValue(user_key, prev or b"", latest)
         except UncertainResultError as e:
             event.err = e
             raise

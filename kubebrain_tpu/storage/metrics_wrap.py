@@ -17,10 +17,16 @@ class MetricsKvStorage(KvStorage):
         self._m = metrics
         if hasattr(inner, "mvcc_write"):
             self.mvcc_write = self._mvcc_write_timed
+        if hasattr(inner, "mvcc_delete"):
+            self.mvcc_delete = self._mvcc_delete_timed
 
     def _mvcc_write_timed(self, *args, **kwargs):
         with self._m.timed("storage.mvcc_write"):
             return self._inner.mvcc_write(*args, **kwargs)
+
+    def _mvcc_delete_timed(self, *args, **kwargs):
+        with self._m.timed("storage.mvcc_delete"):
+            return self._inner.mvcc_delete(*args, **kwargs)
 
     def get_timestamp_oracle(self) -> int:
         return self._inner.get_timestamp_oracle()

@@ -110,6 +110,17 @@ def _load_lib() -> ctypes.CDLL:
             ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_uint64),
         ]
         lib.kb_mvcc_export_fill.restype = ctypes.c_uint64
+        lib.kb_mvcc_delete.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_char_p, ctypes.c_size_t,  # rev_key
+            ctypes.c_uint64, ctypes.c_uint64,  # expected, new rev
+            ctypes.c_char_p, ctypes.c_size_t,  # new record
+            ctypes.c_char_p, ctypes.c_size_t,  # tombstone value
+            ctypes.c_char_p, ctypes.c_size_t,  # last_key
+            ctypes.c_char_p, ctypes.c_size_t,  # last_val
+            ctypes.POINTER(u8p), ctypes.POINTER(ctypes.c_size_t),
+            ctypes.POINTER(ctypes.c_uint64),
+        ]
         lib.kb_mvcc_write.argtypes = [
             ctypes.c_void_p,
             ctypes.c_char_p, ctypes.c_size_t,  # rev_key
@@ -230,6 +241,43 @@ class NativeKv(KvStorage):
                 observed = ctypes.string_at(cv, cl.value)
                 self._lib.kb_free(cv)
             raise CASFailedError(Conflict(0, rev_key, observed))
+
+    def mvcc_delete(
+        self,
+        rev_key: bytes,
+        expected_rev: int,
+        new_rev: int,
+        new_record: bytes,
+        tombstone: bytes,
+        last_key: bytes,
+        last_val: bytes,
+    ) -> tuple[str, bytes | None, int]:
+        """One-call read-validate-tombstone delete. Returns
+        (outcome, prev_value, latest_rev) with outcome in
+        {"ok", "not_found", "mismatch"}; raises on WAL failure/drift."""
+        pv = ctypes.POINTER(ctypes.c_uint8)()
+        pl = ctypes.c_size_t(0)
+        latest = ctypes.c_uint64(0)
+        rc = self._lib.kb_mvcc_delete(
+            self._store, rev_key, len(rev_key),
+            expected_rev, new_rev, new_record, len(new_record),
+            tombstone, len(tombstone), last_key, len(last_key),
+            last_val, len(last_val),
+            ctypes.byref(pv), ctypes.byref(pl), ctypes.byref(latest),
+        )
+        prev = None
+        if rc in (0, 2) and pl.value:
+            prev = ctypes.string_at(pv, pl.value)
+            self._lib.kb_free(pv)
+        if rc == 0:
+            return "ok", prev, int(latest.value)
+        if rc == 1:
+            return "not_found", None, 0
+        if rc == 2:
+            return "mismatch", prev, int(latest.value)
+        if rc == 3:
+            raise StorageError("WAL append failed; delete aborted")
+        raise StorageError(f"revision drift on delete (latest {latest.value})")
 
     def export_mvcc(
         self,

@@ -496,10 +496,12 @@ class TpuKvStorage(KvStorage):
         self._kw = key_width
         self._scanner_kw = scanner_kw
         self._scanner: TpuScanner | None = None
-        # expose the single-call write fast path only when the host engine
-        # has it (instance attribute so hasattr() reflects capability)
+        # expose the single-call fast paths only when the host engine has
+        # them (instance attributes so hasattr() reflects capability)
         if hasattr(inner, "mvcc_write"):
             self.mvcc_write = self._mvcc_write_tracked
+        if hasattr(inner, "mvcc_delete"):
+            self.mvcc_delete = self._mvcc_delete_tracked
 
     # ---- scanner wiring (Backend calls make_scanner, storage/__init__.py)
     def make_scanner(self, **kw) -> TpuScanner:
@@ -559,6 +561,16 @@ class TpuKvStorage(KvStorage):
             ukey, rev = coder.decode(obj_key)
             if rev != 0:
                 self._on_committed([(ukey, rev, obj_val)])
+
+    def _mvcc_delete_tracked(self, rev_key, expected_rev, new_rev, new_record,
+                             tombstone, last_key, last_val):
+        result = self._inner.mvcc_delete(
+            rev_key, expected_rev, new_rev, new_record, tombstone, last_key, last_val
+        )
+        if result[0] == "ok" and coder.is_internal_key(rev_key):
+            ukey, _ = coder.decode(rev_key)
+            self._on_committed([(ukey, new_rev, tombstone)])
+        return result
 
     def _on_committed(self, rows: list[tuple[bytes, int, bytes]]) -> None:
         if self._scanner is not None and rows:

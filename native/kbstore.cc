@@ -594,6 +594,100 @@ int kb_mvcc_write(void* s,
   return 0;
 }
 
+// ------------------------------------------------------------ MVCC delete
+// The reference's documented weakness is the delete path: a read of the
+// revision record, a read of the previous value, then a CAS batch — three
+// engine round-trips (txn.go:145-190; benchmark.md "delete needs
+// optimization"). Here the whole read-validate-write sequence is ONE native
+// call under one lock. Outcomes: 0 ok (prev value + revision returned);
+// 1 key absent/already deleted; 2 revision mismatch (latest returned);
+// 3 WAL failure; 4 revision drift (new_rev <= latest).
+int kb_mvcc_delete(void* s,
+                   const uint8_t* rev_key, size_t rkl,
+                   uint64_t expected_rev,  // 0 = unconditional
+                   uint64_t new_rev,
+                   const uint8_t* new_record, size_t nrl,
+                   const uint8_t* tombstone, size_t tl,
+                   const uint8_t* last_key, size_t lkl,
+                   const uint8_t* last_val, size_t lvl,
+                   uint8_t** prev_val, size_t* prev_len,
+                   uint64_t* latest_rev_out) {
+  Store* st = static_cast<Store*>(s);
+  double now = wallclock();
+  std::string rk(reinterpret_cast<const char*>(rev_key), rkl);
+  std::unique_lock<std::shared_mutex> lock(st->mu);
+  const std::string* record = st->live(rk, st->ts, now);
+  if (record == nullptr || record->size() == 9) return 1;  // absent or deleted
+  if (record->size() != 8) return 1;
+  uint64_t latest = 0;
+  for (int i = 0; i < 8; ++i) {
+    latest = (latest << 8) | static_cast<uint8_t>((*record)[i]);
+  }
+  *latest_rev_out = latest;
+  // previous object row: rev_key with the trailing revision replaced
+  std::string obj_old = rk;
+  for (int i = 0; i < 8; ++i) {
+    obj_old[rkl - 8 + i] = static_cast<char>((latest >> (8 * (7 - i))) & 0xFF);
+  }
+  const std::string* prev = st->live(obj_old, st->ts, now);
+  if (prev != nullptr) {
+    *prev_val = static_cast<uint8_t*>(malloc(prev->size()));
+    memcpy(*prev_val, prev->data(), prev->size());
+    *prev_len = prev->size();
+  } else {
+    *prev_len = 0;
+    *prev_val = nullptr;
+  }
+  if (expected_rev != 0 && latest != expected_rev) return 2;
+  if (new_rev <= latest) return 4;
+  std::string obj_new = rk;
+  for (int i = 0; i < 8; ++i) {
+    obj_new[rkl - 8 + i] = static_cast<char>((new_rev >> (8 * (7 - i))) & 0xFF);
+  }
+  uint64_t ts = ++st->ts;
+  std::vector<AppliedOp> applied(3);
+  applied[0].kind = 0;
+  applied[0].key = rk;
+  applied[0].value.assign(reinterpret_cast<const char*>(new_record), nrl);
+  applied[0].expire_at = 0;
+  applied[1].kind = 0;
+  applied[1].key = obj_new;
+  applied[1].value.assign(reinterpret_cast<const char*>(tombstone), tl);
+  applied[1].expire_at = 0;
+  applied[2].kind = 0;
+  applied[2].key.assign(reinterpret_cast<const char*>(last_key), lkl);
+  applied[2].value.assign(reinterpret_cast<const char*>(last_val), lvl);
+  applied[2].expire_at = 0;
+  if (st->wal != nullptr) {
+    long rec_start = ftell(st->wal);
+    bool logged = write_record(st->wal, ts, applied);
+    if (logged) logged = fflush(st->wal) == 0;
+    if (logged && st->fsync_commits) {
+#ifdef __unix__
+      logged = fsync(fileno(st->wal)) == 0;
+#endif
+    }
+    if (!logged) {
+#ifdef __unix__
+      if (rec_start >= 0 && ftruncate(fileno(st->wal), rec_start) == 0) {
+        fseek(st->wal, rec_start, SEEK_SET);
+      }
+#endif
+      --st->ts;
+      return 3;
+    }
+  }
+  for (AppliedOp& a : applied) {
+    Version v;
+    v.ts = ts;
+    v.deleted = false;
+    v.expire_at = a.expire_at;
+    v.value = std::move(a.value);
+    st->data[a.key].push_back(std::move(v));
+  }
+  return 0;
+}
+
 // ------------------------------------------------------- MVCC bulk export
 // Host-shim fast path for the TPU mirror (SURVEY §2.8): walk the MVCC
 // internal keyspace (magic + user_key + NUL + big-endian u64 revision) at a
