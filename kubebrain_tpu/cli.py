@@ -39,6 +39,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--prefix", default="/", help="key prefix served/compacted")
     p.add_argument("--skip-prefixes", default="", help="comma-separated prefixes compaction skips")
     p.add_argument("--watch-cache-size", type=int, default=200_000)
+    p.add_argument("--disable-etcd-compatibility", action="store_true",
+                   help="serve only the native brain protocol semantics "
+                        "(Count over etcd is rejected; reference etcd-compat flag)")
     p.add_argument("--identity", default="", help="host:peerPort; autodetected when empty")
     p.add_argument("--single-node", action="store_true",
                    help="stub leader election (always leader)")
@@ -133,6 +136,7 @@ def build_endpoint(args):
         prefix=args.prefix.encode(),
         skip_prefixes=[s.encode() for s in args.skip_prefixes.split(",") if s],
         watch_cache_capacity=args.watch_cache_size,
+        enable_etcd_compatibility=not args.disable_etcd_compatibility,
         fanout_matcher=fanout,
     ))
 

@@ -129,3 +129,29 @@ def test_brain_watch_and_compact(brain):
 
     done = c.compact(brain_pb2.BrainCompactRequest(revision=backend.current_revision()))
     assert done.compacted_revision == backend.current_revision()
+
+
+def test_background_compact_loop():
+    """The leader's periodic compaction actually runs and advances the
+    watermark (reference brain/server.go:64-74, 60s loop, keep-1000)."""
+    import time
+
+    from kubebrain_tpu.backend import Backend, BackendConfig
+    from kubebrain_tpu.server.brain import BrainServer
+    from kubebrain_tpu.storage import new_storage
+
+    store = new_storage("memkv")
+    b = Backend(store, BackendConfig(event_ring_capacity=4096))
+    srv = BrainServer(b, peers=None, compact_interval=0.2, compact_keep=5)
+    K = b"/registry/loop/a"
+    rev = b.create(K, b"v0")
+    for i in range(20):
+        rev = b.update(K, b"v%d" % (i + 1), rev)
+    srv.start_background()
+    deadline = time.time() + 10
+    while time.time() < deadline and b.compact_revision() == 0:
+        time.sleep(0.05)
+    assert b.compact_revision() >= rev - 5 - 1
+    srv.close()
+    b.close()
+    store.close()
