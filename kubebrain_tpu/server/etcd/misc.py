@@ -28,6 +28,22 @@ class LeaseService:
             TTL=request.TTL,
         )
 
+    def LeaseRevoke(self, request, context) -> rpc_pb2.LeaseRevokeResponse:
+        # nothing to revoke: TTLs live on the keys, not on lease state
+        return rpc_pb2.LeaseRevokeResponse(
+            header=shim.header(self.backend.current_revision())
+        )
+
+    def LeaseKeepAlive(self, request_iterator, context):
+        # keepalives are acknowledged verbatim (TTL enforcement is by key
+        # pattern; the stream exists so lease-holding clients don't error)
+        for req in request_iterator:
+            yield rpc_pb2.LeaseKeepAliveResponse(
+                header=shim.header(self.backend.current_revision()),
+                ID=req.ID,
+                TTL=req.ID,
+            )
+
 
 class ClusterService:
     def __init__(self, backend, identity: str = "kubebrain-tpu", client_urls=None):
@@ -55,3 +71,46 @@ class MaintenanceService:
             raftIndex=self.backend.current_revision(),
             raftTerm=1,
         )
+
+    def Defragment(self, request, context) -> rpc_pb2.DefragmentResponse:
+        """etcd defrag ≈ our checkpoint: rewrite a latest-only snapshot and
+        truncate the WAL (no-op for engines without durability)."""
+        store = self.backend.store
+        checkpoint = getattr(getattr(store, "_inner", store), "checkpoint", None)
+        if checkpoint is None:
+            checkpoint = getattr(store, "checkpoint", None)
+        if checkpoint is not None:
+            checkpoint()
+        return rpc_pb2.DefragmentResponse(
+            header=shim.header(self.backend.current_revision())
+        )
+
+    SNAPSHOT_CHUNK = 1 << 20
+
+    def Snapshot(self, request, context):
+        """Stream a consistent backup (etcdctl snapshot save): a
+        length-framed dump of the live keyspace at the current revision —
+        engine-portable (restorable into any engine by replaying creates)."""
+        import io
+
+        buf = io.BytesIO()
+        rev = self.backend.current_revision()
+        buf.write(b"KBSNAP1" + rev.to_bytes(8, "big"))
+        res = self.backend.list_(b"", b"", revision=0)
+        for kv in res.kvs:
+            buf.write(len(kv.key).to_bytes(4, "big"))
+            buf.write(kv.key)
+            buf.write(len(kv.value).to_bytes(4, "big"))
+            buf.write(kv.value)
+            buf.write(kv.revision.to_bytes(8, "big"))
+        blob = buf.getvalue()
+        total = len(blob)
+        sent = 0
+        while sent < total:
+            chunk = blob[sent : sent + self.SNAPSHOT_CHUNK]
+            sent += len(chunk)
+            yield rpc_pb2.SnapshotResponse(
+                header=shim.header(rev),
+                remaining_bytes=total - sent,
+                blob=chunk,
+            )

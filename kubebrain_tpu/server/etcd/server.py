@@ -52,11 +52,19 @@ def make_etcd_handlers(backend, peers=None, identity="kubebrain-tpu", client_url
         }),
         grpc.method_handlers_generic_handler("etcdserverpb.Lease", {
             "LeaseGrant": _unary(lease.LeaseGrant, p.LeaseGrantRequest, p.LeaseGrantResponse),
+            "LeaseRevoke": _unary(lease.LeaseRevoke, p.LeaseRevokeRequest, p.LeaseRevokeResponse),
+            "LeaseKeepAlive": _bidi(lease.LeaseKeepAlive, p.LeaseKeepAliveRequest, p.LeaseKeepAliveResponse),
         }),
         grpc.method_handlers_generic_handler("etcdserverpb.Cluster", {
             "MemberList": _unary(cluster.MemberList, p.MemberListRequest, p.MemberListResponse),
         }),
         grpc.method_handlers_generic_handler("etcdserverpb.Maintenance", {
             "Status": _unary(maint.Status, p.StatusRequest, p.StatusResponse),
+            "Defragment": _unary(maint.Defragment, p.DefragmentRequest, p.DefragmentResponse),
+            "Snapshot": grpc.unary_stream_rpc_method_handler(
+                maint.Snapshot,
+                request_deserializer=p.SnapshotRequest.FromString,
+                response_serializer=p.SnapshotResponse.SerializeToString,
+            ),
         }),
     ]

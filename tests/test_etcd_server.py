@@ -367,3 +367,44 @@ def test_http_status_and_health(server):
         assert json.loads(resp.read())["health"] == "true"
     with urllib.request.urlopen(f"http://127.0.0.1:{args.info_port}/metrics", timeout=5) as resp:
         assert resp.status == 200
+
+
+def test_maintenance_snapshot_and_defrag(server):
+    client, backend, _ = server
+    client.create(b"/registry/snapme/a", b"payload-a")
+    client.create(b"/registry/snapme/b", b"payload-b")
+    snap = client.ch.unary_stream(
+        "/etcdserverpb.Maintenance/Snapshot",
+        request_serializer=rpc_pb2.SnapshotRequest.SerializeToString,
+        response_deserializer=rpc_pb2.SnapshotResponse.FromString,
+    )
+    blob = b""
+    for resp in snap(rpc_pb2.SnapshotRequest()):
+        blob += resp.blob
+        last_remaining = resp.remaining_bytes
+    assert last_remaining == 0
+    assert blob.startswith(b"KBSNAP1")
+    assert b"/registry/snapme/a" in blob and b"payload-b" in blob
+    defrag = client.ch.unary_unary(
+        "/etcdserverpb.Maintenance/Defragment",
+        request_serializer=rpc_pb2.DefragmentRequest.SerializeToString,
+        response_deserializer=rpc_pb2.DefragmentResponse.FromString,
+    )
+    assert defrag(rpc_pb2.DefragmentRequest()).header.revision > 0
+
+
+def test_lease_keepalive_and_revoke(server):
+    client, _, _ = server
+    ka = client.ch.stream_stream(
+        "/etcdserverpb.Lease/LeaseKeepAlive",
+        request_serializer=rpc_pb2.LeaseKeepAliveRequest.SerializeToString,
+        response_deserializer=rpc_pb2.LeaseKeepAliveResponse.FromString,
+    )
+    resp = next(ka(iter([rpc_pb2.LeaseKeepAliveRequest(ID=3600)])))
+    assert resp.ID == 3600 and resp.TTL == 3600
+    revoke = client.ch.unary_unary(
+        "/etcdserverpb.Lease/LeaseRevoke",
+        request_serializer=rpc_pb2.LeaseRevokeRequest.SerializeToString,
+        response_deserializer=rpc_pb2.LeaseRevokeResponse.FromString,
+    )
+    assert revoke(rpc_pb2.LeaseRevokeRequest(ID=3600)).header is not None
