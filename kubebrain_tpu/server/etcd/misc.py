@@ -76,41 +76,45 @@ class MaintenanceService:
         """etcd defrag ≈ our checkpoint: rewrite a latest-only snapshot and
         truncate the WAL (no-op for engines without durability)."""
         store = self.backend.store
-        checkpoint = getattr(getattr(store, "_inner", store), "checkpoint", None)
-        if checkpoint is None:
+        # engines hide behind decorator stacks (metrics → tpu → native):
+        # walk down until something offers a checkpoint
+        checkpoint = None
+        seen = set()
+        while store is not None and id(store) not in seen:
+            seen.add(id(store))
             checkpoint = getattr(store, "checkpoint", None)
+            if checkpoint is not None:
+                break
+            store = getattr(store, "_inner", None)
         if checkpoint is not None:
             checkpoint()
         return rpc_pb2.DefragmentResponse(
             header=shim.header(self.backend.current_revision())
         )
 
-    SNAPSHOT_CHUNK = 1 << 20
-
     def Snapshot(self, request, context):
         """Stream a consistent backup (etcdctl snapshot save): a
-        length-framed dump of the live keyspace at the current revision —
-        engine-portable (restorable into any engine by replaying creates)."""
-        import io
-
-        buf = io.BytesIO()
-        rev = self.backend.current_revision()
-        buf.write(b"KBSNAP1" + rev.to_bytes(8, "big"))
-        res = self.backend.list_(b"", b"", revision=0)
-        for kv in res.kvs:
-            buf.write(len(kv.key).to_bytes(4, "big"))
-            buf.write(kv.key)
-            buf.write(len(kv.value).to_bytes(4, "big"))
-            buf.write(kv.value)
-            buf.write(kv.revision.to_bytes(8, "big"))
-        blob = buf.getvalue()
-        total = len(blob)
-        sent = 0
-        while sent < total:
-            chunk = blob[sent : sent + self.SNAPSHOT_CHUNK]
-            sent += len(chunk)
+        length-framed dump of the keyspace AT the header revision —
+        engine-portable (restorable into any engine by replaying creates),
+        streamed batch-by-batch so the keyspace never materializes in full
+        (backend.list_by_stream)."""
+        rev, stream = self.backend.list_by_stream(b"", b"")
+        pending = b"KBSNAP1" + rev.to_bytes(8, "big")
+        for batch in stream:
+            frames = [pending]
+            for kv in batch:
+                frames.append(len(kv.key).to_bytes(4, "big"))
+                frames.append(kv.key)
+                frames.append(len(kv.value).to_bytes(4, "big"))
+                frames.append(kv.value)
+                frames.append(kv.revision.to_bytes(8, "big"))
+            payload = b"".join(frames)
+            pending = b""
             yield rpc_pb2.SnapshotResponse(
                 header=shim.header(rev),
-                remaining_bytes=total - sent,
-                blob=chunk,
+                remaining_bytes=1,  # progress hint; exact total unknown while streaming
+                blob=payload,
             )
+        yield rpc_pb2.SnapshotResponse(
+            header=shim.header(rev), remaining_bytes=0, blob=pending
+        )

@@ -407,4 +407,4 @@ def test_lease_keepalive_and_revoke(server):
         request_serializer=rpc_pb2.LeaseRevokeRequest.SerializeToString,
         response_deserializer=rpc_pb2.LeaseRevokeResponse.FromString,
     )
-    assert revoke(rpc_pb2.LeaseRevokeRequest(ID=3600)).header is not None
+    assert revoke(rpc_pb2.LeaseRevokeRequest(ID=3600)).header.revision > 0
