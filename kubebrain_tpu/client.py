@@ -209,6 +209,7 @@ class EtcdCompatClient:
         req.create_request.prev_kv = prev_kv
         requests.put(req)
         responses = self._watch(iter(requests.get, None))
+        rpc_error = grpc.RpcError  # closure-bound: survives module teardown
 
         def events():
             try:
@@ -223,7 +224,7 @@ class EtcdCompatClient:
                             else None
                         )
                         yield kind, ClientKV(ev.kv.key, ev.kv.value, ev.kv.mod_revision), prev
-            except grpc.RpcError:
+            except rpc_error:
                 return
 
         def cancel():
