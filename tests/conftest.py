@@ -25,3 +25,9 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+# hang self-diagnosis: if a run wedges (shared CI box, subprocess tests),
+# dump every thread's stack after 8 minutes so the stall is attributable
+import faulthandler  # noqa: E402
+
+faulthandler.dump_traceback_later(480, repeat=True)
