@@ -1,0 +1,40 @@
+"""Client library walkthrough (run a server first — see README.md)."""
+
+from kubebrain_tpu.client import BrainClient, EtcdCompatClient
+
+ENDPOINT = "127.0.0.1:2379"
+
+
+def etcd_compat():
+    c = EtcdCompatClient(ENDPOINT)
+    ok, rev = c.create(b"/registry/demo/pod-1", b'{"spec": 1}')
+    assert ok
+    ok, rev = c.update(b"/registry/demo/pod-1", b'{"spec": 2}', rev)  # CAS on mod revision
+
+    events, cancel = c.watch(b"/registry/demo/", b"/registry/demo0", prev_kv=True)
+    c.create(b"/registry/demo/pod-2", b"{}")
+    kind, kv, prev = next(events)
+    print("watched:", kind, kv.key, kv.mod_revision)
+    cancel()
+
+    kvs, list_rev = c.list(b"/registry/demo/", b"/registry/demo0", page=500)
+    print("list:", [(kv.key, kv.mod_revision) for kv in kvs], "at", list_rev)
+
+    # huge ranges: one stream per storage partition, merged in key order
+    for kv in c.parallel_list(b"/registry/demo/", b"/registry/demo0"):
+        print("par:", kv.key)
+    c.close()
+
+
+def native_protocol():
+    b = BrainClient(ENDPOINT)
+    ok, rev = b.create(b"/registry/demo/native", b"payload")
+    print("brain create:", ok, rev)
+    print("count:", b.count(b"/registry/demo/", b"/registry/demo0"))
+    print("partitions:", b.list_partition(b"/registry/demo/", b"/registry/demo0"))
+    b.close()
+
+
+if __name__ == "__main__":
+    etcd_compat()
+    native_protocol()

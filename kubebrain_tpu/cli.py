@@ -30,6 +30,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--data-dir", default="",
                    help="durable storage dir for the native engine (WAL + "
                         "snapshot); empty = in-memory")
+    p.add_argument("--native-partitions", type=int, default=4,
+                   help="partition count the native engine samples for "
+                        "partition-parallel host scans")
     p.add_argument("--fsync", action="store_true",
                    help="fsync the WAL on every commit")
     p.add_argument("--host", default="0.0.0.0")
@@ -111,9 +114,9 @@ def build_endpoint(args):
     from .util.net import get_host
 
     metrics = new_metrics(args.cluster_name)
-    native_kw = {}
+    native_kw = {"partitions": args.native_partitions}
     if getattr(args, "data_dir", ""):
-        native_kw = {"data_dir": args.data_dir, "fsync": args.fsync}
+        native_kw.update({"data_dir": args.data_dir, "fsync": args.fsync})
     if args.storage == "tpu":
         inner_kw = native_kw if args.inner_storage == "native" else {}
         store = new_storage("tpu", inner=args.inner_storage, **inner_kw)
