@@ -66,13 +66,30 @@ class FanoutMatcher:
 
     Callable as (events, [(wid, start, end, min_rev)]) -> bool[E][W] (the
     hub's ``fanout_matcher`` hook). Re-packs the watcher table only when the
-    watcher set changes; event batches are packed per call.
+    watcher set changes; event batches are packed per call. With a mesh, the
+    watcher table lives sharded across devices (the watcher axis is the
+    large, shardable side at 10k watchers — SURVEY P4) and GSPMD computes
+    the (E × W) mask shard-locally.
     """
 
-    def __init__(self, width: int = keyops.KEY_WIDTH):
+    def __init__(self, width: int = keyops.KEY_WIDTH, mesh=None):
         self._width = width
+        self._mesh = mesh
         self._cache_key: tuple | None = None
         self._cached = None
+
+    def _put_watcher(self, arr):
+        a = jnp.asarray(arr)
+        if self._mesh is None:
+            return a
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        n_dev = int(np.prod(self._mesh.devices.shape))
+        if arr.shape[0] % n_dev != 0:
+            return a  # ragged watcher count: stay unsharded
+        axis = self._mesh.axis_names[0]
+        spec = PartitionSpec(axis, *(None,) * (a.ndim - 1))
+        return jax.device_put(a, NamedSharding(self._mesh, spec))
 
     def _watcher_table(self, specs: list[tuple[int, bytes, bytes, int]]):
         cache_key = tuple(specs)
@@ -88,8 +105,9 @@ class FanoutMatcher:
             unbounded = np.array([not e for _, _, e, _ in specs])
             hi, lo = keyops.split_revs(np.array([r for _, _, _, r in specs], dtype=np.uint64))
             self._cached = (
-                jnp.asarray(starts), jnp.asarray(ends), jnp.asarray(unbounded),
-                jnp.asarray(hi), jnp.asarray(lo),
+                self._put_watcher(starts), self._put_watcher(ends),
+                self._put_watcher(unbounded),
+                self._put_watcher(hi), self._put_watcher(lo),
             )
             self._cache_key = cache_key
         return self._cached

@@ -66,3 +66,24 @@ def test_backend_with_vectorized_fanout():
     assert [e.key for e in batch] == [b"/registry/pods/a"]
     b.close()
     store.close()
+
+
+def test_matcher_with_sharded_watcher_table():
+    """The watcher table sharded over the mesh produces identical masks."""
+    from kubebrain_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh()
+    plain = FanoutMatcher()
+    sharded = FanoutMatcher(mesh=mesh)
+    specs = [
+        (i, b"/registry/ns%02d/" % (i % 16), coder.prefix_end(b"/registry/ns%02d/" % (i % 16)), 0)
+        for i in range(64)  # divisible by the 8-device mesh
+    ]
+    events = [
+        WatchEvent(revision=i + 1, key=b"/registry/ns%02d/pod" % (i % 16))
+        for i in range(32)
+    ]
+    m1 = plain(events, specs)
+    m2 = sharded(events, specs)
+    assert (np.asarray(m1) == np.asarray(m2)).all()
+    assert np.asarray(m2).sum() > 0
