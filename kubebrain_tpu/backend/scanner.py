@@ -439,4 +439,11 @@ class Scanner:
                 stats.deleted_rev_records += 1
             except CASFailedError:
                 continue  # key was rewritten since the scan: skip
+        # engine-level history pruning: logical deletes above only append
+        # markers; physically free chains invisible to snapshots taken after
+        # the GC (fresh clock — the pre-GC snapshot would spare the GC's own
+        # markers). No-op for engines without the capability.
+        pruner = getattr(store, "prune_versions", None)
+        if pruner is not None:
+            pruner(store.get_timestamp_oracle())
         return stats

@@ -19,6 +19,8 @@ class MetricsKvStorage(KvStorage):
             self.mvcc_write = self._mvcc_write_timed
         if hasattr(inner, "mvcc_delete"):
             self.mvcc_delete = self._mvcc_delete_timed
+        if hasattr(inner, "prune_versions"):
+            self.prune_versions = inner.prune_versions
 
     def _mvcc_write_timed(self, *args, **kwargs):
         with self._m.timed("storage.mvcc_write"):

@@ -94,6 +94,10 @@ def _load_lib() -> ctypes.CDLL:
         ]
         lib.kb_key_count.argtypes = [ctypes.c_void_p]
         lib.kb_key_count.restype = ctypes.c_uint64
+        lib.kb_version_count.argtypes = [ctypes.c_void_p]
+        lib.kb_version_count.restype = ctypes.c_uint64
+        lib.kb_prune.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        lib.kb_prune.restype = ctypes.c_uint64
         lib.kb_mvcc_export_stats.argtypes = [
             ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t,
             ctypes.c_char_p, ctypes.c_size_t, ctypes.c_uint64,
@@ -206,6 +210,14 @@ class NativeKv(KvStorage):
 
     def key_count(self) -> int:
         return int(self._lib.kb_key_count(self._store))
+
+    def version_count(self) -> int:
+        return int(self._lib.kb_version_count(self._store))
+
+    def prune_versions(self, keep_after_ts: int) -> int:
+        """Physically free version history invisible to snapshots >=
+        keep_after_ts; returns versions freed."""
+        return int(self._lib.kb_prune(self._store, keep_after_ts))
 
     def mvcc_write(
         self,

@@ -454,6 +454,12 @@ class TpuScanner(Scanner):
                 batch.delete(k)
             batch.commit()
 
+        # engine-level history pruning (see generic scanner): free version
+        # chains the logical GC deletes above made unreachable
+        pruner = getattr(store, "prune_versions", None)
+        if pruner is not None:
+            pruner(store.get_timestamp_oracle())
+
         # shrink the mirror in place from the surviving rows + any delta
         with self._mlock:
             if self._mirror is mirror:

@@ -509,6 +509,54 @@ uint64_t kb_key_count(void* s) {
   return st->data.size();
 }
 
+uint64_t kb_version_count(void* s) {
+  Store* st = static_cast<Store*>(s);
+  std::shared_lock<std::shared_mutex> lock(st->mu);
+  uint64_t n = 0;
+  for (const auto& e : st->data) n += e.second.size();
+  return n;
+}
+
+// Physically free version-chain history: for every key, drop versions
+// superseded before ``keep_after_ts`` (invisible to any snapshot >=
+// keep_after_ts) and erase keys whose only remaining state is a deletion at
+// or before it. Safe because engine snapshots are consumed synchronously
+// under the store lock (iterators buffer at open), so no reader can hold a
+// snapshot older than the writer-lock acquisition here. Returns versions
+// freed. (MVCC-layer compaction issues logical deletes; without this the
+// version vectors grow forever on a long-running server.)
+uint64_t kb_prune(void* s, uint64_t keep_after_ts) {
+  Store* st = static_cast<Store*>(s);
+  std::unique_lock<std::shared_mutex> lock(st->mu);
+  double now = wallclock();
+  uint64_t freed = 0;
+  for (auto it = st->data.begin(); it != st->data.end();) {
+    auto& versions = it->second;
+    // newest version with ts <= keep_after_ts: everything older is invisible
+    size_t last_visible = versions.size();
+    for (size_t i = 0; i < versions.size(); ++i) {
+      if (versions[i].ts <= keep_after_ts) last_visible = i;
+    }
+    if (last_visible != versions.size() && last_visible > 0) {
+      versions.erase(versions.begin(), versions.begin() + last_visible);
+      freed += last_visible;
+    }
+    // fully-dead key: single remaining version is a delete/expired at cutoff
+    bool dead = true;
+    for (const auto& v : versions) {
+      if (v.ts > keep_after_ts) { dead = false; break; }
+      if (!v.deleted && !(v.expire_at != 0 && now >= v.expire_at)) { dead = false; break; }
+    }
+    if (dead && !versions.empty()) {
+      freed += versions.size();
+      it = st->data.erase(it);
+    } else {
+      ++it;
+    }
+  }
+  return freed;
+}
+
 // ------------------------------------------------------------- MVCC write
 // The hot write path as ONE native call (conditional revision-record write +
 // object row + last-revision watermark, atomically): the Python MVCC layer
