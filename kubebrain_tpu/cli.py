@@ -59,6 +59,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--secure-only", action="store_true",
                    help="with TLS configured, refuse plaintext clients "
                         "(reference endpoint secure modes, config.go:159)")
+    p.add_argument("--grpc-workers", type=int, default=256,
+                   help="gRPC worker threads; each open watch stream holds one")
     p.add_argument("--cluster-name", default="")
     p.add_argument("--compact-interval", type=float, default=60.0)
     p.add_argument("--jax-platform", default=os.environ.get("KB_JAX_PLATFORM", ""),
@@ -164,6 +166,7 @@ def build_endpoint(args):
         key_file=args.key_file,
         ca_file=args.ca_file,
         insecure=not args.secure_only,
+        grpc_workers=args.grpc_workers,
     ))
     return endpoint, backend, store
 

@@ -79,7 +79,10 @@ class EndpointConfig:
     key_file: str = ""
     ca_file: str = ""
     insecure: bool = True  # also serve plaintext when certs are configured
-    grpc_workers: int = 32
+    # the sync gRPC stack holds one worker thread per ACTIVE stream (every
+    # open Watch); kube-apiserver keeps dozens of watch streams open, so the
+    # pool must be sized well above the expected stream count
+    grpc_workers: int = 256
     extra_http: dict = field(default_factory=dict)
 
 
