@@ -107,6 +107,9 @@ def build_endpoint(args):
     """Dependency wiring (reference KubeBrainOption.Run, option.go:230-259):
     storage → [metrics decorator] → backend → server → endpoint."""
     validate_args(args)
+    # must happen before anything imports jax (embedding callers reach here
+    # without going through main())
+    apply_jax_platform(args.jax_platform)
     from .backend import Backend, BackendConfig
     from .endpoint import Endpoint, EndpointConfig
     from .metrics import new_metrics
@@ -177,7 +180,6 @@ def main(argv=None) -> int:
         print(f"kubebrain-tpu {__version__} (storage engines: memkv, tpu, native)")
         return 0
 
-    apply_jax_platform(args.jax_platform)
     endpoint, backend, store = build_endpoint(args)
     stop = threading.Event()
     watchdog: list[threading.Timer] = []
