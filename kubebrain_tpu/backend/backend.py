@@ -160,13 +160,14 @@ class Backend:
         batch.put(LAST_REV_KEY, last_val)
         batch.commit()
 
-    def create(self, user_key: bytes, value: bytes) -> int:
+    def create(self, user_key: bytes, value: bytes, ttl: int | None = None) -> int:
         """Insert; returns the new revision. KeyExistsError carries the live
-        revision on conflict. Reference txn.go:33 + creator/naive.go:53."""
+        revision on conflict. Reference txn.go:33 + creator/naive.go:53.
+        ``ttl`` overrides the key-pattern TTL (etcd lease attachment)."""
         rev = self.tso.deal()
         event = WatchEvent(revision=rev, verb=Verb.CREATE, key=user_key, value=value, valid=False)
         try:
-            creator.create(self._commit_write, user_key, value, rev)
+            creator.create(self._commit_write, user_key, value, rev, ttl=ttl)
             event.valid = True
             return rev
         except UncertainResultError as e:
@@ -177,7 +178,9 @@ class Backend:
             self._notify(event)
             self.tso.wait_committed(rev, timeout=5.0)
 
-    def update(self, user_key: bytes, value: bytes, expected_revision: int) -> int:
+    def update(
+        self, user_key: bytes, value: bytes, expected_revision: int, ttl: int | None = None
+    ) -> int:
         """Conditional overwrite: CAS(revision_key, expected→new) + Put(object).
         Reference txn.go:193-265. On revision mismatch raises
         CASRevisionMismatchError carrying the latest (revision, value) —
@@ -187,7 +190,7 @@ class Backend:
             revision=rev, verb=Verb.PUT, key=user_key, value=value,
             prev_revision=expected_revision, valid=False,
         )
-        ttl = creator.ttl_for_key(user_key)
+        ttl = creator.ttl_for_key(user_key) if ttl is None else ttl
         try:
             if rev <= expected_revision:
                 # drift-back anomaly (reference txn.go:171-175): the dealt

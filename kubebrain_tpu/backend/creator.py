@@ -35,10 +35,11 @@ def ttl_for_key(user_key: bytes) -> int:
     return EVENTS_TTL_SECONDS if user_key.startswith(EVENTS_TTL_PREFIX) else 0
 
 
-def create(commit_write, user_key: bytes, value: bytes, revision: int) -> None:
+def create(commit_write, user_key: bytes, value: bytes, revision: int, ttl: int | None = None) -> None:
     """Insert ``user_key``=``value`` at ``revision``; raises KeyExistsError
-    (with the live revision) or propagates engine errors (incl. uncertain)."""
-    ttl = ttl_for_key(user_key)
+    (with the live revision) or propagates engine errors (incl. uncertain).
+    ``ttl`` (etcd lease attachment) overrides the key-pattern TTL."""
+    ttl = ttl_for_key(user_key) if ttl is None else ttl
     new_record = coder.encode_rev_value(revision)
     for _attempt in range(2):
         try:

@@ -151,13 +151,13 @@ class KVService:
                 return fwd
             context.abort(grpc.StatusCode.UNAVAILABLE, "etcdserver: not leader")
         m = self._match(request, context)
-        kind, key, guard_rev, value = m
+        kind, key, guard_rev, value, ttl = m
         try:
             if kind == "create":
-                rev = self.backend.create(key, value)
+                rev = self.backend.create(key, value, ttl=ttl)
                 return self._txn_ok(rev, put=True)
             if kind == "update":
-                rev = self.backend.update(key, value, guard_rev)
+                rev = self.backend.update(key, value, guard_rev, ttl=ttl)
                 return self._txn_ok(rev, put=True)
             # delete
             rev, prev = self.backend.delete(key, guard_rev)
@@ -197,11 +197,16 @@ class KVService:
             if op.request_put.key != cmp.key:
                 context.abort(grpc.StatusCode.UNIMPLEMENTED, "etcdserver: key mismatch")
             kind = "create" if guard == 0 else "update"
-            return kind, bytes(op.request_put.key), int(guard), bytes(op.request_put.value)
+            # lease attachment: our LeaseGrant returns ID := TTL, so the lease
+            # id on a put IS its TTL in seconds (covers apiserver masterleases
+            # and events uniformly — broader than the reference's /events/
+            # key-pattern TTL, lease.go:24-31)
+            ttl = int(op.request_put.lease) if op.request_put.lease > 0 else None
+            return kind, bytes(op.request_put.key), int(guard), bytes(op.request_put.value), ttl
         if which == "request_delete_range":
             if op.request_delete_range.key != cmp.key:
                 context.abort(grpc.StatusCode.UNIMPLEMENTED, "etcdserver: key mismatch")
-            return "delete", bytes(op.request_delete_range.key), int(guard), b""
+            return "delete", bytes(op.request_delete_range.key), int(guard), b"", None
         context.abort(
             grpc.StatusCode.UNIMPLEMENTED, "etcdserver: unsupported transaction op"
         )

@@ -460,3 +460,41 @@ def test_snapshot_save_restore_roundtrip(server, tmp_path):
         ep2.close()
         be2.close()
         st2.close()
+
+
+def test_lease_attached_put_gets_ttl():
+    """A put with a lease expires: our LeaseGrant contract makes the lease
+    id the TTL, so lease-attached keys (apiserver masterleases, events) age
+    out — broader than the reference's /events/-pattern TTL."""
+    import time as _time
+
+    port = free_port()
+    args = build_parser().parse_args([
+        "--single-node", "--storage", "native", "--host", "127.0.0.1",
+        "--client-port", str(port),
+        "--peer-port", str(free_port()), "--info-port", str(free_port()),
+    ])
+    endpoint, backend, store = build_endpoint(args)
+    endpoint.run()
+    client = EtcdClient(f"127.0.0.1:{port}")
+    try:
+        lg = client.lease_grant(rpc_pb2.LeaseGrantRequest(TTL=1))
+        req = rpc_pb2.TxnRequest()
+        c = req.compare.add()
+        c.result, c.target, c.key, c.mod_revision = (
+            rpc_pb2.Compare.EQUAL, rpc_pb2.Compare.MOD, b"/registry/masterleases/1.2.3.4", 0,
+        )
+        req.success.add().request_put.CopyFrom(rpc_pb2.PutRequest(
+            key=b"/registry/masterleases/1.2.3.4", value=b"lease-me", lease=lg.ID,
+        ))
+        assert client.txn(req).succeeded
+        r = client.range_(rpc_pb2.RangeRequest(key=b"/registry/masterleases/1.2.3.4"))
+        assert r.count == 1
+        _time.sleep(1.2)
+        r = client.range_(rpc_pb2.RangeRequest(key=b"/registry/masterleases/1.2.3.4"))
+        assert r.count == 0  # expired with the lease TTL
+    finally:
+        client.close()
+        endpoint.close()
+        backend.close()
+        store.close()
