@@ -129,12 +129,18 @@ class WatcherHub:
             return
 
         if self._fanout_matcher is not None and len(subs) * len(batch) >= 4096:
+            import numpy as np
+
             watcher_specs = [(wid, *filters[wid]) for wid, _ in subs]
-            mask = self._fanout_matcher(batch, watcher_specs)  # bool[E, W]
-            per_watcher = {
-                wid: [batch[e] for e in range(len(batch)) if mask[e][w]]
-                for w, (wid, _q) in enumerate(subs)
-            }
+            mask = np.asarray(self._fanout_matcher(batch, watcher_specs))  # bool[E, W]
+            # deliver ∝ matches, not E*W: most watchers match nothing in a
+            # given batch, so only touch columns with hits
+            col_hits = np.nonzero(mask.any(axis=0))[0]
+            per_watcher = {}
+            for w in col_hits:
+                wid = subs[int(w)][0]
+                rows = np.nonzero(mask[:, w])[0]
+                per_watcher[wid] = [batch[int(e)] for e in rows]
         else:
             per_watcher = {}
             for wid, _q in subs:
