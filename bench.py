@@ -43,6 +43,10 @@ def _probe_tpu_alive(timeout: float = 90.0) -> bool:
 
 def _force_cpu() -> None:
     os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        # virtual 8-device mesh so the sharded paths mean something on CPU
+        os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
     import jax
 
     jax.config.update("jax_platforms", "cpu")
@@ -357,6 +361,63 @@ def bench_delete() -> None:
     }))
 
 
+def bench_grpc_list() -> None:
+    """BASELINE config 1: etcd3 Range over 10k /registry/pods/* keys through
+    the live gRPC surface (the CPU-baseline config)."""
+    import socket
+    import subprocess
+
+    from kubebrain_tpu.client import EtcdCompatClient
+
+    def free_port():
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        p = s.getsockname()[1]
+        s.close()
+        return p
+
+    n_keys = int(os.environ.get("KB_BENCH_KEYS", 10_000))
+    iters = int(os.environ.get("KB_BENCH_ITERS", 10))
+    port = free_port()
+    server = subprocess.Popen(
+        [sys.executable, "-m", "kubebrain_tpu.cli", "--single-node",
+         "--storage", "native", "--host", "127.0.0.1",
+         "--client-port", str(port),
+         "--peer-port", str(free_port()), "--info-port", str(free_port())],
+        cwd=os.path.dirname(os.path.abspath(__file__)), stderr=subprocess.DEVNULL,
+    )
+    c = EtcdCompatClient(f"127.0.0.1:{port}")
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        try:
+            c.count(b"/x", b"/y")
+            break
+        except Exception:
+            time.sleep(0.2)
+    value = b"x" * 512
+    for i in range(n_keys):
+        c.create(b"/registry/pods/default/pod-%06d" % i, value)
+    lat = []
+    for _ in range(iters):
+        t0 = time.time()
+        kvs, _ = c.list(b"/registry/pods/", b"/registry/pods0", page=1000)
+        lat.append(time.time() - t0)
+        assert len(kvs) == n_keys
+    c.close()
+    server.terminate()
+    server.wait(timeout=10)
+    p50 = sorted(lat)[len(lat) // 2]
+    rate = n_keys / p50
+    print(json.dumps({
+        "metric": "grpc list keys/sec",
+        "value": round(rate),
+        "unit": "keys/sec",
+        "vs_baseline": 1.0,  # this IS the CPU-baseline config
+        "detail": {"keys": n_keys, "list_p50_ms": round(p50 * 1e3, 2),
+                   "value_bytes": 512, "paged": 1000},
+    }))
+
+
 def bench_grpc_insert() -> None:
     """Over-the-wire insert throughput: concurrent etcd3 clients against a
     live endpoint (the reference's benchmark methodology: 300 concurrent
@@ -528,6 +589,8 @@ def main() -> None:
         return bench_delete()
     if metric == "grpc-insert":
         return bench_grpc_insert()
+    if metric == "grpc-list":
+        return bench_grpc_list()
     if metric == "sim":
         return bench_sim()
 
@@ -559,7 +622,53 @@ def main() -> None:
           f"(visible {cpu_visible})", file=sys.stderr)
 
     # ---- device kernel (jnp/XLA by default; KB_BENCH_PALLAS=1 for the
-    # explicit chunk-major Pallas kernel)
+    # explicit chunk-major Pallas kernel; KB_BENCH_SHARDED=1 shards rows
+    # over the full device mesh — BASELINE config 4's mesh-sharded scan)
+    use_sharded = os.environ.get("KB_BENCH_SHARDED") == "1"
+    if use_sharded:
+        from kubebrain_tpu.ops.scan import visibility_mask as _vis
+        from kubebrain_tpu.parallel.mesh import make_mesh, replicate, shard_rows
+
+        mesh = make_mesh()
+        n_dev = len(mesh.devices.reshape(-1))
+        rows_per = (n // n_dev) // 8 * 8
+        usable = rows_per * n_dev
+        part = lambda a: shard_rows(mesh, a[:usable].reshape(n_dev, rows_per))
+        keys_s = shard_rows(mesh, chunks[:usable].reshape(n_dev, rows_per, CHUNKS))
+        rh_s, rl_s, tomb_s = part(rh), part(rl), part(tomb)
+        nv = jax.device_put(
+            np.full(n_dev, rows_per, np.int32),
+            jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec("part")),
+        )
+        s_r, e_r = replicate(mesh, start), replicate(mesh, end)
+
+        @jax.jit
+        def sharded_count(k, a, b, t, num):
+            f = lambda kk, aa, bb, tt, nn: _vis(
+                kk, aa, bb, tt, nn, s_r, e_r, jnp.asarray(False), qhi, qlo
+            )
+            return jnp.sum(jax.vmap(f)(k, a, b, t, num), dtype=jnp.int32)
+
+        out = sharded_count(keys_s, rh_s, rl_s, tomb_s, nv)
+        out.block_until_ready()
+        lat = []
+        for _ in range(iters):
+            t0 = time.time()
+            sharded_count(keys_s, rh_s, rl_s, tomb_s, nv).block_until_ready()
+            lat.append(time.time() - t0)
+        p50 = sorted(lat)[len(lat) // 2]
+        rate = usable / p50
+        print(json.dumps({
+            "metric": "sharded range-scan keys/sec",
+            "value": round(rate),
+            "unit": "rows/sec",
+            "vs_baseline": round(rate / cpu_rate, 3),
+            "detail": {"rows": usable, "devices": n_dev,
+                       "scan_p50_ms": round(p50 * 1e3, 2),
+                       "cpu_numpy_rows_per_sec": round(cpu_rate)},
+        }))
+        return
+
     use_pallas = os.environ.get("KB_BENCH_PALLAS") == "1"
     if use_pallas:
         from kubebrain_tpu.ops import scan_pallas as sp
