@@ -16,13 +16,14 @@ class Verb(enum.IntEnum):
     DELETE = 2
 
 
-@dataclass
+@dataclass(slots=True)
 class WatchEvent:
     """The record handed from the write path to the async event pipeline.
 
     One WatchEvent is posted for *every* allocated revision — valid or not —
     so the single sequencer can consume revisions contiguously
     (reference common.go:18-29; sequencing invariant at backend.go:208-270).
+    Slotted: the history cache holds up to 200k of these.
     """
 
     revision: int

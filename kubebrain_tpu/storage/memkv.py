@@ -154,6 +154,39 @@ class MemKv(KvStorage):
         self._versions[key].append(version)
 
     # --------------------------------------------------------------- lifecycle
+    def prune_versions(self, keep_after_ts: int) -> int:
+        """Physically free history invisible to snapshots >= keep_after_ts
+        (same contract as the native engine's kb_prune)."""
+        freed = 0
+        with self._lock:
+            now = time.time()
+            for key in list(self._versions):
+                versions = self._versions[key]
+                last_visible = None
+                for i, v in enumerate(versions):
+                    if v.ts <= keep_after_ts:
+                        last_visible = i
+                if last_visible:
+                    del versions[:last_visible]
+                    freed += last_visible
+                dead = all(
+                    v.ts <= keep_after_ts
+                    and (v.value is None
+                         or (self._ttl_supported and v.expire_at and now >= v.expire_at))
+                    for v in versions
+                )
+                if dead and versions:
+                    freed += len(versions)
+                    del self._versions[key]
+                    idx = bisect.bisect_left(self._keys, key)
+                    if idx < len(self._keys) and self._keys[idx] == key:
+                        del self._keys[idx]
+        return freed
+
+    def version_count(self) -> int:
+        with self._lock:
+            return sum(len(v) for v in self._versions.values())
+
     def support_ttl(self) -> bool:
         return self._ttl_supported
 

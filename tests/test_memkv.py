@@ -117,3 +117,20 @@ def test_ttl_expiry(store, monkeypatch):
     with pytest.raises(KeyNotFoundError):
         store.get(b"/events/e1")
     assert list(store.iter(b"/events/", b"/events0")) == []
+
+
+def test_prune_versions():
+    s = new_storage("memkv")
+    for i in range(10):
+        put(s, b"k", b"v%d" % i)
+    put(s, b"dead", b"x")
+    s.delete(b"dead")
+    ts = s.get_timestamp_oracle()
+    put(s, b"k", b"after")  # newer than the prune watermark
+    freed = s.prune_versions(ts)
+    assert freed >= 10
+    assert s.get(b"k") == b"after"
+    with pytest.raises(KeyNotFoundError):
+        s.get(b"dead")
+    assert [k for k, _ in s.iter(b"", b"")] == [b"k"]
+    s.close()
