@@ -196,15 +196,24 @@ def bench_compact() -> None:
     chi, clo = keyops.split_revs(np.array([compact_rev], dtype=np.uint64))
     thi, tlo = keyops.split_revs(np.array([0], dtype=np.uint64))
 
-    # numpy baseline: same victim rule
+    # numpy baseline: the FULL victim rule (rev compares included, same math
+    # as the kernel — no shortcuts even though this dataset's revs are all
+    # <= compact_rev)
     t0 = time.time()
-    rev_le = np.ones(n, dtype=bool)
+    c_hi, c_lo = np.uint32(chi[0]), np.uint32(clo[0])
+    rev_le = (rh < c_hi) | ((rh == c_hi) & (rl <= c_lo))
     same_next = np.zeros(n, dtype=bool)
     same_next[:-1] = (chunks[1:] == chunks[:-1]).all(axis=1)
-    superseded = same_next  # all revs <= compact_rev here
-    is_last = ~same_next
-    victims_np = superseded | (is_last & tomb)
-    keep_np = int((~victims_np).sum())
+    le_next = np.zeros(n, dtype=bool)
+    le_next[:-1] = rev_le[1:]
+    superseded = rev_le & same_next & le_next
+    is_last_le = rev_le & ~(same_next & le_next)
+    victims_np = superseded | (is_last_le & tomb)
+    # ...and the actual compaction gather, same as the device path
+    keep_idx = np.nonzero(~victims_np)[0]
+    kept_arrays = (chunks[keep_idx], rh[keep_idx], rl[keep_idx], tomb[keep_idx])
+    keep_np = len(keep_idx)
+    del kept_arrays
     cpu_dt = time.time() - t0
     cpu_rate = n / cpu_dt
 
@@ -215,7 +224,7 @@ def bench_compact() -> None:
 
     @jax.jit
     def compact_step(keys, a, b, t, x, n_valid, c1, c2, t1, t2):
-        mask = victim_mask(keys, a, b, t, x, n_valid, c1, c2, t1, t2)
+        mask = victim_mask(keys, a, b, t, x, n_valid, c1, c2, t1, t2, with_ttl=False)
         return compact_block(keys, a, b, t, mask)
 
     out = compact_step(*d, nv, *qs)

@@ -19,13 +19,15 @@ a gather, fanned out per partition over the mesh.
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 
 from .scan import rev_leq, same_as_next
 
 
-@jax.jit
+@functools.partial(jax.jit, static_argnames=("with_ttl",))
 def victim_mask(
     keys: jnp.ndarray,     # uint32[N, C] sorted packed user keys
     rev_hi: jnp.ndarray,   # uint32[N]
@@ -35,8 +37,9 @@ def victim_mask(
     n_valid: jnp.ndarray,  # int32 scalar
     compact_hi: jnp.ndarray,
     compact_lo: jnp.ndarray,
-    ttl_cutoff_hi: jnp.ndarray,  # TTL cutoff revision (0 = disabled)
+    ttl_cutoff_hi: jnp.ndarray,  # TTL cutoff revision
     ttl_cutoff_lo: jnp.ndarray,
+    with_ttl: bool = True,  # STATIC: compile out the carry when TTL is off
 ) -> jnp.ndarray:
     """bool[N]: version rows deletable when compacting to compact_rev."""
     n = keys.shape[0]
@@ -47,13 +50,14 @@ def victim_mask(
     superseded = le_compact & same_next & le_next
     is_last_le = le_compact & ~(same_next & le_next)
     dead_tombstone = is_last_le & tomb
+    if not with_ttl:
+        return superseded | dead_tombstone
 
     # TTL expiry: a group is expired ⇔ its LAST row (any revision) is <= the
-    # cutoff. Broadcast the group-last verdict backwards with a bounded
-    # linear carry: version chains are short post-compaction, so MAX_CHAIN
-    # steps of (same_next & next_expired) cover real chains; longer chains
-    # just expire over successive compactions.
-    ttl_enabled = (ttl_cutoff_hi > 0) | (ttl_cutoff_lo > 0)
+    # cutoff. Broadcast the group-last verdict backwards with a log-step
+    # segmented carry: version chains are short post-compaction, so
+    # MAX_CHAIN covers real chains; longer ones expire over successive
+    # compactions.
     last_of_group = valid & ~same_next
     last_le_cutoff = last_of_group & rev_leq(rev_hi, rev_lo, ttl_cutoff_hi, ttl_cutoff_lo)
     MAX_CHAIN = 64
@@ -64,7 +68,7 @@ def victim_mask(
         expired = expired | (run & jnp.roll(expired, -step))
         run = run & jnp.roll(run, -step)
         step *= 2
-    expired = expired & ttl_enabled & ttl_key & valid
+    expired = expired & ttl_key & valid
 
     return superseded | dead_tombstone | expired
 

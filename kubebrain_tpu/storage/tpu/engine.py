@@ -72,10 +72,13 @@ def _vis_indices(keys, rh, rl, tomb, nv, start, end, unb, qhi, qlo, size):
     return idx
 
 
-@jax.jit
-def _victim_batch(keys, rh, rl, tomb, ttl, nv, start, end, unb, chi, clo, thi, tlo):
+@functools.partial(jax.jit, static_argnames=("with_ttl",))
+def _victim_batch(keys, rh, rl, tomb, ttl, nv, start, end, unb, chi, clo, thi, tlo,
+                  with_ttl=True):
     """Compaction victim masks for all partitions, range-restricted."""
-    f = lambda k, a, b, t, x, n: victim_mask(k, a, b, t, x, n, chi, clo, thi, tlo)
+    f = lambda k, a, b, t, x, n: victim_mask(
+        k, a, b, t, x, n, chi, clo, thi, tlo, with_ttl=with_ttl
+    )
     mask = jax.vmap(f)(keys, rh, rl, tomb, ttl, nv)
     rng = jax.vmap(lambda k: lex_geq(k, start) & (unb | lex_less(k, end)))(keys)
     return mask & rng
@@ -384,6 +387,7 @@ class TpuScanner(Scanner):
                 mirror.ttl_dev, mirror.n_valid_dev, s, e, unb,
                 jnp.asarray(chi[0]), jnp.asarray(clo[0]),
                 jnp.asarray(thi[0]), jnp.asarray(tlo[0]),
+                with_ttl=ttl_cutoff > 0,
             )
         )
 
