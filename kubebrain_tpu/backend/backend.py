@@ -448,12 +448,12 @@ class Backend:
         return self._compact_revision_at(None)
 
     # ==================================================================== watch
-    def watch(self, prefix: bytes = b"", revision: int = 0):
+    def watch(self, prefix: bytes = b"", revision: int = 0, queue_factory=None):
         """Prefix-watch sugar over watch_range."""
         end = coder.prefix_end(prefix) if prefix else b""
-        return self.watch_range(prefix, end, revision)
+        return self.watch_range(prefix, end, revision, queue_factory=queue_factory)
 
-    def watch_range(self, start: bytes, end: bytes, revision: int = 0):
+    def watch_range(self, start: bytes, end: bytes, revision: int = 0, queue_factory=None):
         """Subscribe-then-replay watch registration (reference watch.go:37-96):
         subscribe to the hub FIRST, then replay history from the cache for
         events in (revision, hub-subscription point]; raise WatchExpiredError
@@ -476,7 +476,8 @@ class Backend:
                 raise WatchExpiredError(f"want {revision}, cache oldest {oldest}")
 
         wid, q, _replayed = self.watcher_hub.add_watcher_with_replay(
-            start, end, revision, self.watch_cache, validate=validate
+            start, end, revision, self.watch_cache, validate=validate,
+            queue_factory=queue_factory,
         )
         return wid, q
 

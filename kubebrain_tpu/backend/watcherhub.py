@@ -42,15 +42,22 @@ class WatcherHub:
         self._fanout_matcher = fanout_matcher
 
     def add_watcher(
-        self, start: bytes = b"", end: bytes = b"", min_revision: int = 0
+        self, start: bytes = b"", end: bytes = b"", min_revision: int = 0,
+        queue_factory=None,
     ) -> tuple[int, queue.Queue]:
         with self._lock:
-            return self._add_locked(start, end, min_revision)
+            return self._add_locked(start, end, min_revision, queue_factory)
 
-    def _add_locked(self, start: bytes, end: bytes, min_revision: int) -> tuple[int, queue.Queue]:
+    def _add_locked(
+        self, start: bytes, end: bytes, min_revision: int, queue_factory=None
+    ) -> tuple[int, queue.Queue]:
+        """``queue_factory(maxsize)`` may supply a custom subscriber queue
+        (e.g. an asyncio bridge); it must provide queue.Queue's put_nowait /
+        get_nowait / empty contract incl. raising queue.Full."""
         self._next_id += 1
         wid = self._next_id
-        q: queue.Queue = queue.Queue(maxsize=SUBSCRIBER_BUFFER)
+        factory = queue_factory or (lambda maxsize: queue.Queue(maxsize=maxsize))
+        q = factory(SUBSCRIBER_BUFFER)
         self._subs[wid] = q
         self._filters[wid] = (start, end, min_revision)
         return wid, q
@@ -62,6 +69,7 @@ class WatcherHub:
         revision: int,
         cache,
         validate: Callable[[], None] | None = None,
+        queue_factory=None,
     ) -> tuple[int, queue.Queue, int]:
         """Atomically subscribe AND replay history >= ``revision`` from the
         watch cache, then set the live filter to newest-replayed + 1.
@@ -86,7 +94,7 @@ class WatcherHub:
                 else []
             )
             next_rev = (catch_up[-1].revision + 1) if catch_up else revision
-            wid, q = self._add_locked(start, end, next_rev)
+            wid, q = self._add_locked(start, end, next_rev, queue_factory)
             if catch_up:
                 q.put_nowait(catch_up)
             return wid, q, len(catch_up)

@@ -61,6 +61,9 @@ def build_parser() -> argparse.ArgumentParser:
                         "(reference endpoint secure modes, config.go:159)")
     p.add_argument("--grpc-workers", type=int, default=256,
                    help="gRPC worker threads; each open watch stream holds one")
+    p.add_argument("--aio-port", type=int, default=0,
+                   help="additional asyncio etcd3 listener (coroutine-held "
+                        "watch streams — no thread-per-stream ceiling); 0 = off")
     p.add_argument("--cluster-name", default="")
     p.add_argument("--compact-interval", type=float, default=60.0)
     p.add_argument("--jax-platform", default=os.environ.get("KB_JAX_PLATFORM", ""),
@@ -171,6 +174,22 @@ def build_endpoint(args):
         insecure=not args.secure_only,
         grpc_workers=args.grpc_workers,
     ))
+    if args.aio_port:
+        from .endpoint.aio import AioEndpoint
+
+        aio = AioEndpoint(backend, peers, args.host, args.aio_port, identity)
+        _orig_run, _orig_close = endpoint.run, endpoint.close
+
+        def run_both():
+            _orig_run()
+            aio.run()
+
+        def close_both(grace: float = 1.0):
+            aio.close(grace)
+            _orig_close(grace)
+
+        endpoint.run = run_both
+        endpoint.close = close_both
     return endpoint, backend, store
 
 

@@ -90,3 +90,54 @@ def test_failover_under_load_no_acked_writes_lost():
             except Exception:
                 pass
         store.close()
+
+
+def test_realtime_revision_ordering():
+    """Linearizability smoke for writes (the reference lists Jepsen as TODO,
+    README.md:30-34): if op A's response completes before op B begins, A's
+    revision must be lower — revisions must respect real time across
+    concurrent clients."""
+    import bisect
+
+    from kubebrain_tpu.backend import Backend, BackendConfig
+
+    store = new_storage("native")
+    b = Backend(store, BackendConfig(event_ring_capacity=65536))
+    records = []  # (t_start, t_end, revision)
+    lock = threading.Lock()
+
+    def writer(w):
+        for i in range(200):
+            t0 = time.monotonic()
+            rev = b.create(b"/lin/w%02d-%04d" % (w, i), b"v")
+            t1 = time.monotonic()
+            with lock:
+                records.append((t0, t1, rev))
+
+    threads = [threading.Thread(target=writer, args=(w,)) for w in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+    revs = [r for _, _, r in records]
+    assert len(revs) == len(set(revs)), "revision handed out twice"
+    # real-time order: for every pair where A ended before B started,
+    # rev_A < rev_B. Check efficiently: sort by start; walk keeping the max
+    # revision among ops that END before the current start.
+    by_start = sorted(records)
+    ends = sorted((t1, rev) for _, t1, rev in records)
+    end_times = [e[0] for e in ends]
+    max_rev_until = []
+    mx = 0
+    for _, rev in ends:
+        mx = max(mx, rev)
+        max_rev_until.append(mx)
+    violations = 0
+    for t0, _, rev in by_start:
+        idx = bisect.bisect_left(end_times, t0) - 1
+        if idx >= 0 and max_rev_until[idx] >= rev:
+            violations += 1
+    assert violations == 0, f"{violations} real-time ordering violations"
+    b.close()
+    store.close()
