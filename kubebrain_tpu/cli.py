@@ -177,7 +177,13 @@ def build_endpoint(args):
     if args.aio_port:
         from .endpoint.aio import AioEndpoint
 
-        aio = AioEndpoint(backend, peers, args.host, args.aio_port, identity)
+        creds = None
+        if args.cert_file and args.key_file:
+            creds = endpoint._grpc_creds()
+        aio = AioEndpoint(
+            backend, peers, args.host, args.aio_port, identity,
+            credentials=creds, insecure=not args.secure_only,
+        )
         _orig_run, _orig_close = endpoint.run, endpoint.close
 
         def run_both():

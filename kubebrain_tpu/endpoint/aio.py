@@ -99,42 +99,92 @@ def _wrap_unary(fn):
 
 
 class AioWatchService:
-    """Native-async Watch terminal (protocol of server/etcd/watch.py)."""
+    """Native-async Watch terminal — full parity with the sync protocol
+    (server/etcd/watch.py): shared response builders, negative-start-revision
+    list-over-watch streams, progress-notify bookmarks, compacted cancels."""
+
+    PROGRESS_INTERVAL = 60.0
 
     def __init__(self, backend, peers=None):
         self.backend = backend
         self.peers = peers
 
     async def Watch(self, request_iterator, context):
+        from ..server.etcd.watch import (
+            compacted_response,
+            dropped_response,
+            events_response,
+        )
+
+        if self.peers is not None and not self.peers.is_leader():
+            # follower watch-forwarding is a sync-proxy feature; refuse loudly
+            # rather than serve from a non-leader pipeline
+            await context.abort(
+                grpc.StatusCode.UNAVAILABLE,
+                "etcdserver: not leader (watch on the aio port requires the leader; "
+                "use the sync client port for proxied watches)",
+            )
+
         loop = asyncio.get_running_loop()
         out: asyncio.Queue = asyncio.Queue(maxsize=1024)
         watches: dict[int, tuple[int, asyncio.Task]] = {}
         next_id = [0]
 
-        async def pump(watch_id: int, wid: int, q: AioBridgeQueue, want_prev, no_put, no_delete):
+        async def pump(watch_id, wid, q, want_prev, no_put, no_delete, progress_notify):
+            last_sent = loop.time()
+            while True:
+                try:
+                    batch = await asyncio.wait_for(q.get(), timeout=0.5)
+                except asyncio.TimeoutError:
+                    if progress_notify and loop.time() - last_sent >= self.PROGRESS_INTERVAL:
+                        last_sent = loop.time()
+                        await out.put(rpc_pb2.WatchResponse(
+                            header=shim.header(self.backend.current_revision()),
+                            watch_id=watch_id,
+                        ))
+                    continue
+                if batch is None:
+                    await out.put(dropped_response(self.backend.current_revision(), watch_id))
+                    return
+                resp = events_response(batch, watch_id, want_prev, no_put, no_delete)
+                if resp is not None:
+                    last_sent = loop.time()
+                    await out.put(resp)
+
+        async def range_stream(creq, watch_id):
+            """List-over-watch (negative start revision, watch.py protocol)."""
+            from ..backend.errors import CompactedError, FutureRevisionError
             from ..proto import kv_pb2
 
-            while True:
-                batch = await q.get()
-                if batch is None:
-                    await out.put(rpc_pb2.WatchResponse(
-                        header=shim.header(self.backend.current_revision()),
-                        watch_id=watch_id, canceled=True,
-                        cancel_reason="etcdserver: watcher dropped (slow consumer)",
-                    ))
-                    return
-                resp = rpc_pb2.WatchResponse(
-                    header=shim.header(batch[-1].revision), watch_id=watch_id
+            revision = -int(creq.start_revision)
+            try:
+                rev, stream = await loop.run_in_executor(
+                    None, self.backend.list_by_stream,
+                    bytes(creq.key), bytes(creq.range_end), revision,
                 )
-                for ev in batch:
-                    pe = shim.to_event(ev, want_prev)
-                    if (pe.type == kv_pb2.Event.PUT and no_put) or (
-                        pe.type == kv_pb2.Event.DELETE and no_delete
-                    ):
-                        continue
-                    resp.events.append(pe)
-                if resp.events:
-                    await out.put(resp)
+            except (CompactedError, FutureRevisionError):
+                await out.put(compacted_response(
+                    self.backend.current_revision(),
+                    self.backend.compact_revision(), watch_id,
+                ))
+                return
+            await out.put(rpc_pb2.WatchResponse(
+                header=shim.header(rev), watch_id=watch_id, created=True
+            ))
+            it = iter(stream)
+            while True:
+                batch = await loop.run_in_executor(None, next, it, None)
+                if batch is None:
+                    break
+                resp = rpc_pb2.WatchResponse(header=shim.header(rev), watch_id=watch_id)
+                for kv in batch:
+                    resp.events.append(
+                        kv_pb2.Event(type=kv_pb2.Event.PUT, kv=shim.to_kv(kv))
+                    )
+                await out.put(resp)
+            await out.put(rpc_pb2.WatchResponse(
+                header=shim.header(rev), watch_id=watch_id, canceled=True
+            ))
 
         async def reader():
             try:
@@ -144,6 +194,9 @@ class AioWatchService:
                         creq = req.create_request
                         next_id[0] += 1
                         watch_id = creq.watch_id if creq.watch_id > 0 else next_id[0]
+                        if creq.start_revision < 0:
+                            asyncio.create_task(range_stream(creq, watch_id))
+                            continue
                         end = bytes(creq.range_end)
                         if not end:
                             end = bytes(creq.key) + b"\x00"
@@ -157,11 +210,9 @@ class AioWatchService:
                                 queue_factory=lambda maxsize: AioBridgeQueue(maxsize, loop),
                             )
                         except WatchExpiredError:
-                            await out.put(rpc_pb2.WatchResponse(
-                                header=shim.header(self.backend.current_revision()),
-                                watch_id=watch_id, created=True, canceled=True,
-                                compact_revision=max(self.backend.compact_revision(), 1),
-                                cancel_reason="etcdserver: mvcc: required revision has been compacted",
+                            await out.put(compacted_response(
+                                self.backend.current_revision(),
+                                self.backend.compact_revision(), watch_id,
                             ))
                             continue
                         await out.put(rpc_pb2.WatchResponse(
@@ -170,9 +221,10 @@ class AioWatchService:
                         ))
                         no_put = rpc_pb2.WatchCreateRequest.NOPUT in creq.filters
                         no_delete = rpc_pb2.WatchCreateRequest.NODELETE in creq.filters
-                        task = asyncio.create_task(
-                            pump(watch_id, wid, q, bool(creq.prev_kv), no_put, no_delete)
-                        )
+                        task = asyncio.create_task(pump(
+                            watch_id, wid, q, bool(creq.prev_kv), no_put, no_delete,
+                            bool(creq.progress_notify),
+                        ))
                         watches[watch_id] = (wid, task)
                     elif which == "cancel_request":
                         watch_id = req.cancel_request.watch_id
@@ -209,6 +261,17 @@ class AioWatchService:
                 self.backend.unwatch(wid)
 
 
+def _aio_lease_keepalive(backend):
+    async def handler(request_iterator, context):
+        async for req in request_iterator:
+            yield rpc_pb2.LeaseKeepAliveResponse(
+                header=shim.header(backend.current_revision()),
+                ID=req.ID, TTL=req.ID,
+            )
+
+    return handler
+
+
 def make_aio_handlers(backend, peers=None, identity="kubebrain-tpu"):
     kv = KVService(backend, peers)
     lease = LeaseService(backend)
@@ -242,6 +305,11 @@ def make_aio_handlers(backend, peers=None, identity="kubebrain-tpu"):
         grpc.method_handlers_generic_handler("etcdserverpb.Lease", {
             "LeaseGrant": unary(lease.LeaseGrant, p.LeaseGrantRequest, p.LeaseGrantResponse),
             "LeaseRevoke": unary(lease.LeaseRevoke, p.LeaseRevokeRequest, p.LeaseRevokeResponse),
+            "LeaseKeepAlive": grpc.stream_stream_rpc_method_handler(
+                _aio_lease_keepalive(backend),
+                request_deserializer=p.LeaseKeepAliveRequest.FromString,
+                response_serializer=p.LeaseKeepAliveResponse.SerializeToString,
+            ),
         }),
         grpc.method_handlers_generic_handler("etcdserverpb.Cluster", {
             "MemberList": unary(cluster.MemberList, p.MemberListRequest, p.MemberListResponse),
@@ -255,23 +323,33 @@ def make_aio_handlers(backend, peers=None, identity="kubebrain-tpu"):
 
 class AioEndpoint:
     """Runs the aio gRPC server in a dedicated event-loop thread so the rest
-    of the (threaded) process is unchanged."""
+    of the (threaded) process is unchanged. TLS mirrors the sync endpoint:
+    with credentials configured, a secure port is bound (plus plaintext only
+    when ``insecure``)."""
 
-    def __init__(self, backend, peers, host: str, port: int, identity="kubebrain-tpu"):
+    def __init__(
+        self, backend, peers, host: str, port: int, identity="kubebrain-tpu",
+        credentials: grpc.ServerCredentials | None = None, insecure: bool = True,
+    ):
         self.backend = backend
         self.peers = peers
         self.host = host
         self.port = port
         self.identity = identity
+        self.credentials = credentials
+        self.insecure = insecure
         self._loop: asyncio.AbstractEventLoop | None = None
         self._server = None
         self._thread: threading.Thread | None = None
         self._started = threading.Event()
+        self._error: BaseException | None = None
 
     def run(self) -> None:
         self._thread = threading.Thread(target=self._serve, name="kb-aio", daemon=True)
         self._thread.start()
         self._started.wait(timeout=10)
+        if self._error is not None:
+            raise RuntimeError(f"aio endpoint failed to start: {self._error}")
 
     def _serve(self) -> None:
         self._loop = asyncio.new_event_loop()
@@ -281,14 +359,23 @@ class AioEndpoint:
             self._server = grpc.aio.server()
             for h in make_aio_handlers(self.backend, self.peers, self.identity):
                 self._server.add_generic_rpc_handlers((h,))
-            self._server.add_insecure_port(f"{self.host}:{self.port}")
+            bound = False
+            if self.credentials is not None:
+                self._server.add_secure_port(f"{self.host}:{self.port}", self.credentials)
+                bound = True
+            if self.insecure or not bound:
+                self._server.add_insecure_port(f"{self.host}:{self.port}")
             await self._server.start()
             self._started.set()
             await self._server.wait_for_termination()
 
         try:
             self._loop.run_until_complete(main())
-        except Exception:
+        except Exception as e:
+            import traceback
+
+            traceback.print_exc()
+            self._error = e
             self._started.set()
 
     def close(self, grace: float = 1.0) -> None:

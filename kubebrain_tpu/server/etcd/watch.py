@@ -24,6 +24,40 @@ from ...proto import rpc_pb2
 from . import shim
 
 
+def events_response(batch, watch_id, want_prev, no_put, no_delete):
+    """Wire WatchResponse for one event batch (None if fully filtered) —
+    shared by the sync and aio pumps so the protocol can't drift."""
+    from ...proto import kv_pb2
+
+    resp = rpc_pb2.WatchResponse(
+        header=shim.header(batch[-1].revision), watch_id=watch_id
+    )
+    for ev in batch:
+        pe = shim.to_event(ev, want_prev)
+        if (pe.type == kv_pb2.Event.PUT and no_put) or (
+            pe.type == kv_pb2.Event.DELETE and no_delete
+        ):
+            continue
+        resp.events.append(pe)
+    return resp if resp.events else None
+
+
+def dropped_response(current_revision, watch_id):
+    return rpc_pb2.WatchResponse(
+        header=shim.header(current_revision), watch_id=watch_id, canceled=True,
+        cancel_reason="etcdserver: watcher dropped (slow consumer)",
+    )
+
+
+def compacted_response(current_revision, compact_revision, watch_id):
+    return rpc_pb2.WatchResponse(
+        header=shim.header(current_revision), watch_id=watch_id,
+        created=True, canceled=True,
+        compact_revision=max(compact_revision, 1),
+        cancel_reason="etcdserver: mvcc: required revision has been compacted",
+    )
+
+
 class WatchService:
     def __init__(self, backend: Backend, peers=None):
         self.backend = backend
@@ -108,13 +142,10 @@ class _WatchSession:
             )
         except WatchExpiredError:
             self._send(
-                rpc_pb2.WatchResponse(
-                    header=shim.header(self.backend.current_revision()),
-                    watch_id=watch_id,
-                    created=True,
-                    canceled=True,
-                    compact_revision=max(self.backend.compact_revision(), 1),
-                    cancel_reason="etcdserver: mvcc: required revision has been compacted",
+                compacted_response(
+                    self.backend.current_revision(),
+                    self.backend.compact_revision(),
+                    watch_id,
                 )
             )
             return
@@ -148,8 +179,6 @@ class _WatchSession:
               progress_notify=False) -> None:
         import time as _time
 
-        from ...proto import kv_pb2
-
         last_sent = _time.monotonic()
         while not stop.is_set():
             try:
@@ -173,27 +202,11 @@ class _WatchSession:
             if batch is None:
                 # hub dropped us (slow consumer) or backend closed: cancel so
                 # the client re-watches (watcherhub.go:82-90)
-                self._send(
-                    rpc_pb2.WatchResponse(
-                        header=shim.header(self.backend.current_revision()),
-                        watch_id=watch_id,
-                        canceled=True,
-                        cancel_reason="etcdserver: watcher dropped (slow consumer)",
-                    )
-                )
+                self._send(dropped_response(self.backend.current_revision(), watch_id))
                 self._remove(watch_id)
                 return
-            resp = rpc_pb2.WatchResponse(
-                header=shim.header(batch[-1].revision), watch_id=watch_id
-            )
-            for ev in batch:
-                pe = shim.to_event(ev, want_prev)
-                if (pe.type == kv_pb2.Event.PUT and no_put) or (
-                    pe.type == kv_pb2.Event.DELETE and no_delete
-                ):
-                    continue
-                resp.events.append(pe)
-            if resp.events:
+            resp = events_response(batch, watch_id, want_prev, no_put, no_delete)
+            if resp is not None:
                 last_sent = _time.monotonic()
                 self._send(resp)
 

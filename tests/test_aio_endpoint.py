@@ -113,3 +113,35 @@ def test_300_streams_beyond_any_thread_pool(aio_server):
     assert received[0] == N * 10, f"delivered {received[0]}/{N*10}"
     for rq in request_queues:
         rq.put(None)
+
+
+def test_aio_list_over_watch_and_keepalive(aio_server):
+    """Negative-start-revision range stream + LeaseKeepAlive parity."""
+    client, backend = aio_server
+    for i in range(7):
+        client.create(b"/aio/low/k%02d" % i, b"v%d" % i)
+    requests: sync_queue.Queue = sync_queue.Queue()
+    responses = client.watch(iter(requests.get, None))
+    req = rpc_pb2.WatchRequest()
+    req.create_request.key = b"/aio/low/"
+    req.create_request.range_end = b"/aio/low0"
+    req.create_request.start_revision = -backend.current_revision()
+    requests.put(req)
+    created = next(responses)
+    assert created.created
+    got = []
+    while True:
+        resp = next(responses)
+        got.extend(resp.events)
+        if resp.canceled:
+            break
+    assert len(got) == 7 and all(e.kv.value.startswith(b"v") for e in got)
+    requests.put(None)
+
+    ka = client.ch.stream_stream(
+        "/etcdserverpb.Lease/LeaseKeepAlive",
+        request_serializer=rpc_pb2.LeaseKeepAliveRequest.SerializeToString,
+        response_deserializer=rpc_pb2.LeaseKeepAliveResponse.FromString,
+    )
+    resp = next(ka(iter([rpc_pb2.LeaseKeepAliveRequest(ID=600)])))
+    assert resp.ID == 600 and resp.TTL == 600
