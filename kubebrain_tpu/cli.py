@@ -211,8 +211,9 @@ def main(argv=None) -> int:
 
     def _graceful_exit(signum, frame):  # noqa: ARG001
         # force-kill watchdog (reference forceExitWhileGracefulExitTimeout,
-        # cmd/main.go:62): a wedged close must not block exit > 3s
-        t = threading.Timer(3.0, lambda: os._exit(2))
+        # cmd/main.go:62): a wedged close must not block exit; budget covers
+        # grpc drain + aio loop stop + engine checkpoint
+        t = threading.Timer(10.0, lambda: os._exit(2))
         t.daemon = True
         t.start()
         watchdog.append(t)

@@ -378,13 +378,13 @@ class AioEndpoint:
             self._error = e
             self._started.set()
 
-    def close(self, grace: float = 1.0) -> None:
+    def close(self, grace: float = 0.5) -> None:
         if self._loop is not None and self._server is not None:
             fut = asyncio.run_coroutine_threadsafe(self._server.stop(grace), self._loop)
             try:
-                fut.result(timeout=5)
+                fut.result(timeout=grace + 1.0)
             except Exception:
                 pass
             self._loop.call_soon_threadsafe(self._loop.stop)
         if self._thread is not None:
-            self._thread.join(timeout=5)
+            self._thread.join(timeout=2)
