@@ -472,7 +472,15 @@ class Backend:
             if len(self.watch_cache) == 0:
                 if revision < self.tso.committed():
                     raise WatchExpiredError(f"cache empty, want {revision}")
+            elif self.watch_cache.has_evicted():
+                # once the ring has dropped events, oldest-1 may name a real
+                # evicted event — match the reference's strict check
+                # (ring.FindEvents "low" when revision < oldest, watch.go)
+                if revision < oldest:
+                    raise WatchExpiredError(f"want {revision}, cache oldest {oldest}")
             elif revision < oldest - 1:
+                # never-full cache: oldest-1 is the pre-history revision the
+                # first cached event was written against — replay is complete
                 raise WatchExpiredError(f"want {revision}, cache oldest {oldest}")
 
         wid, q, _replayed = self.watcher_hub.add_watcher_with_replay(

@@ -19,6 +19,7 @@ cancelled and clients must re-list).
 from __future__ import annotations
 
 import json
+import logging
 import threading
 import time
 from dataclasses import dataclass
@@ -26,7 +27,10 @@ from typing import Callable
 
 from ..storage import CASFailedError, KvStorage
 from ..storage.errors import KeyNotFoundError
+from ..util.env import crash_guard
 from .common import ELECTION_KEY
+
+logger = logging.getLogger("kubebrain")
 
 LEASE_SECONDS = 8.0
 RENEW_INTERVAL = 5.0
@@ -137,7 +141,15 @@ class LeaderElection:
 
     # ---------------------------------------------------------------- campaign
     def try_acquire_once(self, now: float | None = None) -> bool:
-        """One acquire/renew attempt; True iff we hold the lock afterwards."""
+        """One acquire/renew attempt; True iff we hold the lock afterwards.
+
+        Any storage error — CAS loss, uncertain result, engine/network
+        failure, even a malformed lock record — means we could NOT prove we
+        hold the lock, so we must report not-leader. Treating an error as
+        anything else risks two concurrent leaders: the reference's
+        leaderelection machinery likewise treats renew errors as lease loss
+        (leader.go:109-118 panics on loss).
+        """
         now = time.time() if now is None else now
         try:
             rec = self._lock.get()
@@ -163,9 +175,14 @@ class LeaderElection:
             return False
         except CASFailedError:
             return False
+        except Exception:
+            logger.exception("lock op failed for %s; assuming not leader", self._lock.identity)
+            return False
 
     def campaign(self) -> None:
-        self._thread = threading.Thread(target=self._loop, name="kb-campaign", daemon=True)
+        self._thread = threading.Thread(
+            target=crash_guard(self._loop), name="kb-campaign", daemon=True
+        )
         self._thread.start()
 
     def _loop(self) -> None:

@@ -20,12 +20,21 @@ unresolved uncertain write could garbage-collect the very record step 2 needs.
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from collections import deque
 from typing import Callable
 
 from .common import Verb, WatchEvent
+
+logger = logging.getLogger("kubebrain")
+
+# A head event whose resolution keeps failing (persistent engine fault on one
+# key) must not wedge the FIFO and pin the compaction watermark forever: after
+# this many failed attempts it is dropped with a loud log (the reference makes
+# exactly one attempt per tick and drops on the first definitive answer).
+MAX_RESOLVE_ATTEMPTS = 8
 
 
 class AsyncFifoRetry:
@@ -35,26 +44,28 @@ class AsyncFifoRetry:
         rewrite: Callable[[WatchEvent, tuple[int, bool]], None],
         check_interval: float = 1.0,
         probe_after: float = 5.0,
+        max_attempts: int = MAX_RESOLVE_ATTEMPTS,
     ):
         self._read_rev_record = read_rev_record
         self._rewrite = rewrite
         self._check_interval = check_interval
         self._probe_after = probe_after
+        self._max_attempts = max_attempts
         self._lock = threading.Lock()
-        self._queue: deque[tuple[WatchEvent, float]] = deque()
+        self._queue: deque[list] = deque()  # [event, enqueued_at, attempts]
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
 
     def append(self, event: WatchEvent) -> None:
         with self._lock:
-            self._queue.append((event, time.monotonic()))
+            self._queue.append([event, time.monotonic(), 0])
 
     def min_revision(self) -> int:
         """Smallest unresolved uncertain revision; 0 when queue empty."""
         with self._lock:
             if not self._queue:
                 return 0
-            return min(ev.revision for ev, _ in self._queue)
+            return min(entry[0].revision for entry in self._queue)
 
     def __len__(self) -> int:
         with self._lock:
@@ -72,11 +83,41 @@ class AsyncFifoRetry:
             with self._lock:
                 if not self._queue:
                     return resolved
-                event, enqueued = self._queue[0]
+                entry = self._queue[0]
+                event, enqueued, attempts = entry
                 if now - enqueued < self._probe_after:
                     return resolved
-                self._queue.popleft()
-            self._resolve(event)
+            # resolve BEFORE popping: while the repair is in flight the event
+            # must keep fencing compaction via min_revision() (the revision
+            # record _resolve reads could otherwise be GC'd under us), and an
+            # engine hiccup in _resolve must not drop the event — the
+            # reference queue holds the item until handled (retry.go:161-220)
+            try:
+                self._resolve(event)
+            except Exception:
+                with self._lock:
+                    entry[2] = attempts + 1
+                    give_up = entry[2] >= self._max_attempts
+                    if give_up and self._queue and self._queue[0] is entry:
+                        self._queue.popleft()
+                if give_up:
+                    logger.exception(
+                        "uncertain-write repair for key=%r rev=%d dropped after "
+                        "%d failed attempts; storage may disagree with the "
+                        "event stream for this key",
+                        event.key, event.revision, entry[2],
+                    )
+                    continue
+                logger.warning(
+                    "uncertain-write repair for key=%r rev=%d failed "
+                    "(attempt %d/%d); will retry",
+                    event.key, event.revision, entry[2], self._max_attempts,
+                    exc_info=True,
+                )
+                return resolved  # leave at head; retry next tick
+            with self._lock:
+                if self._queue and self._queue[0] is entry:
+                    self._queue.popleft()
             resolved += 1
 
     def _resolve(self, event: WatchEvent) -> None:
@@ -101,8 +142,8 @@ class AsyncFifoRetry:
         while not self._stop.wait(self._check_interval):
             try:
                 self.process_ready()
-            except Exception:  # engine hiccup: keep the repair loop alive
-                pass
+            except Exception:  # keep the repair loop alive, but never silently
+                logger.exception("uncertain-write repair tick failed")
 
     def close(self) -> None:
         self._stop.set()

@@ -28,6 +28,7 @@ class Ring:
         self._cap = capacity
         self._buf: list[WatchEvent] = []
         self._start = 0  # index of oldest
+        self._evicted = False
         self._lock = threading.Lock()
 
     def add(self, event: WatchEvent) -> None:
@@ -37,6 +38,13 @@ class Ring:
             else:
                 self._buf[self._start] = event
                 self._start = (self._start + 1) % self._cap
+                self._evicted = True
+
+    def has_evicted(self) -> bool:
+        """True once any event has been dropped off the tail — after that,
+        ``oldest_revision() - 1`` may correspond to a real, evicted event."""
+        with self._lock:
+            return self._evicted
 
     def _at(self, logical_index: int) -> WatchEvent:
         return self._buf[(self._start + logical_index) % len(self._buf)]

@@ -87,12 +87,21 @@ class WatcherHub:
         """
         with self._lock:
             if validate is not None:
-                validate()  # e.g. cache-expiry check, atomic with the replay
+                validate()  # fast-fail before paying for the replay
             catch_up = (
                 [e for e in cache.find_events(revision) if _in_range(e.key, start, end)]
                 if revision
                 else []
             )
+            if validate is not None and revision:
+                # re-check AFTER the replay copy: the sequencer appends (and
+                # evicts) cache entries outside the hub lock, so the cache's
+                # oldest revision may have advanced past ``revision`` between
+                # the first check and find_events — replay would then be
+                # missing the evicted events. Eviction only moves oldest
+                # forward, so if this second check passes, find_events ran
+                # with oldest <= revision and the copy is complete.
+                validate()
             next_rev = (catch_up[-1].revision + 1) if catch_up else revision
             wid, q = self._add_locked(start, end, next_rev, queue_factory)
             if catch_up:

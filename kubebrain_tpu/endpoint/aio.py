@@ -128,6 +128,7 @@ class AioWatchService:
         loop = asyncio.get_running_loop()
         out: asyncio.Queue = asyncio.Queue(maxsize=1024)
         watches: dict[int, tuple[int, asyncio.Task]] = {}
+        stream_tasks: set[asyncio.Task] = set()
         next_id = [0]
 
         async def pump(watch_id, wid, q, want_prev, no_put, no_delete, progress_notify):
@@ -168,22 +169,40 @@ class AioWatchService:
                     self.backend.compact_revision(), watch_id,
                 ))
                 return
+            except asyncio.CancelledError:
+                raise
+            except Exception as exc:
+                # any other failure must still answer the client — otherwise
+                # it waits forever on this watch_id
+                await out.put(rpc_pb2.WatchResponse(
+                    header=shim.header(self.backend.current_revision()),
+                    watch_id=watch_id, canceled=True,
+                    cancel_reason=f"range stream failed: {exc}",
+                ))
+                return
             await out.put(rpc_pb2.WatchResponse(
                 header=shim.header(rev), watch_id=watch_id, created=True
             ))
             it = iter(stream)
-            while True:
-                batch = await loop.run_in_executor(None, next, it, None)
-                if batch is None:
-                    break
-                resp = rpc_pb2.WatchResponse(header=shim.header(rev), watch_id=watch_id)
-                for kv in batch:
-                    resp.events.append(
-                        kv_pb2.Event(type=kv_pb2.Event.PUT, kv=shim.to_kv(kv))
-                    )
-                await out.put(resp)
+            try:
+                while True:
+                    batch = await loop.run_in_executor(None, next, it, None)
+                    if batch is None:
+                        break
+                    resp = rpc_pb2.WatchResponse(header=shim.header(rev), watch_id=watch_id)
+                    for kv in batch:
+                        resp.events.append(
+                            kv_pb2.Event(type=kv_pb2.Event.PUT, kv=shim.to_kv(kv))
+                        )
+                    await out.put(resp)
+                reason = ""
+            except asyncio.CancelledError:
+                raise
+            except Exception as exc:  # mid-stream failure: tell the client
+                reason = f"range stream failed: {exc}"
             await out.put(rpc_pb2.WatchResponse(
-                header=shim.header(rev), watch_id=watch_id, canceled=True
+                header=shim.header(rev), watch_id=watch_id, canceled=True,
+                cancel_reason=reason,
             ))
 
         async def reader():
@@ -195,7 +214,9 @@ class AioWatchService:
                         next_id[0] += 1
                         watch_id = creq.watch_id if creq.watch_id > 0 else next_id[0]
                         if creq.start_revision < 0:
-                            asyncio.create_task(range_stream(creq, watch_id))
+                            task = asyncio.create_task(range_stream(creq, watch_id))
+                            stream_tasks.add(task)
+                            task.add_done_callback(stream_tasks.discard)
                             continue
                         end = bytes(creq.range_end)
                         if not end:
@@ -256,6 +277,11 @@ class AioWatchService:
                 yield item
         finally:
             reader_task.cancel()
+            # list-over-watch tasks block on `out.put` once the consumer is
+            # gone (bounded queue) — cancel them or they leak with their
+            # backend list streams
+            for task in list(stream_tasks):
+                task.cancel()
             for wid, task in watches.values():
                 task.cancel()
                 self.backend.unwatch(wid)

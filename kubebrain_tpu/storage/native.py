@@ -277,8 +277,10 @@ class NativeKv(KvStorage):
             last_val, len(last_val),
             ctypes.byref(pv), ctypes.byref(pl), ctypes.byref(latest),
         )
+        # free whenever the C side filled the buffer, regardless of rc —
+        # rc 4 (revision drift) also mallocs prev_val before its check
         prev = None
-        if rc in (0, 2) and pl.value:
+        if pl.value:
             prev = ctypes.string_at(pv, pl.value)
             self._lib.kb_free(pv)
         if rc == 0:

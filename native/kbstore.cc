@@ -47,6 +47,10 @@ struct Store {
   FILE* wal = nullptr;
   bool fsync_commits = false;
 
+  ~Store() {
+    if (wal != nullptr) fclose(wal);
+  }
+
   const std::string* live(const std::string& key, uint64_t snap, double now) const {
     auto it = data.find(key);
     if (it == data.end()) return nullptr;
@@ -240,7 +244,7 @@ void* kb_open_at(const char* dir, int fsync_commits) {
     // checkpoint immediately: writes a clean snapshot and truncates the WAL,
     // so a torn tail left by a crash is never appended after
     if (checkpoint_locked(st) != 0) {
-      delete st;
+      delete st;  // ~Store closes the WAL handle if one was opened
       return nullptr;
     }
   }

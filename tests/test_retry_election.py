@@ -169,3 +169,75 @@ def test_lock_tso_seeds_revision():
     assert rec.tso >= 1
     b.close()
     store.close()
+
+def test_renew_error_drops_leadership():
+    """ADVICE r1 (high): a non-CAS storage error during renewal must make the
+    campaign report not-leader and fire on_stopped_leading — NOT kill the
+    thread with _is_leader still set (split-brain)."""
+    store = new_storage("memkv")
+    stopped = []
+    ea = LeaderElection(
+        ResourceLock(store, "node-a"),
+        on_stopped_leading=lambda: stopped.append(True),
+        lease_seconds=0.5,
+        renew_interval=0.03,
+        retry_interval=0.02,
+    )
+    ea.campaign()
+    assert ea.wait_for_leadership(2.0)
+    # sabotage the lock: every storage op now raises an unexpected error
+    real_get = store.get
+    store.get = lambda *a, **k: (_ for _ in ()).throw(RuntimeError("engine down"))
+    deadline = time.monotonic() + 3.0
+    while ea.is_leader() and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert not ea.is_leader(), "leadership must drop when renewal cannot be proven"
+    assert stopped, "on_stopped_leading must fire"
+    store.get = real_get
+    ea.close()
+    store.close()
+
+
+def test_retry_keeps_event_on_resolve_failure():
+    """ADVICE r1 (low): a failing _resolve must not drop the uncertain event —
+    it stays queued (and keeps fencing compaction) until resolution succeeds."""
+    from kubebrain_tpu.backend.retry import AsyncFifoRetry
+
+    calls = []
+
+    def read_rev_record(key):
+        calls.append(key)
+        if len(calls) == 1:
+            raise RuntimeError("engine hiccup")
+        return (7, False)
+
+    repaired = []
+    r = AsyncFifoRetry(read_rev_record, lambda ev, rec: repaired.append(ev), probe_after=0.0)
+    ev = WatchEvent(revision=7, verb=Verb.PUT, key=b"/k", value=b"v", valid=False)
+    r.append(ev)
+    assert r.process_ready() == 0  # first attempt fails; event retained
+    assert len(r) == 1, "event must survive a failed resolve"
+    assert r.min_revision() == 7, "compaction fence must hold during repair"
+    assert r.process_ready() == 1
+    assert repaired and repaired[0].revision == 7
+    assert len(r) == 0
+
+
+def test_retry_poisoned_head_dropped_after_cap():
+    """A head whose resolution fails persistently must not wedge the FIFO or
+    pin the compaction watermark forever: it is dropped after max_attempts."""
+    from kubebrain_tpu.backend.retry import AsyncFifoRetry
+
+    def always_fail(key):
+        raise RuntimeError("persistent engine fault")
+
+    r = AsyncFifoRetry(always_fail, lambda ev, rec: None, probe_after=0.0, max_attempts=3)
+    r.append(WatchEvent(revision=5, verb=Verb.PUT, key=b"/bad", value=b"v", valid=False))
+    r.append(WatchEvent(revision=6, verb=Verb.PUT, key=b"/bad2", value=b"v", valid=False))
+    assert r.process_ready() == 0 and len(r) == 2  # attempt 1
+    assert r.process_ready() == 0 and len(r) == 2  # attempt 2
+    r.process_ready()  # attempt 3: head dropped, second entry then also fails
+    assert r.min_revision() != 5, "poisoned head must stop fencing compaction"
+    for _ in range(3):
+        r.process_ready()
+    assert len(r) == 0

@@ -143,3 +143,37 @@ def test_watch_failed_writes_invisible(backend):
     with pytest.raises(queue.Empty):
         q.get_nowait()
     backend.unwatch(wid)
+
+
+def test_watch_oldest_minus_one_expires_after_eviction(backend):
+    """ADVICE r1 (medium): once the ring has evicted, oldest-1 may name a
+    real dropped event — watching there must expire (reference watch.go
+    'low' when revision < oldest), not silently skip the evicted event."""
+    # cache cap is 64: fill past capacity so eviction has happened
+    for i in range(80):
+        backend.create(b"/registry/k%03d" % i, b"v")
+    assert wait_for_revision(backend, 80)
+    oldest = backend.watch_cache.oldest_revision()
+    assert backend.watch_cache.has_evicted()
+    with pytest.raises(WatchExpiredError):
+        backend.watch(b"/registry/", revision=oldest - 1)
+    # exactly oldest is still servable
+    wid, q = backend.watch(b"/registry/", revision=oldest)
+    backend.unwatch(wid)
+
+
+def test_watch_oldest_minus_one_ok_before_eviction(backend):
+    """On a never-full cache oldest-1 pre-dates all history (it is the
+    revision the first cached event was written against — e.g. a leader
+    seeded from the engine clock): replay from the first cached event is
+    complete, so the -1 slack stays valid."""
+    backend.set_current_revision(5)
+    r1 = backend.create(b"/registry/a", b"v1")  # revision 6
+    r2 = backend.create(b"/registry/b", b"v2")
+    assert wait_for_revision(backend, r2)
+    assert not backend.watch_cache.has_evicted()
+    assert backend.watch_cache.oldest_revision() == r1 == 6
+    wid, q = backend.watch(b"/registry/", revision=r1 - 1)
+    events = collect(q, 2)
+    assert [e.revision for e in events] == [r1, r2]
+    backend.unwatch(wid)
