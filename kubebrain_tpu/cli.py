@@ -64,6 +64,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--aio-port", type=int, default=0,
                    help="additional asyncio etcd3 listener (coroutine-held "
                         "watch streams — no thread-per-stream ceiling); 0 = off")
+    p.add_argument("--front-port", type=int, default=0,
+                   help="native C++ gRPC/HTTP frontend (kbfront) on this port: "
+                        "single-port h2+http demux (reference cmux) with the "
+                        "protocol work in C++; 0 = off")
     p.add_argument("--cluster-name", default="")
     p.add_argument("--compact-interval", type=float, default=60.0)
     p.add_argument("--jax-platform", default=os.environ.get("KB_JAX_PLATFORM", ""),
@@ -196,6 +200,25 @@ def build_endpoint(args):
 
         endpoint.run = run_both
         endpoint.close = close_both
+    if getattr(args, "front_port", 0):
+        from .endpoint.front import FrontServer
+
+        front = FrontServer(
+            backend, peers, server, identity, metrics=metrics,
+            brain=server.brain,
+        )
+        _frun, _fclose = endpoint.run, endpoint.close
+
+        def run_with_front():
+            _frun()
+            front.run(args.front_port, args.host)
+
+        def close_with_front(grace: float = 1.0):
+            front.close()
+            _fclose(grace)
+
+        endpoint.run = run_with_front
+        endpoint.close = close_with_front
     return endpoint, backend, store
 
 

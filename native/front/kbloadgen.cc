@@ -1,0 +1,402 @@
+// kbloadgen — native etcd3 gRPC load generator for benchmarking kbfront.
+//
+// The reference benchmarks its server with an external Go benchmark tool
+// over 300 concurrent etcd clients (docs/benchmark.md:34-37). A Python
+// grpcio client costs ~300-500us of interpreter CPU per call, so on a
+// 2-vCPU box the *client* saturates long before a native server does;
+// this tool plays the reference benchmark tool's role at native speed:
+// N connections x M in-flight Txn-create calls, protobuf hand-encoded
+// (etcd TxnRequest create shape: compare mod_revision==0 -> put, the
+// exact transaction kube-apiserver emits, reference etcd/kv.go:160).
+//
+// usage: kbloadgen <host> <port> <total_ops> [conns] [inflight] [value_bytes]
+//        [key_prefix]
+// Prints one JSON line: {"ops":N,"seconds":S,"rate":R,"p50_us":..,"p99_us":..}
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <fcntl.h>
+#include <netinet/tcp.h>
+#include <sys/epoll.h>
+#include <sys/socket.h>
+#include <time.h>
+#include <unistd.h>
+
+#include <algorithm>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <map>
+#include <string>
+#include <vector>
+
+#include "nghttp2_min.h"
+
+namespace {
+
+uint64_t now_us() {
+  timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return static_cast<uint64_t>(ts.tv_sec) * 1000000u +
+         static_cast<uint64_t>(ts.tv_nsec) / 1000u;
+}
+
+// ------------------------------------------------------- protobuf encoding
+void pb_varint(std::string &out, uint64_t v) {
+  while (v >= 0x80) {
+    out.push_back(static_cast<char>((v & 0x7f) | 0x80));
+    v >>= 7;
+  }
+  out.push_back(static_cast<char>(v));
+}
+void pb_tag(std::string &out, int field, int wire) {
+  pb_varint(out, static_cast<uint64_t>((field << 3) | wire));
+}
+void pb_bytes(std::string &out, int field, const std::string &b) {
+  pb_tag(out, field, 2);
+  pb_varint(out, b.size());
+  out.append(b);
+}
+
+// etcd Txn create: compare(target=MOD, key, mod_revision=0) ->
+// success put(key,value) / failure range(key)
+std::string encode_txn_create(const std::string &key, const std::string &val) {
+  std::string cmp;
+  pb_tag(cmp, 2, 0);  // target
+  pb_varint(cmp, 2);  // MOD
+  pb_bytes(cmp, 3, key);
+  pb_tag(cmp, 6, 0);  // mod_revision (oneof: presence matters)
+  pb_varint(cmp, 0);
+
+  std::string put;
+  pb_bytes(put, 1, key);
+  pb_bytes(put, 2, val);
+  std::string op_put;
+  pb_bytes(op_put, 2, put);  // RequestOp.request_put
+
+  std::string rng;
+  pb_bytes(rng, 1, key);
+  std::string op_rng;
+  pb_bytes(op_rng, 1, rng);  // RequestOp.request_range
+
+  std::string txn;
+  pb_bytes(txn, 1, cmp);
+  pb_bytes(txn, 2, op_put);
+  pb_bytes(txn, 3, op_rng);
+  return txn;
+}
+
+// TxnResponse top-level scan for field 2 (succeeded, varint)
+bool parse_txn_succeeded(const uint8_t *p, size_t n) {
+  size_t off = 0;
+  while (off < n) {
+    uint64_t tag = 0;
+    int shift = 0;
+    while (off < n) {
+      uint8_t b = p[off++];
+      tag |= static_cast<uint64_t>(b & 0x7f) << shift;
+      if (!(b & 0x80)) break;
+      shift += 7;
+    }
+    int field = static_cast<int>(tag >> 3);
+    int wire = static_cast<int>(tag & 7);
+    if (wire == 0) {
+      uint64_t v = 0;
+      shift = 0;
+      while (off < n) {
+        uint8_t b = p[off++];
+        v |= static_cast<uint64_t>(b & 0x7f) << shift;
+        if (!(b & 0x80)) break;
+        shift += 7;
+      }
+      if (field == 2) return v != 0;
+    } else if (wire == 2) {
+      uint64_t len = 0;
+      shift = 0;
+      while (off < n) {
+        uint8_t b = p[off++];
+        len |= static_cast<uint64_t>(b & 0x7f) << shift;
+        if (!(b & 0x80)) break;
+        shift += 7;
+      }
+      off += len;
+    } else {
+      return false;  // unexpected wire type
+    }
+  }
+  return false;
+}
+
+// ------------------------------------------------------------ client conn
+struct LoadStream {
+  std::string body;  // gRPC-framed request
+  size_t off = 0;
+  uint64_t start_us = 0;
+  std::string resp;
+};
+
+struct LoadConn {
+  int fd = -1;
+  nghttp2_session *session = nullptr;
+  std::string outbuf;
+  std::map<int32_t, LoadStream> streams;
+  int inflight = 0;
+};
+
+struct Gen {
+  std::string host = "127.0.0.1";
+  int port = 0;
+  long total_ops = 0;
+  long started = 0;
+  long completed = 0;
+  long failed = 0;
+  int value_bytes = 512;
+  std::string prefix = "/registry/pods/load";
+  std::vector<uint64_t> lat_us;
+  std::string value;
+};
+
+Gen g;
+
+}  // namespace
+
+// Provider-by-lookup: nghttp2 gives us the stream id, so resolve the body
+// from the owning connection's map (set as session user data).
+static ssize_t body_read_lookup_cb(nghttp2_session *session, int32_t sid,
+                                   uint8_t *buf, size_t length,
+                                   uint32_t *data_flags, nghttp2_data_source *,
+                                   void *user_data) {
+  LoadConn *c = static_cast<LoadConn *>(user_data);
+  auto it = c->streams.find(sid);
+  if (it == c->streams.end()) return NGHTTP2_ERR_TEMPORAL_CALLBACK_FAILURE;
+  LoadStream &st = it->second;
+  size_t left = st.body.size() - st.off;
+  size_t n = left < length ? left : length;
+  memcpy(buf, st.body.data() + st.off, n);
+  st.off += n;
+  if (st.off == st.body.size()) *data_flags |= NGHTTP2_DATA_FLAG_EOF;
+  (void)session;
+  return static_cast<ssize_t>(n);
+}
+
+namespace {
+
+nghttp2_nv mknv(const char *name, const char *value) {
+  nghttp2_nv nv;
+  nv.name = reinterpret_cast<uint8_t *>(const_cast<char *>(name));
+  nv.value = reinterpret_cast<uint8_t *>(const_cast<char *>(value));
+  nv.namelen = strlen(name);
+  nv.valuelen = strlen(value);
+  nv.flags = NGHTTP2_NV_FLAG_NONE;
+  return nv;
+}
+
+void submit_one_v2(LoadConn *c) {
+  if (g.started >= g.total_ops) return;
+  long seq = g.started++;
+  char keybuf[160];
+  snprintf(keybuf, sizeof keybuf, "%s-%012ld", g.prefix.c_str(), seq);
+  std::string msg = encode_txn_create(keybuf, g.value);
+  std::string framed;
+  framed.push_back('\0');
+  uint8_t l4[4] = {static_cast<uint8_t>(msg.size() >> 24),
+                   static_cast<uint8_t>(msg.size() >> 16),
+                   static_cast<uint8_t>(msg.size() >> 8),
+                   static_cast<uint8_t>(msg.size())};
+  framed.append(reinterpret_cast<char *>(l4), 4);
+  framed.append(msg);
+
+  static char authority[64];
+  snprintf(authority, sizeof authority, "%s:%d", g.host.c_str(), g.port);
+  nghttp2_nv hdrs[] = {
+      mknv(":method", "POST"),        mknv(":scheme", "http"),
+      mknv(":authority", authority),  mknv(":path", "/etcdserverpb.KV/Txn"),
+      mknv("content-type", "application/grpc"), mknv("te", "trailers"),
+  };
+  nghttp2_data_provider prd;
+  prd.source.ptr = nullptr;
+  prd.read_callback = body_read_lookup_cb;
+  int32_t sid = nghttp2_submit_request(c->session, nullptr, hdrs, 6, &prd, nullptr);
+  if (sid < 0) {
+    fprintf(stderr, "submit_request: %s\n", nghttp2_strerror(sid));
+    g.started--;
+    return;
+  }
+  LoadStream &st = c->streams[sid];
+  st.body = std::move(framed);
+  st.start_us = now_us();
+  c->inflight++;
+}
+
+int on_data_chunk(nghttp2_session *, uint8_t, int32_t sid, const uint8_t *data,
+                  size_t len, void *user_data) {
+  LoadConn *c = static_cast<LoadConn *>(user_data);
+  auto it = c->streams.find(sid);
+  if (it != c->streams.end())
+    it->second.resp.append(reinterpret_cast<const char *>(data), len);
+  return 0;
+}
+
+int on_stream_close(nghttp2_session *, int32_t sid, uint32_t error_code,
+                    void *user_data) {
+  LoadConn *c = static_cast<LoadConn *>(user_data);
+  auto it = c->streams.find(sid);
+  if (it == c->streams.end()) return 0;
+  LoadStream &st = it->second;
+  bool ok = false;
+  if (error_code == 0 && st.resp.size() > 5) {
+    ok = parse_txn_succeeded(
+        reinterpret_cast<const uint8_t *>(st.resp.data()) + 5,
+        st.resp.size() - 5);
+  }
+  g.completed++;
+  if (!ok) g.failed++;
+  g.lat_us.push_back(now_us() - st.start_us);
+  c->streams.erase(it);
+  c->inflight--;
+  return 0;
+}
+
+void conn_flush(LoadConn *c) {
+  while (nghttp2_session_want_write(c->session)) {
+    const uint8_t *out;
+    ssize_t n = nghttp2_session_mem_send(c->session, &out);
+    if (n <= 0) break;
+    c->outbuf.append(reinterpret_cast<const char *>(out),
+                     static_cast<size_t>(n));
+  }
+  while (!c->outbuf.empty()) {
+    ssize_t w = write(c->fd, c->outbuf.data(), c->outbuf.size());
+    if (w > 0) {
+      c->outbuf.erase(0, static_cast<size_t>(w));
+    } else if (w < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+      break;
+    } else {
+      perror("write");
+      exit(1);
+    }
+  }
+}
+
+}  // namespace
+
+int main(int argc, char **argv) {
+  if (argc < 4) {
+    fprintf(stderr,
+            "usage: kbloadgen <host> <port> <total_ops> [conns] [inflight] "
+            "[value_bytes] [key_prefix]\n");
+    return 1;
+  }
+  g.host = argv[1];
+  g.port = atoi(argv[2]);
+  g.total_ops = atol(argv[3]);
+  int nconns = argc > 4 ? atoi(argv[4]) : 8;
+  int inflight = argc > 5 ? atoi(argv[5]) : 32;
+  g.value_bytes = argc > 6 ? atoi(argv[6]) : 512;
+  if (argc > 7) g.prefix = argv[7];
+  g.value.assign(static_cast<size_t>(g.value_bytes), 'x');
+  g.lat_us.reserve(static_cast<size_t>(g.total_ops));
+
+  std::vector<LoadConn *> conns;
+  int epfd = epoll_create1(0);
+  for (int i = 0; i < nconns; i++) {
+    int fd = socket(AF_INET, SOCK_STREAM, 0);
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(static_cast<uint16_t>(g.port));
+    inet_pton(AF_INET, g.host.c_str(), &addr.sin_addr);
+    if (connect(fd, reinterpret_cast<sockaddr *>(&addr), sizeof addr) != 0) {
+      perror("connect");
+      return 1;
+    }
+    int one = 1;
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
+    int fl = fcntl(fd, F_GETFL, 0);
+    fcntl(fd, F_SETFL, fl | O_NONBLOCK);
+    LoadConn *c = new LoadConn();
+    c->fd = fd;
+    nghttp2_session_callbacks *cbs;
+    nghttp2_session_callbacks_new(&cbs);
+    nghttp2_session_callbacks_set_on_data_chunk_recv_callback(cbs, on_data_chunk);
+    nghttp2_session_callbacks_set_on_stream_close_callback(cbs, on_stream_close);
+    nghttp2_session_client_new(&c->session, cbs, c);
+    nghttp2_session_callbacks_del(cbs);
+    nghttp2_settings_entry iv[2] = {
+        {NGHTTP2_SETTINGS_MAX_CONCURRENT_STREAMS, 4096},
+        {NGHTTP2_SETTINGS_INITIAL_WINDOW_SIZE, 1 << 20},
+    };
+    nghttp2_submit_settings(c->session, NGHTTP2_FLAG_NONE, iv, 2);
+    conns.push_back(c);
+    epoll_event ev{};
+    ev.events = EPOLLIN;
+    ev.data.u32 = static_cast<uint32_t>(i);
+    epoll_ctl(epfd, EPOLL_CTL_ADD, fd, &ev);
+  }
+
+  uint64_t t0 = now_us();
+  for (LoadConn *c : conns) {
+    for (int j = 0; j < inflight && g.started < g.total_ops; j++) submit_one_v2(c);
+    conn_flush(c);
+  }
+
+  char buf[1 << 16];
+  epoll_event events[64];
+  while (g.completed < g.total_ops) {
+    int n = epoll_wait(epfd, events, 64, 1000);
+    if (n < 0) {
+      if (errno == EINTR) continue;
+      perror("epoll_wait");
+      return 1;
+    }
+    for (int i = 0; i < n; i++) {
+      LoadConn *c = conns[events[i].data.u32];
+      ssize_t r;
+      while ((r = read(c->fd, buf, sizeof buf)) > 0) {
+        ssize_t rv = nghttp2_session_mem_recv(
+            c->session, reinterpret_cast<uint8_t *>(buf),
+            static_cast<size_t>(r));
+        if (rv < 0) {
+          fprintf(stderr, "mem_recv: %s\n", nghttp2_strerror((int)rv));
+          return 1;
+        }
+      }
+      if (r == 0) {
+        fprintf(stderr, "server closed connection\n");
+        return 1;
+      }
+      if (r < 0 && errno != EAGAIN && errno != EWOULDBLOCK) {
+        perror("read");
+        return 1;
+      }
+      // top up the pipeline
+      while (c->inflight < inflight && g.started < g.total_ops) submit_one_v2(c);
+      conn_flush(c);
+    }
+  }
+  uint64_t dt = now_us() - t0;
+
+  std::sort(g.lat_us.begin(), g.lat_us.end());
+  auto pct = [&](double p) -> uint64_t {
+    if (g.lat_us.empty()) return 0;
+    size_t idx = static_cast<size_t>(p * (g.lat_us.size() - 1));
+    return g.lat_us[idx];
+  };
+  printf(
+      "{\"ops\": %ld, \"failed\": %ld, \"seconds\": %.3f, \"rate\": %.0f, "
+      "\"avg_us\": %.0f, \"p50_us\": %lu, \"p99_us\": %lu}\n",
+      g.completed, g.failed, dt / 1e6, g.completed / (dt / 1e6),
+      g.lat_us.empty() ? 0.0
+                       : [&] {
+                           double s = 0;
+                           for (uint64_t v : g.lat_us) s += static_cast<double>(v);
+                           return s / static_cast<double>(g.lat_us.size());
+                         }(),
+      pct(0.5), pct(0.99));
+  for (LoadConn *c : conns) {
+    nghttp2_session_del(c->session);
+    close(c->fd);
+    delete c;
+  }
+  close(epfd);
+  return 0;
+}

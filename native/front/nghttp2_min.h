@@ -1,0 +1,181 @@
+// Minimal hand-written ABI declarations for the system libnghttp2.so.14
+// (nghttp2 1.52.0). The distro ships the runtime library but not the
+// -dev headers, so we declare exactly the subset of the public API the
+// kbfront gRPC frontend uses. Struct layouts below are part of nghttp2's
+// stable public ABI (nghttp2.h); everything else stays opaque behind
+// pointers. Verified behaviorally by tests/test_front.py driving a real
+// grpcio client against the spike server.
+//
+// This replaces what the reference gets from its gRPC runtime dependency
+// (the reference terminates etcd3 gRPC via google.golang.org/grpc).
+#pragma once
+
+#include <stddef.h>
+#include <stdint.h>
+#include <sys/types.h>
+
+extern "C" {
+
+typedef struct nghttp2_session nghttp2_session;
+typedef struct nghttp2_session_callbacks nghttp2_session_callbacks;
+typedef struct nghttp2_option nghttp2_option;
+
+typedef struct {
+  uint8_t *name;
+  uint8_t *value;
+  size_t namelen;
+  size_t valuelen;
+  uint8_t flags;
+} nghttp2_nv;
+
+typedef struct {
+  size_t length;
+  int32_t stream_id;
+  uint8_t type;
+  uint8_t flags;
+  uint8_t reserved;
+} nghttp2_frame_hd;
+
+// We only ever read frame->hd, which every frame type begins with.
+typedef struct {
+  nghttp2_frame_hd hd;
+} nghttp2_frame;
+
+typedef struct {
+  int32_t settings_id;
+  uint32_t value;
+} nghttp2_settings_entry;
+
+typedef union {
+  int fd;
+  void *ptr;
+} nghttp2_data_source;
+
+typedef ssize_t (*nghttp2_data_source_read_callback)(
+    nghttp2_session *session, int32_t stream_id, uint8_t *buf, size_t length,
+    uint32_t *data_flags, nghttp2_data_source *source, void *user_data);
+
+typedef struct {
+  nghttp2_data_source source;
+  nghttp2_data_source_read_callback read_callback;
+} nghttp2_data_provider;
+
+// ---- constants (values fixed by the public API / RFC 7540) ----
+enum {
+  NGHTTP2_FLAG_NONE = 0,
+  NGHTTP2_FLAG_END_STREAM = 0x01,
+  NGHTTP2_FLAG_END_HEADERS = 0x04,
+};
+enum {
+  NGHTTP2_DATA = 0,
+  NGHTTP2_HEADERS = 1,
+  NGHTTP2_RST_STREAM = 3,
+  NGHTTP2_SETTINGS = 4,
+  NGHTTP2_GOAWAY = 7,
+  NGHTTP2_WINDOW_UPDATE = 8,
+};
+enum {
+  NGHTTP2_SETTINGS_HEADER_TABLE_SIZE = 1,
+  NGHTTP2_SETTINGS_ENABLE_PUSH = 2,
+  NGHTTP2_SETTINGS_MAX_CONCURRENT_STREAMS = 3,
+  NGHTTP2_SETTINGS_INITIAL_WINDOW_SIZE = 4,
+  NGHTTP2_SETTINGS_MAX_FRAME_SIZE = 5,
+  NGHTTP2_SETTINGS_MAX_HEADER_LIST_SIZE = 6,
+};
+enum {
+  NGHTTP2_DATA_FLAG_NONE = 0,
+  NGHTTP2_DATA_FLAG_EOF = 0x01,
+  NGHTTP2_DATA_FLAG_NO_END_STREAM = 0x02,
+};
+enum {
+  NGHTTP2_ERR_WOULDBLOCK = -504,
+  NGHTTP2_ERR_EOF = -507,
+  NGHTTP2_ERR_DEFERRED = -508,
+  NGHTTP2_ERR_TEMPORAL_CALLBACK_FAILURE = -521,
+  NGHTTP2_ERR_CALLBACK_FAILURE = -902,
+};
+enum {
+  NGHTTP2_NO_ERROR = 0,
+  NGHTTP2_PROTOCOL_ERROR = 1,
+  NGHTTP2_INTERNAL_ERROR = 2,
+};
+enum { NGHTTP2_NV_FLAG_NONE = 0 };
+
+// ---- callbacks ----
+typedef int (*nghttp2_on_frame_recv_callback)(nghttp2_session *,
+                                              const nghttp2_frame *, void *);
+typedef int (*nghttp2_on_begin_headers_callback)(nghttp2_session *,
+                                                 const nghttp2_frame *, void *);
+typedef int (*nghttp2_on_header_callback)(nghttp2_session *,
+                                          const nghttp2_frame *,
+                                          const uint8_t *name, size_t namelen,
+                                          const uint8_t *value, size_t valuelen,
+                                          uint8_t flags, void *);
+typedef int (*nghttp2_on_data_chunk_recv_callback)(nghttp2_session *,
+                                                   uint8_t flags,
+                                                   int32_t stream_id,
+                                                   const uint8_t *data,
+                                                   size_t len, void *);
+typedef int (*nghttp2_on_stream_close_callback)(nghttp2_session *,
+                                                int32_t stream_id,
+                                                uint32_t error_code, void *);
+
+int nghttp2_session_callbacks_new(nghttp2_session_callbacks **callbacks_ptr);
+void nghttp2_session_callbacks_del(nghttp2_session_callbacks *callbacks);
+void nghttp2_session_callbacks_set_on_frame_recv_callback(
+    nghttp2_session_callbacks *, nghttp2_on_frame_recv_callback);
+void nghttp2_session_callbacks_set_on_begin_headers_callback(
+    nghttp2_session_callbacks *, nghttp2_on_begin_headers_callback);
+void nghttp2_session_callbacks_set_on_header_callback(
+    nghttp2_session_callbacks *, nghttp2_on_header_callback);
+void nghttp2_session_callbacks_set_on_data_chunk_recv_callback(
+    nghttp2_session_callbacks *, nghttp2_on_data_chunk_recv_callback);
+void nghttp2_session_callbacks_set_on_stream_close_callback(
+    nghttp2_session_callbacks *, nghttp2_on_stream_close_callback);
+
+int nghttp2_session_server_new(nghttp2_session **session_ptr,
+                               const nghttp2_session_callbacks *callbacks,
+                               void *user_data);
+int nghttp2_session_client_new(nghttp2_session **session_ptr,
+                               const nghttp2_session_callbacks *callbacks,
+                               void *user_data);
+void nghttp2_session_del(nghttp2_session *session);
+
+ssize_t nghttp2_session_mem_recv(nghttp2_session *session, const uint8_t *in,
+                                 size_t inlen);
+ssize_t nghttp2_session_mem_send(nghttp2_session *session,
+                                 const uint8_t **data_ptr);
+int nghttp2_session_want_read(nghttp2_session *session);
+int nghttp2_session_want_write(nghttp2_session *session);
+
+int nghttp2_submit_settings(nghttp2_session *session, uint8_t flags,
+                            const nghttp2_settings_entry *iv, size_t niv);
+int nghttp2_submit_response(nghttp2_session *session, int32_t stream_id,
+                            const nghttp2_nv *nva, size_t nvlen,
+                            const nghttp2_data_provider *data_prd);
+int nghttp2_submit_headers(nghttp2_session *session, uint8_t flags,
+                           int32_t stream_id, const void *pri_spec,
+                           const nghttp2_nv *nva, size_t nvlen,
+                           void *stream_user_data);
+int nghttp2_submit_data(nghttp2_session *session, uint8_t flags,
+                        int32_t stream_id,
+                        const nghttp2_data_provider *data_prd);
+int nghttp2_submit_trailer(nghttp2_session *session, int32_t stream_id,
+                           const nghttp2_nv *nva, size_t nvlen);
+int nghttp2_submit_rst_stream(nghttp2_session *session, uint8_t flags,
+                              int32_t stream_id, uint32_t error_code);
+int nghttp2_submit_request(nghttp2_session *session, const void *pri_spec,
+                           const nghttp2_nv *nva, size_t nvlen,
+                           const nghttp2_data_provider *data_prd,
+                           void *stream_user_data);
+int nghttp2_session_resume_data(nghttp2_session *session, int32_t stream_id);
+int nghttp2_session_terminate_session(nghttp2_session *session,
+                                      uint32_t error_code);
+void *nghttp2_session_get_stream_user_data(nghttp2_session *session,
+                                           int32_t stream_id);
+int nghttp2_session_set_stream_user_data(nghttp2_session *session,
+                                         int32_t stream_id,
+                                         void *stream_user_data);
+const char *nghttp2_strerror(int lib_error_code);
+
+}  // extern "C"

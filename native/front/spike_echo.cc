@@ -1,0 +1,193 @@
+// ABI-validation spike: a single-connection gRPC echo server built directly
+// on the system libnghttp2 via nghttp2_min.h. Accepts any unary gRPC call
+// and echoes the request message bytes back as the response message.
+// Driven by tests/test_front.py with a real grpcio client; its only job is
+// to prove the hand-declared ABI (struct layouts, callback signatures,
+// data-provider protocol incl. trailers) is correct before kbfront builds
+// on it.
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstdio>
+#include <cstring>
+#include <map>
+#include <string>
+#include <vector>
+
+#include "nghttp2_min.h"
+
+struct Stream {
+  std::string path;
+  std::string body;        // raw DATA bytes received (gRPC framing included)
+  std::string resp;        // response bytes to send (gRPC framed)
+  size_t resp_off = 0;
+  bool end_stream = false; // client half-closed
+  bool responded = false;
+};
+
+struct Conn {
+  int fd;
+  nghttp2_session *session = nullptr;
+  std::map<int32_t, Stream> streams;
+};
+
+static nghttp2_nv mknv(const char *name, const char *value) {
+  nghttp2_nv nv;
+  nv.name = reinterpret_cast<uint8_t *>(const_cast<char *>(name));
+  nv.value = reinterpret_cast<uint8_t *>(const_cast<char *>(value));
+  nv.namelen = strlen(name);
+  nv.valuelen = strlen(value);
+  nv.flags = NGHTTP2_NV_FLAG_NONE;
+  return nv;
+}
+
+static ssize_t resp_read_cb(nghttp2_session *session, int32_t stream_id,
+                            uint8_t *buf, size_t length, uint32_t *data_flags,
+                            nghttp2_data_source *source, void *) {
+  Stream *st = static_cast<Stream *>(source->ptr);
+  size_t left = st->resp.size() - st->resp_off;
+  size_t n = left < length ? left : length;
+  memcpy(buf, st->resp.data() + st->resp_off, n);
+  st->resp_off += n;
+  if (st->resp_off == st->resp.size()) {
+    // EOF on data, but trailers follow (grpc-status). Submitting the
+    // trailer HERE guarantees its HEADERS frame is queued after the final
+    // DATA frame.
+    *data_flags |= NGHTTP2_DATA_FLAG_EOF | NGHTTP2_DATA_FLAG_NO_END_STREAM;
+    nghttp2_nv trailers[1] = {mknv("grpc-status", "0")};
+    int rv = nghttp2_submit_trailer(session, stream_id, trailers, 1);
+    if (rv != 0) fprintf(stderr, "submit_trailer: %s\n", nghttp2_strerror(rv));
+  }
+  return static_cast<ssize_t>(n);
+}
+
+static void maybe_respond(Conn *c, int32_t sid) {
+  Stream &st = c->streams[sid];
+  if (!st.end_stream || st.responded) return;
+  st.responded = true;
+  st.resp = st.body;  // echo, gRPC frame and all
+  st.resp_off = 0;
+
+  nghttp2_nv hdrs[2] = {mknv(":status", "200"),
+                        mknv("content-type", "application/grpc")};
+  nghttp2_data_provider prd;
+  prd.source.ptr = &st;
+  prd.read_callback = resp_read_cb;
+  int rv = nghttp2_submit_response(c->session, sid, hdrs, 2, &prd);
+  if (rv != 0) fprintf(stderr, "submit_response: %s\n", nghttp2_strerror(rv));
+}
+
+static int on_begin_headers(nghttp2_session *, const nghttp2_frame *frame,
+                            void *user_data) {
+  Conn *c = static_cast<Conn *>(user_data);
+  if (frame->hd.type == NGHTTP2_HEADERS)
+    c->streams[frame->hd.stream_id];  // create
+  return 0;
+}
+
+static int on_header(nghttp2_session *, const nghttp2_frame *frame,
+                     const uint8_t *name, size_t namelen, const uint8_t *value,
+                     size_t valuelen, uint8_t, void *user_data) {
+  Conn *c = static_cast<Conn *>(user_data);
+  if (namelen == 5 && memcmp(name, ":path", 5) == 0) {
+    c->streams[frame->hd.stream_id].path.assign(
+        reinterpret_cast<const char *>(value), valuelen);
+    fprintf(stderr, "spike: path=%.*s\n", (int)valuelen, value);
+  }
+  return 0;
+}
+
+static int on_data_chunk(nghttp2_session *, uint8_t, int32_t sid,
+                         const uint8_t *data, size_t len, void *user_data) {
+  Conn *c = static_cast<Conn *>(user_data);
+  c->streams[sid].body.append(reinterpret_cast<const char *>(data), len);
+  return 0;
+}
+
+static int on_frame_recv(nghttp2_session *, const nghttp2_frame *frame,
+                         void *user_data) {
+  Conn *c = static_cast<Conn *>(user_data);
+  if ((frame->hd.type == NGHTTP2_DATA || frame->hd.type == NGHTTP2_HEADERS) &&
+      (frame->hd.flags & NGHTTP2_FLAG_END_STREAM)) {
+    c->streams[frame->hd.stream_id].end_stream = true;
+    maybe_respond(c, frame->hd.stream_id);
+  }
+  return 0;
+}
+
+static int on_stream_close(nghttp2_session *, int32_t sid, uint32_t,
+                           void *user_data) {
+  Conn *c = static_cast<Conn *>(user_data);
+  c->streams.erase(sid);
+  return 0;
+}
+
+int main(int argc, char **argv) {
+  int port = argc > 1 ? atoi(argv[1]) : 28000;
+  int lfd = socket(AF_INET, SOCK_STREAM, 0);
+  int one = 1;
+  setsockopt(lfd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof one);
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (bind(lfd, reinterpret_cast<sockaddr *>(&addr), sizeof addr) != 0) {
+    perror("bind");
+    return 1;
+  }
+  listen(lfd, 16);
+  fprintf(stderr, "spike: listening on %d\n", port);
+
+  int fd = accept(lfd, nullptr, nullptr);
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
+  Conn conn;
+  conn.fd = fd;
+
+  nghttp2_session_callbacks *cbs;
+  nghttp2_session_callbacks_new(&cbs);
+  nghttp2_session_callbacks_set_on_begin_headers_callback(cbs, on_begin_headers);
+  nghttp2_session_callbacks_set_on_header_callback(cbs, on_header);
+  nghttp2_session_callbacks_set_on_data_chunk_recv_callback(cbs, on_data_chunk);
+  nghttp2_session_callbacks_set_on_frame_recv_callback(cbs, on_frame_recv);
+  nghttp2_session_callbacks_set_on_stream_close_callback(cbs, on_stream_close);
+  nghttp2_session_server_new(&conn.session, cbs, &conn);
+  nghttp2_session_callbacks_del(cbs);
+
+  nghttp2_settings_entry iv[2] = {
+      {NGHTTP2_SETTINGS_MAX_CONCURRENT_STREAMS, 1024},
+      {NGHTTP2_SETTINGS_INITIAL_WINDOW_SIZE, 1 << 20},
+  };
+  nghttp2_submit_settings(conn.session, NGHTTP2_FLAG_NONE, iv, 2);
+
+  uint8_t buf[65536];
+  while (true) {
+    // flush pending output
+    while (nghttp2_session_want_write(conn.session)) {
+      const uint8_t *out;
+      ssize_t n = nghttp2_session_mem_send(conn.session, &out);
+      if (n <= 0) break;
+      ssize_t off = 0;
+      while (off < n) {
+        ssize_t w = write(fd, out + off, static_cast<size_t>(n - off));
+        if (w <= 0) { perror("write"); return 1; }
+        off += w;
+      }
+    }
+    if (!nghttp2_session_want_read(conn.session)) break;
+    ssize_t n = read(fd, buf, sizeof buf);
+    if (n <= 0) break;
+    ssize_t rv = nghttp2_session_mem_recv(conn.session, buf, static_cast<size_t>(n));
+    if (rv < 0) {
+      fprintf(stderr, "mem_recv: %s\n", nghttp2_strerror(static_cast<int>(rv)));
+      return 1;
+    }
+  }
+  nghttp2_session_del(conn.session);
+  close(fd);
+  close(lfd);
+  fprintf(stderr, "spike: done\n");
+  return 0;
+}

@@ -682,7 +682,9 @@ int kb_mvcc_delete(void* s,
     obj_old[rkl - 8 + i] = static_cast<char>((latest >> (8 * (7 - i))) & 0xFF);
   }
   const std::string* prev = st->live(obj_old, st->ts, now);
-  if (prev != nullptr) {
+  if (prev != nullptr && !prev->empty()) {
+    // empty previous values stay {nullptr, 0}: the python adapter frees on
+    // prev_len truthiness, so a malloc(0) here would leak
     *prev_val = static_cast<uint8_t*>(malloc(prev->size()));
     memcpy(*prev_val, prev->data(), prev->size());
     *prev_len = prev->size();

@@ -1,0 +1,231 @@
+"""Native gRPC/HTTP frontend (kbfront) tests.
+
+Covers the ABI spike contract and the full backhaul path: a real grpcio
+client speaks etcd3 to the C++ frontend, which forwards de-framed requests
+over the unix backhaul to the Python terminals. Also the single-port
+HTTP/1+h2 demux (reference cmux, pkg/endpoint/server.go:65-100).
+"""
+
+import os
+import socket
+import subprocess
+import threading
+import time
+import urllib.request
+
+import grpc
+import pytest
+
+from kubebrain_tpu.backend import Backend, BackendConfig
+from kubebrain_tpu.endpoint.front import FrontServer
+from kubebrain_tpu.proto import rpc_pb2
+from kubebrain_tpu.server import Server
+from kubebrain_tpu.server.service import SingleNodePeerService
+from kubebrain_tpu.storage import new_storage
+
+FRONT_BIN = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "native", "front", "kbfront",
+)
+
+pytestmark = pytest.mark.skipif(
+    not os.path.exists(FRONT_BIN), reason="kbfront not built (make -C native)"
+)
+
+
+def free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+class FrontFixture:
+    def __init__(self):
+        self.store = new_storage("memkv")
+        self.backend = Backend(
+            self.store, BackendConfig(event_ring_capacity=4096, watch_cache_capacity=4096)
+        )
+        self.peers = SingleNodePeerService(self.backend, "front-test:0")
+        self.server = Server(
+            self.backend, self.peers, None, "front-test:0", client_urls=[]
+        )
+        self.front = FrontServer(
+            self.backend, self.peers, self.server, "front-test:0",
+            brain=self.server.brain,
+        )
+        self.port = free_port()
+        self.front.run(self.port)
+        self.channel = grpc.insecure_channel(f"127.0.0.1:{self.port}")
+        p = rpc_pb2
+        self.txn = self.channel.unary_unary(
+            "/etcdserverpb.KV/Txn",
+            request_serializer=p.TxnRequest.SerializeToString,
+            response_deserializer=p.TxnResponse.FromString,
+        )
+        self.range_ = self.channel.unary_unary(
+            "/etcdserverpb.KV/Range",
+            request_serializer=p.RangeRequest.SerializeToString,
+            response_deserializer=p.RangeResponse.FromString,
+        )
+        self.watch = self.channel.stream_stream(
+            "/etcdserverpb.Watch/Watch",
+            request_serializer=p.WatchRequest.SerializeToString,
+            response_deserializer=p.WatchResponse.FromString,
+        )
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            try:
+                grpc.channel_ready_future(self.channel).result(timeout=1)
+                break
+            except grpc.FutureTimeoutError:
+                pass
+
+    def create(self, key, value):
+        return self.txn(rpc_pb2.TxnRequest(
+            compare=[rpc_pb2.Compare(
+                target=rpc_pb2.Compare.MOD, key=key, mod_revision=0)],
+            success=[rpc_pb2.RequestOp(
+                request_put=rpc_pb2.PutRequest(key=key, value=value))],
+            failure=[rpc_pb2.RequestOp(
+                request_range=rpc_pb2.RangeRequest(key=key))],
+        ))
+
+    def close(self):
+        self.channel.close()
+        self.front.close()
+        self.backend.close()
+        self.store.close()
+
+
+@pytest.fixture(scope="module")
+def front():
+    f = FrontFixture()
+    yield f
+    f.close()
+
+
+def test_front_txn_create_and_range(front):
+    r = front.create(b"/registry/f/a", b"va")
+    assert r.succeeded
+    rev = r.header.revision
+    assert rev >= 1
+    lst = front.range_(rpc_pb2.RangeRequest(key=b"/registry/f/", range_end=b"/registry/f0"))
+    assert lst.count == 1
+    assert lst.kvs[0].key == b"/registry/f/a"
+    assert lst.kvs[0].value == b"va"
+    assert lst.kvs[0].mod_revision == rev
+
+
+def test_front_txn_conflict(front):
+    front.create(b"/registry/f/dup", b"v1")
+    r = front.create(b"/registry/f/dup", b"v2")
+    assert not r.succeeded  # create-on-existing fails the compare
+
+
+def test_front_update_delete(front):
+    r1 = front.create(b"/registry/f/u", b"v1")
+    rev1 = r1.header.revision
+    up = front.txn(rpc_pb2.TxnRequest(
+        compare=[rpc_pb2.Compare(
+            target=rpc_pb2.Compare.MOD, key=b"/registry/f/u", mod_revision=rev1)],
+        success=[rpc_pb2.RequestOp(
+            request_put=rpc_pb2.PutRequest(key=b"/registry/f/u", value=b"v2"))],
+        failure=[rpc_pb2.RequestOp(
+            request_range=rpc_pb2.RangeRequest(key=b"/registry/f/u"))],
+    ))
+    assert up.succeeded
+    rev2 = up.header.revision
+    de = front.txn(rpc_pb2.TxnRequest(
+        compare=[rpc_pb2.Compare(
+            target=rpc_pb2.Compare.MOD, key=b"/registry/f/u", mod_revision=rev2)],
+        success=[rpc_pb2.RequestOp(
+            request_delete_range=rpc_pb2.DeleteRangeRequest(key=b"/registry/f/u"))],
+        failure=[rpc_pb2.RequestOp(
+            request_range=rpc_pb2.RangeRequest(key=b"/registry/f/u"))],
+    ))
+    assert de.succeeded
+    got = front.range_(rpc_pb2.RangeRequest(key=b"/registry/f/u"))
+    assert got.count == 0
+
+
+def test_front_watch_stream(front):
+    r1 = front.create(b"/registry/fw/a", b"v1")
+    rev1 = r1.header.revision
+    got = []
+    done = threading.Event()
+
+    def reqs():
+        yield rpc_pb2.WatchRequest(create_request=rpc_pb2.WatchCreateRequest(
+            key=b"/registry/fw/", range_end=b"/registry/fw0", start_revision=rev1))
+        done.wait(20)
+
+    def consume():
+        for resp in front.watch(reqs()):
+            for ev in resp.events:
+                got.append((ev.type, bytes(ev.kv.key), ev.kv.mod_revision))
+                if len(got) >= 3:
+                    done.set()
+                    return
+
+    t = threading.Thread(target=consume, daemon=True)
+    t.start()
+    time.sleep(0.3)
+    front.create(b"/registry/fw/b", b"v2")
+    de = front.txn(rpc_pb2.TxnRequest(
+        compare=[rpc_pb2.Compare(
+            target=rpc_pb2.Compare.MOD, key=b"/registry/fw/a", mod_revision=rev1)],
+        success=[rpc_pb2.RequestOp(
+            request_delete_range=rpc_pb2.DeleteRangeRequest(key=b"/registry/fw/a"))],
+        failure=[rpc_pb2.RequestOp(
+            request_range=rpc_pb2.RangeRequest(key=b"/registry/fw/a"))],
+    ))
+    assert de.succeeded
+    t.join(timeout=20)
+    assert len(got) == 3, got
+    assert got[0] == (0, b"/registry/fw/a", rev1)       # replay PUT
+    assert got[1][0] == 0 and got[1][1] == b"/registry/fw/b"
+    assert got[2][0] == 1 and got[2][1] == b"/registry/fw/a"  # DELETE
+
+
+def test_front_http_same_port(front):
+    """Single-port demux: plain HTTP/1 on the gRPC port (cmux parity)."""
+    body = urllib.request.urlopen(
+        f"http://127.0.0.1:{front.port}/health", timeout=10).read()
+    assert b"true" in body
+    status = urllib.request.urlopen(
+        f"http://127.0.0.1:{front.port}/status", timeout=10).read()
+    assert b"revision" in status
+    with pytest.raises(urllib.error.HTTPError):
+        urllib.request.urlopen(
+            f"http://127.0.0.1:{front.port}/nope", timeout=10)
+
+
+def test_front_unknown_method(front):
+    call = front.channel.unary_unary(
+        "/etcdserverpb.KV/Nonexistent",
+        request_serializer=lambda b: bytes(b),
+        response_deserializer=lambda b: bytes(b),
+    )
+    with pytest.raises(grpc.RpcError) as ei:
+        call(b"", timeout=10)
+    assert ei.value.code() == grpc.StatusCode.UNIMPLEMENTED
+
+
+def test_front_brain_create_get(front):
+    from kubebrain_tpu.proto import brain_pb2
+    create = front.channel.unary_unary(
+        "/brainpb.Brain/Create",
+        request_serializer=brain_pb2.CreateRequest.SerializeToString,
+        response_deserializer=brain_pb2.CreateResponse.FromString,
+    )
+    get = front.channel.unary_unary(
+        "/brainpb.Brain/Get",
+        request_serializer=brain_pb2.GetRequest.SerializeToString,
+        response_deserializer=brain_pb2.GetResponse.FromString,
+    )
+    cr = create(brain_pb2.CreateRequest(key=b"/registry/fb/x", value=b"bv"), timeout=10)
+    assert cr.succeeded
+    g = get(brain_pb2.GetRequest(key=b"/registry/fb/x"), timeout=10)
+    assert g.kv.value == b"bv"
