@@ -23,8 +23,15 @@ def build_parser() -> argparse.ArgumentParser:
         prog="kubebrain-tpu",
         description="TPU-native etcd3-compatible metadata store for Kubernetes",
     )
-    p.add_argument("--storage", default="memkv", choices=["memkv", "tpu", "native"],
-                   help="storage engine (reference: build-tag selected TiKV/Badger)")
+    p.add_argument("--storage", default="memkv",
+                   choices=["memkv", "tpu", "native", "remote"],
+                   help="storage engine (reference: build-tag selected TiKV/Badger; "
+                        "'remote' = shared kbstored server, the TiKV role)")
+    p.add_argument("--storage-address", default="127.0.0.1:2389",
+                   help="kbstored address for --storage=remote")
+    p.add_argument("--storage-pool", type=int, default=8,
+                   help="connection pool size to kbstored (reference keeps "
+                        "200 round-robin TiKV clients, tikv.go:36-82)")
     p.add_argument("--inner-storage", default="memkv",
                    help="host engine backing the tpu mirror (tpu engine only)")
     p.add_argument("--data-dir", default="",
@@ -134,6 +141,11 @@ def build_endpoint(args):
         store = new_storage("tpu", inner=args.inner_storage, **inner_kw)
     elif args.storage == "native":
         store = new_storage("native", **native_kw)
+    elif args.storage == "remote":
+        store = new_storage(
+            "remote", address=args.storage_address, pool=args.storage_pool,
+            partitions=args.native_partitions,
+        )
     else:
         store = new_storage(args.storage)
     if args.enable_storage_metrics:
@@ -206,6 +218,7 @@ def build_endpoint(args):
         front = FrontServer(
             backend, peers, server, identity, metrics=metrics,
             brain=server.brain,
+            inline_unary=args.storage != "remote",
         )
         _frun, _fclose = endpoint.run, endpoint.close
 

@@ -65,7 +65,12 @@ class FrontServer:
     """Backhaul listener + kbfront subprocess supervisor."""
 
     def __init__(self, backend, peers=None, server=None, identity="kubebrain-tpu",
-                 metrics=None, brain=None):
+                 metrics=None, brain=None, inline_unary: bool = True):
+        # inline_unary: run unary terminals on the event loop (right for
+        # in-process engines, ~tens of us/op). With a NETWORK engine
+        # (--storage=remote) every op is a TCP round trip that would stall
+        # all frontend traffic — those run in the executor instead.
+        self._inline_unary = inline_unary
         self.backend = backend
         self.peers = peers
         self.server = server  # Server composite for /status etc (may be None)
@@ -273,23 +278,14 @@ class FrontServer:
         elif kind == K_HALF_CLOSE:
             pending = self._unary_pending.pop(key, None)
             if pending is not None:
-                (req_cls, fn), raw = pending
-                try:
-                    resp = fn(req_cls.FromString(raw), _SYNC_CTX)
-                    out = resp.SerializeToString()
-                    w = self._writer
-                    if w is not None and not w.is_closing():
-                        # MSG + END in one write() call
-                        w.write(
-                            _HDR.pack(len(out), cid, sid, K_MSG) + out
-                            + _HDR.pack(6, cid, sid, K_END) + _END_OK
-                        )
-                except _AbortError as e:
-                    self._send_end(cid, sid, _status_num(e.code), e.details)
-                except Exception as exc:
-                    logger.exception("front unary failed")
-                    self._send_end(
-                        cid, sid, _status_num(grpc.StatusCode.INTERNAL), str(exc))
+                if self._inline_unary:
+                    self._unary_finish(cid, sid, pending)
+                else:
+                    loop = asyncio.get_running_loop()
+                    fut = loop.run_in_executor(
+                        None, self._unary_compute, pending)
+                    fut.add_done_callback(
+                        lambda f, c=cid, s=sid: self._unary_done(c, s, f))
                 return
             st = self._streams.get(key)
             if st is not None:
@@ -305,6 +301,50 @@ class FrontServer:
         st = self._streams.pop(key, None)
         if st is not None and st.task is not None:
             st.task.cancel()
+
+    # ----------------------------------------------------------- unary paths
+    def _unary_finish(self, cid: int, sid: int, pending) -> None:
+        """Inline completion (local engines): handler + combined reply."""
+        (req_cls, fn), raw = pending
+        try:
+            resp = fn(req_cls.FromString(raw), _SYNC_CTX)
+            out = resp.SerializeToString()
+            w = self._writer
+            if w is not None and not w.is_closing():
+                # MSG + END in one write() call
+                w.write(
+                    _HDR.pack(len(out), cid, sid, K_MSG) + out
+                    + _HDR.pack(6, cid, sid, K_END) + _END_OK
+                )
+        except _AbortError as e:
+            self._send_end(cid, sid, _status_num(e.code), e.details)
+        except Exception as exc:
+            logger.exception("front unary failed")
+            self._send_end(
+                cid, sid, _status_num(grpc.StatusCode.INTERNAL), str(exc))
+
+    @staticmethod
+    def _unary_compute(pending):
+        """Executor half (network engines): just the handler call."""
+        (req_cls, fn), raw = pending
+        return fn(req_cls.FromString(raw), _SYNC_CTX)
+
+    def _unary_done(self, cid: int, sid: int, fut) -> None:
+        try:
+            resp = fut.result()
+            out = resp.SerializeToString()
+            w = self._writer
+            if w is not None and not w.is_closing():
+                w.write(
+                    _HDR.pack(len(out), cid, sid, K_MSG) + out
+                    + _HDR.pack(6, cid, sid, K_END) + _END_OK
+                )
+        except _AbortError as e:
+            self._send_end(cid, sid, _status_num(e.code), e.details)
+        except Exception as exc:
+            logger.exception("front unary failed")
+            self._send_end(
+                cid, sid, _status_num(grpc.StatusCode.INTERNAL), str(exc))
 
     # --------------------------------------------------------------- streams
     async def _run_stream(self, cid: int, sid: int, path: str, st: _Stream) -> None:

@@ -205,6 +205,8 @@ def new_storage(name: str, **kwargs) -> KvStorage:
             from . import tpu  # noqa: F401
         elif name == "native":
             from . import native  # noqa: F401
+        elif name == "remote":
+            from . import remote  # noqa: F401
     if name not in _FACTORIES:
         raise ValueError(f"unknown storage engine {name!r}; have {sorted(_FACTORIES)}")
     return _FACTORIES[name](**kwargs)

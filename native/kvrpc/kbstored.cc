@@ -1,0 +1,541 @@
+// kbstored — network-attached storage tier (the reference's TiKV role).
+//
+// The reference's production story is N stateless KubeBrain nodes over one
+// shared distributed KV reached by gRPC (pkg/storage/tikv/tikv.go:38-153,
+// 2PC batches with ErrCASFailed / ErrUncertainResult classification,
+// batch.go:110-146). This binary plays that role for kubebrain-tpu: it owns
+// a kbstore engine (native/kbstore.cc — version chains, CAS batches,
+// WAL+snapshot durability) and serves it over a pipelined length-prefixed
+// TCP protocol, so any number of SEPARATE OS processes (or hosts) share one
+// storage truth — election, revision sync and uncertain-write repair all
+// flow through it exactly as they do through TiKV in the reference.
+//
+// Protocol (little-endian), pipelined per connection:
+//   request:  u32 body_len | u64 req_id | u8 op | body
+//   response: u32 body_len | u64 req_id | u8 status | body
+// status: 0 ok, 1 not_found, 2 cas_conflict/mismatch, 3 wal_error,
+//         4 revision_drift, 5 error (body = utf8 message)
+// ops:
+//   1 GET        u64 snap | key               -> value
+//   2 TSO        -                            -> u64 ts
+//   3 BATCH      u32 n | n * (u8 type | i64 ttl | u32 kl|key | u32 vl|val |
+//                u32 ol|old)                  -> ok: u64 ts
+//                types: 0 put 1 put_if_absent 2 cas 3 del 4 del_current
+//                conflict: i64 idx | u8 has | u32 vl|val
+//   4 SCAN       u64 snap | u8 reverse | u32 limit | u32 sl|start | u32 el|end
+//                -> u32 n | n * (u32 kl|key | u32 vl|val) | u8 more
+//   5 PARTITIONS u32 n_parts                  -> u32 n | n * (u32 bl|border)
+//   6 MVCC_WRITE u8 has_expected | i64 ttl | 5 length-prefixed fields
+//                (rev_key rev_val expected obj_key obj_val last_key last_val
+//                 = 7 fields)                 -> ok | conflict: u8 has|u32|val
+//   7 MVCC_DELETE u64 expected_rev | u64 new_rev | 5 length-prefixed fields
+//                (rev_key new_record tombstone last_key last_val)
+//                -> ok/mismatch: u8 has_prev | u32|prev | u64 latest
+//   8 CHECKPOINT -                            -> ok
+//   9 INFO       -                            -> u8 support_ttl | u64 keys |
+//                                               u64 versions
+//
+// Scan paging is client-driven (stateless server): 'more' set when the page
+// cap truncated a forward scan; the client re-issues from last_key+\0.
+// Reverse scans (point-get path) must fit one page.
+
+#include <arpa/inet.h>
+#include <errno.h>
+#include <fcntl.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <signal.h>
+#include <sys/epoll.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+// ---- engine ABI (implemented in native/kbstore.cc, linked in) ----
+extern "C" {
+void *kb_open();
+void *kb_open_at(const char *dir, int fsync_commits);
+int kb_checkpoint(void *s);
+void kb_close(void *s);
+uint64_t kb_tso(void *s);
+int kb_get(void *s, const uint8_t *key, size_t klen, uint64_t snap,
+           uint8_t **out, size_t *outlen);
+void kb_free(void *p);
+void *kb_batch_begin(void *s);
+void kb_batch_put(void *b, const uint8_t *k, size_t kl, const uint8_t *v,
+                  size_t vl, int64_t ttl);
+void kb_batch_put_if_absent(void *b, const uint8_t *k, size_t kl,
+                            const uint8_t *v, size_t vl, int64_t ttl);
+void kb_batch_cas(void *b, const uint8_t *k, size_t kl, const uint8_t *nv,
+                  size_t nvl, const uint8_t *ov, size_t ovl, int64_t ttl);
+void kb_batch_del(void *b, const uint8_t *k, size_t kl);
+void kb_batch_del_current(void *b, const uint8_t *k, size_t kl,
+                          const uint8_t *exp, size_t el);
+void kb_batch_abort(void *b);
+int kb_batch_commit(void *b, int64_t *conflict_idx, uint8_t **conflict_val,
+                    size_t *conflict_len, int *conflict_has_val);
+void *kb_iter_open(void *s, const uint8_t *start, size_t slen,
+                   const uint8_t *end, size_t elen, uint64_t snap,
+                   uint64_t limit, int reverse);
+int kb_iter_next(void *itp, const uint8_t **key, size_t *klen,
+                 const uint8_t **val, size_t *vlen);
+void kb_iter_close(void *itp);
+int kb_split_keys(void *s, int n_parts, uint8_t *borders, size_t row_width,
+                  size_t *border_lens);
+uint64_t kb_key_count(void *s);
+uint64_t kb_version_count(void *s);
+int kb_mvcc_write(void *s, const uint8_t *rev_key, size_t rkl,
+                  const uint8_t *rev_val, size_t rvl, const uint8_t *expected,
+                  size_t el, int has_expected, const uint8_t *obj_key,
+                  size_t okl, const uint8_t *obj_val, size_t ovl,
+                  const uint8_t *last_key, size_t lkl, const uint8_t *last_val,
+                  size_t lvl, int64_t ttl, uint8_t **conflict_val,
+                  size_t *conflict_len, int *conflict_has);
+int kb_mvcc_delete(void *s, const uint8_t *rev_key, size_t rkl,
+                   uint64_t expected_rev, uint64_t new_rev,
+                   const uint8_t *new_record, size_t nrl,
+                   const uint8_t *tombstone, size_t tl, const uint8_t *last_key,
+                   size_t lkl, const uint8_t *last_val, size_t lvl,
+                   uint8_t **prev_val, size_t *prev_len, uint64_t *latest);
+}
+
+namespace {
+
+constexpr uint8_t OP_GET = 1, OP_TSO = 2, OP_BATCH = 3, OP_SCAN = 4,
+                  OP_PARTITIONS = 5, OP_MVCC_WRITE = 6, OP_MVCC_DELETE = 7,
+                  OP_CHECKPOINT = 8, OP_INFO = 9;
+constexpr uint8_t ST_OK = 0, ST_NOT_FOUND = 1, ST_CONFLICT = 2, ST_WAL = 3,
+                  ST_DRIFT = 4, ST_ERROR = 5;
+constexpr uint32_t SCAN_PAGE_CAP = 2048;
+
+void *g_store = nullptr;
+
+// ---------------------------------------------------------- little helpers
+struct Reader {
+  const char *p;
+  size_t n;
+  size_t off = 0;
+  bool ok = true;
+
+  template <typename T> T num() {
+    if (off + sizeof(T) > n) {
+      ok = false;
+      return T{};
+    }
+    T v;
+    memcpy(&v, p + off, sizeof(T));
+    off += sizeof(T);
+    return v;
+  }
+  std::string bytes() {
+    uint32_t len = num<uint32_t>();
+    if (!ok || off + len > n) {
+      ok = false;
+      return {};
+    }
+    std::string s(p + off, len);
+    off += len;
+    return s;
+  }
+};
+
+void put_u8(std::string &o, uint8_t v) { o.push_back(static_cast<char>(v)); }
+template <typename T> void put_num(std::string &o, T v) {
+  o.append(reinterpret_cast<const char *>(&v), sizeof(T));
+}
+void put_bytes(std::string &o, const void *p, size_t len) {
+  put_num<uint32_t>(o, static_cast<uint32_t>(len));
+  o.append(static_cast<const char *>(p), len);
+}
+
+// ------------------------------------------------------------ op handlers
+// Each returns (status, body).
+uint8_t op_get(Reader &r, std::string &body) {
+  uint64_t snap = r.num<uint64_t>();
+  if (!r.ok) return ST_ERROR;
+  const char *key = r.p + r.off;
+  size_t klen = r.n - r.off;
+  uint8_t *out;
+  size_t outlen;
+  int rc = kb_get(g_store, reinterpret_cast<const uint8_t *>(key), klen, snap,
+                  &out, &outlen);
+  if (rc != 0) return ST_NOT_FOUND;
+  body.assign(reinterpret_cast<char *>(out), outlen);
+  kb_free(out);
+  return ST_OK;
+}
+
+uint8_t op_batch(Reader &r, std::string &body) {
+  uint32_t n = r.num<uint32_t>();
+  void *b = kb_batch_begin(g_store);
+  for (uint32_t i = 0; i < n && r.ok; i++) {
+    uint8_t type = r.num<uint8_t>();
+    int64_t ttl = r.num<int64_t>();
+    std::string key = r.bytes();
+    std::string val = r.bytes();
+    std::string old = r.bytes();
+    if (!r.ok) break;
+    const uint8_t *k = reinterpret_cast<const uint8_t *>(key.data());
+    const uint8_t *v = reinterpret_cast<const uint8_t *>(val.data());
+    const uint8_t *o = reinterpret_cast<const uint8_t *>(old.data());
+    switch (type) {
+      case 0: kb_batch_put(b, k, key.size(), v, val.size(), ttl); break;
+      case 1: kb_batch_put_if_absent(b, k, key.size(), v, val.size(), ttl); break;
+      case 2: kb_batch_cas(b, k, key.size(), v, val.size(), o, old.size(), ttl); break;
+      case 3: kb_batch_del(b, k, key.size()); break;
+      case 4: kb_batch_del_current(b, k, key.size(), o, old.size()); break;
+      default: r.ok = false;
+    }
+  }
+  if (!r.ok) {
+    kb_batch_abort(b);  // commit never ran; free the staged ops
+    body = "malformed batch";
+    return ST_ERROR;
+  }
+  int64_t idx;
+  uint8_t *cval;
+  size_t clen;
+  int chas;
+  int rc = kb_batch_commit(b, &idx, &cval, &clen, &chas);
+  if (rc == 0) {
+    put_num<uint64_t>(body, kb_tso(g_store));
+    return ST_OK;
+  }
+  if (rc == 1) {
+    put_num<int64_t>(body, idx);
+    put_u8(body, chas ? 1 : 0);
+    if (chas) {
+      put_bytes(body, cval, clen);
+      kb_free(cval);
+    } else {
+      put_num<uint32_t>(body, 0);
+    }
+    return ST_CONFLICT;
+  }
+  body = "wal append failed";
+  return ST_WAL;
+}
+
+uint8_t op_scan(Reader &r, std::string &body) {
+  uint64_t snap = r.num<uint64_t>();
+  uint8_t reverse = r.num<uint8_t>();
+  uint32_t limit = r.num<uint32_t>();
+  std::string start = r.bytes();
+  std::string end = r.bytes();
+  if (!r.ok) return ST_ERROR;
+  uint32_t cap = limit && limit < SCAN_PAGE_CAP ? limit : SCAN_PAGE_CAP;
+  // +1 row beyond the cap detects 'more'
+  void *it = kb_iter_open(
+      g_store, reinterpret_cast<const uint8_t *>(start.data()), start.size(),
+      reinterpret_cast<const uint8_t *>(end.data()), end.size(), snap,
+      cap + 1, reverse);
+  std::string rows;
+  uint32_t count = 0;
+  bool more = false;
+  const uint8_t *k, *v;
+  size_t kl, vl;
+  while (kb_iter_next(it, &k, &kl, &v, &vl) == 0) {
+    if (count == cap) {
+      more = true;
+      break;
+    }
+    put_bytes(rows, k, kl);
+    put_bytes(rows, v, vl);
+    count++;
+  }
+  kb_iter_close(it);
+  if (limit && count >= limit) more = false;  // caller asked for exactly this
+  put_num<uint32_t>(body, count);
+  body.append(rows);
+  put_u8(body, more ? 1 : 0);
+  return ST_OK;
+}
+
+uint8_t op_partitions(Reader &r, std::string &body) {
+  uint32_t n_parts = r.num<uint32_t>();
+  if (!r.ok || n_parts < 2 || n_parts > 1024) {
+    put_num<uint32_t>(body, 0);
+    return ST_OK;
+  }
+  const size_t width = 256;
+  std::vector<uint8_t> borders(width * (n_parts - 1));
+  std::vector<size_t> lens(n_parts - 1);
+  int got = kb_split_keys(g_store, static_cast<int>(n_parts), borders.data(),
+                          width, lens.data());
+  if (got < 0) got = 0;
+  put_num<uint32_t>(body, static_cast<uint32_t>(got));
+  for (int i = 0; i < got; i++)
+    put_bytes(body, borders.data() + static_cast<size_t>(i) * width, lens[i]);
+  return ST_OK;
+}
+
+uint8_t op_mvcc_write(Reader &r, std::string &body) {
+  uint8_t has_expected = r.num<uint8_t>();
+  int64_t ttl = r.num<int64_t>();
+  std::string rev_key = r.bytes(), rev_val = r.bytes(), expected = r.bytes(),
+              obj_key = r.bytes(), obj_val = r.bytes(), last_key = r.bytes(),
+              last_val = r.bytes();
+  if (!r.ok) return ST_ERROR;
+  uint8_t *cval;
+  size_t clen;
+  int chas = 0;
+  auto u8 = [](const std::string &s) {
+    return reinterpret_cast<const uint8_t *>(s.data());
+  };
+  int rc = kb_mvcc_write(g_store, u8(rev_key), rev_key.size(), u8(rev_val),
+                         rev_val.size(), u8(expected), expected.size(),
+                         has_expected, u8(obj_key), obj_key.size(),
+                         u8(obj_val), obj_val.size(), u8(last_key),
+                         last_key.size(), u8(last_val), last_val.size(), ttl,
+                         &cval, &clen, &chas);
+  if (rc == 0) return ST_OK;
+  if (rc == 1) {
+    put_u8(body, chas ? 1 : 0);
+    if (chas) {
+      put_bytes(body, cval, clen);
+      kb_free(cval);
+    } else {
+      put_num<uint32_t>(body, 0);
+    }
+    return ST_CONFLICT;
+  }
+  body = "wal append failed";
+  return ST_WAL;
+}
+
+uint8_t op_mvcc_delete(Reader &r, std::string &body) {
+  uint64_t expected_rev = r.num<uint64_t>();
+  uint64_t new_rev = r.num<uint64_t>();
+  std::string rev_key = r.bytes(), new_record = r.bytes(),
+              tombstone = r.bytes(), last_key = r.bytes(),
+              last_val = r.bytes();
+  if (!r.ok) return ST_ERROR;
+  auto u8 = [](const std::string &s) {
+    return reinterpret_cast<const uint8_t *>(s.data());
+  };
+  uint8_t *prev;
+  size_t plen = 0;
+  uint64_t latest = 0;
+  int rc = kb_mvcc_delete(g_store, u8(rev_key), rev_key.size(), expected_rev,
+                          new_rev, u8(new_record), new_record.size(),
+                          u8(tombstone), tombstone.size(), u8(last_key),
+                          last_key.size(), u8(last_val), last_val.size(),
+                          &prev, &plen, &latest);
+  // rc: 0 ok, 1 not_found, 2 mismatch, 3 wal, 4 drift
+  if (rc == 0 || rc == 2) {
+    put_u8(body, plen ? 1 : 0);
+    if (plen) {
+      put_bytes(body, prev, plen);
+      kb_free(prev);
+    } else {
+      put_num<uint32_t>(body, 0);
+    }
+    put_num<uint64_t>(body, latest);
+    return rc == 0 ? ST_OK : ST_CONFLICT;
+  }
+  if (plen) kb_free(prev);
+  if (rc == 1) return ST_NOT_FOUND;
+  if (rc == 3) {
+    body = "wal append failed";
+    return ST_WAL;
+  }
+  put_num<uint64_t>(body, latest);
+  return ST_DRIFT;
+}
+
+uint8_t handle_op(uint8_t op, Reader &r, std::string &body) {
+  switch (op) {
+    case OP_GET: return op_get(r, body);
+    case OP_TSO: put_num<uint64_t>(body, kb_tso(g_store)); return ST_OK;
+    case OP_BATCH: return op_batch(r, body);
+    case OP_SCAN: return op_scan(r, body);
+    case OP_PARTITIONS: return op_partitions(r, body);
+    case OP_MVCC_WRITE: return op_mvcc_write(r, body);
+    case OP_MVCC_DELETE: return op_mvcc_delete(r, body);
+    case OP_CHECKPOINT:
+      if (kb_checkpoint(g_store) != 0) {
+        body = "checkpoint failed (snapshot write or WAL reopen)";
+        return ST_ERROR;
+      }
+      return ST_OK;
+    case OP_INFO:
+      put_u8(body, 1);  // engine expires TTLs natively
+      put_num<uint64_t>(body, kb_key_count(g_store));
+      put_num<uint64_t>(body, kb_version_count(g_store));
+      return ST_OK;
+    default:
+      body = "unknown op";
+      return ST_ERROR;
+  }
+}
+
+// ------------------------------------------------------------- conn plumbing
+struct SConn {
+  int fd;
+  std::string in;
+  std::string out;
+};
+
+int g_epfd = -1;
+
+void conn_update(SConn *c) {
+  epoll_event ev{};
+  ev.events = EPOLLIN | (c->out.empty() ? 0u : static_cast<uint32_t>(EPOLLOUT));
+  ev.data.ptr = c;
+  epoll_ctl(g_epfd, EPOLL_CTL_MOD, c->fd, &ev);
+}
+
+bool conn_flush(SConn *c) {
+  while (!c->out.empty()) {
+    ssize_t n = write(c->fd, c->out.data(), c->out.size());
+    if (n > 0) {
+      c->out.erase(0, static_cast<size_t>(n));
+    } else if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+      break;
+    } else {
+      return false;
+    }
+  }
+  conn_update(c);
+  return true;
+}
+
+void conn_ingest(SConn *c) {
+  size_t off = 0;
+  while (c->in.size() - off >= 13) {
+    uint32_t blen;
+    uint64_t req_id;
+    memcpy(&blen, c->in.data() + off, 4);
+    memcpy(&req_id, c->in.data() + off + 4, 8);
+    uint8_t op = static_cast<uint8_t>(c->in[off + 12]);
+    if (c->in.size() - off - 13 < blen) break;
+    Reader r{c->in.data() + off + 13, blen};
+    std::string body;
+    uint8_t status = handle_op(op, r, body);
+    uint32_t rlen = static_cast<uint32_t>(body.size());
+    c->out.append(reinterpret_cast<char *>(&rlen), 4);
+    c->out.append(reinterpret_cast<char *>(&req_id), 8);
+    c->out.push_back(static_cast<char>(status));
+    c->out.append(body);
+    off += 13 + blen;
+  }
+  c->in.erase(0, off);
+}
+
+}  // namespace
+
+int main(int argc, char **argv) {
+  if (argc < 2) {
+    fprintf(stderr,
+            "usage: kbstored <port> [data-dir] [--fsync] [host]\n"
+            "  data-dir '' or '-' = in-memory\n");
+    return 1;
+  }
+  signal(SIGPIPE, SIG_IGN);
+  int port = atoi(argv[1]);
+  const char *dir = argc > 2 ? argv[2] : "";
+  bool fsync_commits = false;
+  const char *host = "127.0.0.1";
+  for (int i = 3; i < argc; i++) {
+    if (strcmp(argv[i], "--fsync") == 0)
+      fsync_commits = true;
+    else
+      host = argv[i];
+  }
+  if (dir[0] == '-' && dir[1] == '\0') dir = "";
+  g_store = dir[0] ? kb_open_at(dir, fsync_commits ? 1 : 0) : kb_open();
+  if (g_store == nullptr) {
+    fprintf(stderr, "[kbstored] failed to open store at %s\n", dir);
+    return 1;
+  }
+
+  int lfd = socket(AF_INET, SOCK_STREAM, 0);
+  int one = 1;
+  setsockopt(lfd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof one);
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
+    perror("inet_pton");
+    return 1;
+  }
+  if (bind(lfd, reinterpret_cast<sockaddr *>(&addr), sizeof addr) != 0) {
+    perror("bind");
+    return 1;
+  }
+  listen(lfd, 512);
+  fcntl(lfd, F_SETFL, fcntl(lfd, F_GETFL, 0) | O_NONBLOCK);
+
+  g_epfd = epoll_create1(0);
+  epoll_event ev{};
+  ev.events = EPOLLIN;
+  ev.data.ptr = nullptr;  // listener marker
+  epoll_ctl(g_epfd, EPOLL_CTL_ADD, lfd, &ev);
+
+  fprintf(stderr, "[kbstored] serving %s:%d (dir=%s fsync=%d)\n", host, port,
+          dir[0] ? dir : "<memory>", fsync_commits ? 1 : 0);
+  printf("READY\n");
+  fflush(stdout);
+
+  std::vector<char> buf(1 << 18);
+  epoll_event events[128];
+  while (true) {
+    int n = epoll_wait(g_epfd, events, 128, -1);
+    if (n < 0) {
+      if (errno == EINTR) continue;
+      perror("epoll_wait");
+      return 1;
+    }
+    for (int i = 0; i < n; i++) {
+      if (events[i].data.ptr == nullptr) {
+        while (true) {
+          int cfd = accept(lfd, nullptr, nullptr);
+          if (cfd < 0) break;
+          fcntl(cfd, F_SETFL, fcntl(cfd, F_GETFL, 0) | O_NONBLOCK);
+          setsockopt(cfd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
+          SConn *c = new SConn();
+          c->fd = cfd;
+          epoll_event cev{};
+          cev.events = EPOLLIN;
+          cev.data.ptr = c;
+          epoll_ctl(g_epfd, EPOLL_CTL_ADD, cfd, &cev);
+        }
+        continue;
+      }
+      SConn *c = static_cast<SConn *>(events[i].data.ptr);
+      bool dead = false;
+      if (events[i].events & (EPOLLHUP | EPOLLERR)) dead = true;
+      if (!dead && (events[i].events & EPOLLIN)) {
+        while (true) {
+          ssize_t r = read(c->fd, buf.data(), buf.size());
+          if (r > 0) {
+            c->in.append(buf.data(), static_cast<size_t>(r));
+          } else if (r < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+            break;
+          } else {
+            dead = true;
+            break;
+          }
+        }
+        if (!dead) {
+          conn_ingest(c);
+          if (!conn_flush(c)) dead = true;
+        }
+      }
+      if (!dead && (events[i].events & EPOLLOUT)) {
+        if (!conn_flush(c)) dead = true;
+      }
+      if (dead) {
+        epoll_ctl(g_epfd, EPOLL_CTL_DEL, c->fd, nullptr);
+        close(c->fd);
+        delete c;
+      }
+    }
+  }
+}

@@ -1,0 +1,349 @@
+"""Distributed storage tier: kbstored + RemoteKvStorage.
+
+The reference's production deployment is N stateless nodes over one shared
+TiKV cluster (pkg/storage/tikv/); round 1 only had in-process engines — the
+"3-node cluster" tests handed one Python object to three Node instances.
+These tests run the engine-contract suite against a REAL network boundary
+(kbstored subprocess), then form a cluster of three SEPARATE kubebrain-tpu
+OS processes over one kbstored and kill the leader (reference failover
+story, leader.go:82-120 + revision.go:114-128).
+"""
+
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+from kubebrain_tpu.backend import Backend, BackendConfig, wait_for_revision
+from kubebrain_tpu.storage import new_storage
+from kubebrain_tpu.storage.errors import (
+    CASFailedError,
+    KeyNotFoundError,
+    UncertainResultError,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+STORED_BIN = os.path.join(REPO, "native", "kvrpc", "kbstored")
+
+pytestmark = pytest.mark.skipif(
+    not os.path.exists(STORED_BIN), reason="kbstored not built (make -C native)"
+)
+
+
+def free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+@pytest.fixture(scope="module")
+def stored():
+    port = free_port()
+    proc = subprocess.Popen(
+        [STORED_BIN, str(port)], stdout=subprocess.PIPE, stderr=subprocess.DEVNULL
+    )
+    line = proc.stdout.readline()
+    assert b"READY" in line, "kbstored failed to start"
+    yield port
+    proc.terminate()
+    proc.wait(timeout=5)
+
+
+@pytest.fixture
+def store(stored):
+    s = new_storage("remote", address=f"127.0.0.1:{stored}", pool=4)
+    yield s
+    s.close()
+
+
+def put(store, key, value, ttl=0):
+    b = store.begin_batch_write()
+    b.put(key, value, ttl)
+    b.commit()
+
+
+# ------------------------------------------------- engine contract over TCP
+def test_remote_crud(store):
+    with pytest.raises(KeyNotFoundError):
+        store.get(b"/r/k")
+    put(store, b"/r/k", b"v1")
+    assert store.get(b"/r/k") == b"v1"
+    put(store, b"/r/k", b"v2")
+    assert store.get(b"/r/k") == b"v2"
+    store.delete(b"/r/k")
+    with pytest.raises(KeyNotFoundError):
+        store.get(b"/r/k")
+
+
+def test_remote_snapshot_isolation(store):
+    put(store, b"/rs/a", b"1")
+    snap = store.get_timestamp_oracle()
+    put(store, b"/rs/a", b"2")
+    put(store, b"/rs/b", b"9")
+    assert store.get(b"/rs/a", snapshot_ts=snap) == b"1"
+    assert store.get(b"/rs/a") == b"2"
+    with pytest.raises(KeyNotFoundError):
+        store.get(b"/rs/b", snapshot_ts=snap)
+
+
+def test_remote_conditional_batch_conflict_carries_value(store):
+    b = store.begin_batch_write()
+    b.put_if_not_exist(b"/rc/k", b"v")
+    b.commit()
+    b2 = store.begin_batch_write()
+    b2.put(b"/rc/other", b"x")
+    b2.put_if_not_exist(b"/rc/k", b"v2")
+    with pytest.raises(CASFailedError) as ei:
+        b2.commit()
+    assert ei.value.conflict.index == 1
+    assert ei.value.conflict.value == b"v"  # observed value rides back
+    # atomicity: the losing batch applied nothing
+    with pytest.raises(KeyNotFoundError):
+        store.get(b"/rc/other")
+    # cas with correct old value wins
+    b3 = store.begin_batch_write()
+    b3.cas(b"/rc/k", b"v2", b"v")
+    b3.commit()
+    assert store.get(b"/rc/k") == b"v2"
+
+
+def test_remote_iter_forward_reverse_limit(store):
+    for i in range(10):
+        put(store, b"/ri/%02d" % i, b"v%d" % i)
+    keys = [k for k, _ in store.iter(b"/ri/", b"/ri0")]
+    assert keys == [b"/ri/%02d" % i for i in range(10)]
+    # limit
+    keys = [k for k, _ in store.iter(b"/ri/", b"/ri0", limit=3)]
+    assert len(keys) == 3
+    # reverse: start > end, descending
+    keys = [k for k, _ in store.iter(b"/ri/99", b"/ri/", limit=2)]
+    assert keys == [b"/ri/09", b"/ri/08"]
+
+
+def test_remote_paged_scan(store):
+    """Forward scans page transparently past the server page cap."""
+    n = 3000  # > SCAN_PAGE_CAP (2048)
+    batch = store.begin_batch_write()
+    for i in range(n):
+        batch.put(b"/rp/%06d" % i, b"x")
+    batch.commit()
+    rows = list(store.iter(b"/rp/", b"/rp0"))
+    assert len(rows) == n
+    assert rows[0][0] == b"/rp/000000" and rows[-1][0] == b"/rp/%06d" % (n - 1)
+
+
+def test_remote_partitions(store):
+    parts = store.get_partitions(b"/rp/", b"/rp0")
+    assert parts[0].left == b"/rp/"
+    assert parts[-1].right == b"/rp0"
+    for a, b in zip(parts, parts[1:]):
+        assert a.right == b.left
+
+
+def test_remote_ttl(store):
+    assert store.support_ttl()
+    b = store.begin_batch_write()
+    b.put(b"/rt/k", b"v", ttl_seconds=1)
+    b.commit()
+    assert store.get(b"/rt/k") == b"v"
+    time.sleep(1.2)
+    with pytest.raises(KeyNotFoundError):
+        store.get(b"/rt/k")
+
+
+def test_remote_backend_semantics(store):
+    """The MVCC backend runs unchanged over the network engine (the
+    reference's multi-engine table-driven suite, backend_test.go:52-88)."""
+    b = Backend(store, BackendConfig(event_ring_capacity=4096,
+                                     watch_cache_capacity=4096))
+    r1 = b.create(b"/registry/rk/a", b"v1")
+    r2 = b.update(b"/registry/rk/a", b"v2", r1)
+    kv = b.get(b"/registry/rk/a")
+    assert kv.value == b"v2" and kv.revision == r2
+    res = b.list_(b"/registry/rk/", b"/registry/rk0")
+    assert [x.key for x in res.kvs] == [b"/registry/rk/a"]
+    b.delete(b"/registry/rk/a", r2)
+    with pytest.raises(KeyNotFoundError):
+        b.get(b"/registry/rk/a")
+    b.close()
+
+
+def test_uncertain_on_connection_death(stored):
+    """A commit whose transport dies mid-flight must classify as UNCERTAIN,
+    not as failure (reference batch.go:125-146)."""
+    s = new_storage("remote", address=f"127.0.0.1:{stored}", pool=1)
+    # sabotage: sever the transport under the client before commit
+    s._pool[0].sock.shutdown(socket.SHUT_RDWR)
+    b = s.begin_batch_write()
+    b.put(b"/ru/k", b"v")
+    with pytest.raises(UncertainResultError):
+        b.commit()
+    s.close()
+
+
+# ---------------------------------------------------- 3-process cluster
+class ClusterNode:
+    def __init__(self, stored_port, data=None):
+        self.client_port = free_port()
+        self.peer_port = free_port()
+        self.info_port = free_port()
+        env = {**os.environ, "JAX_PLATFORMS": "cpu",
+               "KB_HOST": "127.0.0.1"}
+        self.proc = subprocess.Popen(
+            [sys.executable, "-m", "kubebrain_tpu.cli",
+             "--storage", "remote", "--storage-address", f"127.0.0.1:{stored_port}",
+             "--storage-pool", "2",
+             "--host", "127.0.0.1",
+             "--client-port", str(self.client_port),
+             "--peer-port", str(self.peer_port),
+             "--info-port", str(self.info_port),
+             "--enable-etcd-proxy"],
+            cwd=REPO, env=env, stderr=subprocess.DEVNULL,
+        )
+
+    def status(self, timeout=2.0):
+        import json
+        import urllib.request
+
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{self.peer_port}/status", timeout=timeout
+        ) as resp:
+            return json.loads(resp.read())
+
+    def kill(self):
+        self.proc.send_signal(signal.SIGKILL)
+        self.proc.wait(timeout=5)
+
+    def terminate(self):
+        if self.proc.poll() is None:
+            self.proc.terminate()
+            try:
+                self.proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+
+
+@pytest.mark.slow
+def test_three_process_cluster_failover():
+    """Three separate OS processes over one kbstored: elect exactly one
+    leader, serve writes, kill the leader, confirm a new leader takes over
+    and NO acknowledged write is lost (the reference's whole production
+    story: stateless nodes + storage-anchored election)."""
+    from kubebrain_tpu.client import EtcdCompatClient
+
+    sport = free_port()
+    stored_proc = subprocess.Popen(
+        [STORED_BIN, str(sport)], stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL,
+    )
+    assert b"READY" in stored_proc.stdout.readline()
+    nodes = [ClusterNode(sport) for _ in range(3)]
+    acked = []
+    try:
+        # wait for exactly one leader
+        def leaders(deadline=60):
+            end = time.time() + deadline
+            while time.time() < end:
+                ls = []
+                for n in nodes:
+                    try:
+                        st = n.status()
+                        if st.get("is_leader"):
+                            ls.append(n)
+                    except Exception:
+                        pass
+                if len(ls) == 1:
+                    return ls
+                time.sleep(0.3)
+            return []
+
+        ls = leaders()
+        assert len(ls) == 1, "cluster must elect exactly one leader"
+        leader = ls[0]
+
+        c = EtcdCompatClient(f"127.0.0.1:{leader.client_port}")
+        for i in range(50):
+            ok, rev = c.create(b"/registry/ha/k%03d" % i, b"v%d" % i)
+            assert ok
+            acked.append((b"/registry/ha/k%03d" % i, rev))
+        c.close()
+
+        # kill -9 the leader; a survivor must take over
+        leader.kill()
+        survivors = [n for n in nodes if n is not leader]
+        end = time.time() + 90
+        new_leader = None
+        while time.time() < end and new_leader is None:
+            for n in survivors:
+                try:
+                    if n.status().get("is_leader"):
+                        new_leader = n
+                        break
+                except Exception:
+                    pass
+            time.sleep(0.3)
+        assert new_leader is not None, "no failover within 90s"
+
+        # every acked write must be readable on the new leader
+        c2 = EtcdCompatClient(f"127.0.0.1:{new_leader.client_port}")
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            try:
+                kvs, _ = c2.list(b"/registry/ha/", b"/registry/ha0")
+                if len(kvs) == len(acked):
+                    break
+            except Exception:
+                pass
+            time.sleep(0.5)
+        kvs, _ = c2.list(b"/registry/ha/", b"/registry/ha0")
+        got = {bytes(kv.key): kv.mod_revision for kv in kvs}
+        for key, rev in acked:
+            assert key in got, f"acked write {key} lost after failover"
+            assert got[key] == rev, f"revision changed for {key}"
+        # and the new leader keeps serving writes with monotonic revisions
+        ok, r_new = c2.create(b"/registry/ha/after-failover", b"v")
+        assert ok and r_new > max(rev for _, rev in acked)
+        c2.close()
+    finally:
+        for n in nodes:
+            n.terminate()
+        stored_proc.terminate()
+        stored_proc.wait(timeout=5)
+
+
+def test_pool_heals_after_server_restart():
+    """A single kbstored restart must not leave permanently-dead pool slots:
+    writes hitting dead sockets classify as uncertain AND heal the slot, so
+    the pool recovers once the server is back."""
+    port = free_port()
+    proc = subprocess.Popen([STORED_BIN, str(port)], stdout=subprocess.PIPE,
+                            stderr=subprocess.DEVNULL)
+    assert b"READY" in proc.stdout.readline()
+    s = new_storage("remote", address=f"127.0.0.1:{port}", pool=3)
+    put(s, b"/hr/a", b"v")
+    proc.terminate()
+    proc.wait(timeout=5)
+    proc = subprocess.Popen([STORED_BIN, str(port)], stdout=subprocess.PIPE,
+                            stderr=subprocess.DEVNULL)
+    assert b"READY" in proc.stdout.readline()
+    try:
+        # every pool slot is dead; each failed write must heal its slot
+        recovered = 0
+        for i in range(12):
+            try:
+                put(s, b"/hr/k%d" % i, b"v")
+                recovered += 1
+            except UncertainResultError:
+                pass
+        assert recovered >= 6, "pool must recover after the server returns"
+        assert s.get(b"/hr/k11") == b"v"  # last write landed on a healed conn
+    finally:
+        s.close()
+        proc.terminate()
+        proc.wait(timeout=5)
