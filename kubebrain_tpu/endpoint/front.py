@@ -303,11 +303,17 @@ class FrontServer:
             st.task.cancel()
 
     # ----------------------------------------------------------- unary paths
-    def _unary_finish(self, cid: int, sid: int, pending) -> None:
-        """Inline completion (local engines): handler + combined reply."""
+    @staticmethod
+    def _unary_compute(pending):
+        """The handler call itself (inline or in the executor)."""
         (req_cls, fn), raw = pending
+        return fn(req_cls.FromString(raw), _SYNC_CTX)
+
+    def _unary_reply(self, cid: int, sid: int, result) -> None:
+        """ONE copy of the response/error protocol: result() yields the
+        response message or raises."""
         try:
-            resp = fn(req_cls.FromString(raw), _SYNC_CTX)
+            resp = result()
             out = resp.SerializeToString()
             w = self._writer
             if w is not None and not w.is_closing():
@@ -323,28 +329,11 @@ class FrontServer:
             self._send_end(
                 cid, sid, _status_num(grpc.StatusCode.INTERNAL), str(exc))
 
-    @staticmethod
-    def _unary_compute(pending):
-        """Executor half (network engines): just the handler call."""
-        (req_cls, fn), raw = pending
-        return fn(req_cls.FromString(raw), _SYNC_CTX)
+    def _unary_finish(self, cid: int, sid: int, pending) -> None:
+        self._unary_reply(cid, sid, lambda: self._unary_compute(pending))
 
     def _unary_done(self, cid: int, sid: int, fut) -> None:
-        try:
-            resp = fut.result()
-            out = resp.SerializeToString()
-            w = self._writer
-            if w is not None and not w.is_closing():
-                w.write(
-                    _HDR.pack(len(out), cid, sid, K_MSG) + out
-                    + _HDR.pack(6, cid, sid, K_END) + _END_OK
-                )
-        except _AbortError as e:
-            self._send_end(cid, sid, _status_num(e.code), e.details)
-        except Exception as exc:
-            logger.exception("front unary failed")
-            self._send_end(
-                cid, sid, _status_num(grpc.StatusCode.INTERNAL), str(exc))
+        self._unary_reply(cid, sid, fut.result)
 
     # --------------------------------------------------------------- streams
     async def _run_stream(self, cid: int, sid: int, path: str, st: _Stream) -> None:

@@ -171,7 +171,10 @@ class _PagedIter(Iter):
         self._store = store
         self._start = start
         self._end = end
-        self._snap = snapshot_ts or 0
+        # pin the snapshot NOW when the caller passed none: pages must all
+        # read the same version of the world (Iter contract — the in-process
+        # engines get this by buffering at open)
+        self._snap = snapshot_ts or store.get_timestamp_oracle()
         self._limit = limit
         self._reverse = reverse
         self._rows: list[tuple[bytes, bytes]] = []
@@ -227,7 +230,10 @@ class RemoteKvStorage(KvStorage):
     """KvStorage over a kbstored server (reference tikv.NewKvStorage)."""
 
     def __init__(self, address: str = "127.0.0.1:2389", pool: int = 8,
-                 timeout: float = 5.0, partitions: int = 4):
+                 timeout: float = 30.0, partitions: int = 4):
+        # 30s default: kbstored serves ops from one reactor thread, so a
+        # checkpoint or big scan page briefly stalls other connections — a
+        # tight timeout would misclassify those stalls as uncertain writes
         host, _, port = address.rpartition(":")
         self._address = (host or "127.0.0.1", int(port))
         self._timeout = timeout

@@ -406,12 +406,16 @@ bool conn_flush(SConn *c) {
   return true;
 }
 
-void conn_ingest(SConn *c) {
+constexpr uint32_t MAX_FRAME = 64u << 20;  // one conn cannot OOM the daemon
+
+// returns false when the connection must be dropped (oversized frame)
+bool conn_ingest(SConn *c) {
   size_t off = 0;
   while (c->in.size() - off >= 13) {
     uint32_t blen;
     uint64_t req_id;
     memcpy(&blen, c->in.data() + off, 4);
+    if (blen > MAX_FRAME) return false;
     memcpy(&req_id, c->in.data() + off + 4, 8);
     uint8_t op = static_cast<uint8_t>(c->in[off + 12]);
     if (c->in.size() - off - 13 < blen) break;
@@ -426,6 +430,7 @@ void conn_ingest(SConn *c) {
     off += 13 + blen;
   }
   c->in.erase(0, off);
+  return c->in.size() <= MAX_FRAME + 13;
 }
 
 }  // namespace
@@ -524,8 +529,8 @@ int main(int argc, char **argv) {
           }
         }
         if (!dead) {
-          conn_ingest(c);
-          if (!conn_flush(c)) dead = true;
+          if (!conn_ingest(c)) dead = true;
+          else if (!conn_flush(c)) dead = true;
         }
       }
       if (!dead && (events[i].events & EPOLLOUT)) {
