@@ -34,6 +34,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "200 round-robin TiKV clients, tikv.go:36-82)")
     p.add_argument("--inner-storage", default="memkv",
                    help="host engine backing the tpu mirror (tpu engine only)")
+    p.add_argument("--use-pallas", action="store_true",
+                   help="run range scans through the Pallas/Mosaic kernel "
+                        "instead of the fused-jnp kernel (tpu engine only; "
+                        "interpret-mode off-TPU; env KB_USE_PALLAS)")
     p.add_argument("--data-dir", default="",
                    help="durable storage dir for the native engine (WAL + "
                         "snapshot); empty = in-memory")
@@ -138,6 +142,8 @@ def build_endpoint(args):
         native_kw.update({"data_dir": args.data_dir, "fsync": args.fsync})
     if args.storage == "tpu":
         inner_kw = native_kw if args.inner_storage == "native" else {}
+        if args.use_pallas:
+            inner_kw["use_pallas"] = True
         store = new_storage("tpu", inner=args.inner_storage, **inner_kw)
     elif args.storage == "native":
         store = new_storage("native", **native_kw)

@@ -166,6 +166,107 @@ def scan_mask_pallas(keys_t, rh31, rl31, tomb, n_valid, start, end, unbounded,
     return mask.reshape(n) != 0
 
 
+def _flip_sign_jnp(x: jnp.ndarray) -> jnp.ndarray:
+    """In-graph equivalent of :func:`flip_sign` (uint32 -> int32 bitcast)."""
+    return jax.lax.bitcast_convert_type(x ^ jnp.uint32(0x80000000), jnp.int32)
+
+
+def _split31_jnp(hi32: jnp.ndarray, lo32: jnp.ndarray):
+    """(hi, lo) 32-bit uint32 split -> (hi, lo) 31-bit int32 split in-graph.
+
+    Safe for revisions < 2^62 (hi < 2^30, so hi<<1|lo>>31 < 2^31)."""
+    rh31 = jax.lax.bitcast_convert_type(
+        (hi32 << jnp.uint32(1)) | (lo32 >> jnp.uint32(31)), jnp.int32
+    )
+    rl31 = jax.lax.bitcast_convert_type(lo32 & jnp.uint32(0x7FFFFFFF), jnp.int32)
+    return rh31, rl31
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def visibility_mask_batch(keys, rh, rl, tomb, n_valid, start, end, unbounded,
+                          read_hi, read_lo, interpret=False):
+    """Pallas visibility masks straight off the engine mirror's row-major
+    layout — the production entry point `TpuScanner` calls when the Pallas
+    path is enabled (`--use-pallas` / KB_USE_PALLAS).
+
+    Same contract as ``vmap(ops.scan.visibility_mask)``:
+    keys uint32[P, N, C] big-endian chunks, rh/rl uint32[P, N] (32-bit rev
+    split), tomb bool[P, N], n_valid int32[P], start/end uint32[C] packed
+    bounds, unbounded bool, read_hi/read_lo uint32. Returns bool[P, N].
+
+    Layout conversion (transpose to chunk-major, sign flip, 31-bit rev
+    resplit, LANE_TILE padding) happens in-graph: XLA fuses it into the
+    surrounding program and the kernel sees its native tiling.
+    """
+    p, n, c = keys.shape
+    if n == 0:
+        return jnp.zeros((p, 0), dtype=bool)
+    pad = (-n) % LANE_TILE
+    if pad:
+        keys = jnp.pad(keys, ((0, 0), (0, pad), (0, 0)))
+        rh = jnp.pad(rh, ((0, 0), (0, pad)))
+        rl = jnp.pad(rl, ((0, 0), (0, pad)))
+        tomb = jnp.pad(tomb, ((0, 0), (0, pad)))
+    keys_t = _flip_sign_jnp(jnp.swapaxes(keys, 1, 2))  # [P, C, Npad]
+    rh31, rl31 = _split31_jnp(jnp.asarray(rh, jnp.uint32), jnp.asarray(rl, jnp.uint32))
+    qhi31, qlo31 = _split31_jnp(
+        jnp.asarray(read_hi, jnp.uint32), jnp.asarray(read_lo, jnp.uint32)
+    )
+    s = _flip_sign_jnp(jnp.asarray(start, jnp.uint32))
+    e = _flip_sign_jnp(jnp.asarray(end, jnp.uint32))
+    unb = jnp.asarray(unbounded, jnp.int32)
+    f = lambda kt, h, l, t, nv: scan_mask_pallas(
+        kt, h, l, t, nv, s, e, unb, qhi31, qlo31, interpret=interpret
+    )
+    mask = jax.vmap(f)(keys_t, rh31, rl31, tomb.astype(jnp.int8), n_valid)
+    return mask[:, :n]
+
+
+def prepare_mirror(keys_host: np.ndarray, revs_host: np.ndarray,
+                   tomb_host: np.ndarray, tile: int = LANE_TILE):
+    """Row-major mirror arrays → Pallas layout, computed ONCE per mirror
+    publish (numpy, host-side): chunk-major sign-flipped keys, 31-bit rev
+    split, int8 tombstones, rows padded to ``tile``.
+
+    keys_host uint32[P, N, C], revs_host uint64[P, N], tomb_host bool[P, N].
+    Returns (keys_t int32[P, C, Npad], rh31 int32[P, Npad],
+    rl31 int32[P, Npad], tomb8 int8[P, Npad], n).
+
+    The per-query path (`visibility_mask_batch_cached`) then only converts
+    the bounds and read revision — O(C) per scan instead of O(P·N·C).
+    """
+    p, n, c = keys_host.shape
+    pad = (-n) % tile
+    if pad:
+        keys_host = np.pad(keys_host, ((0, 0), (0, pad), (0, 0)))
+        revs_host = np.pad(revs_host, ((0, 0), (0, pad)))
+        tomb_host = np.pad(tomb_host, ((0, 0), (0, pad)))
+    keys_t = np.ascontiguousarray(np.transpose(flip_sign(keys_host), (0, 2, 1)))
+    rh31, rl31 = split_revs31(np.asarray(revs_host, dtype=np.uint64).reshape(-1))
+    npad = n + pad
+    return (keys_t, rh31.reshape(p, npad), rl31.reshape(p, npad),
+            tomb_host.astype(np.int8), n)
+
+
+@functools.partial(jax.jit, static_argnames=("n", "interpret"))
+def visibility_mask_batch_cached(keys_t, rh31, rl31, tomb8, nv, start, end,
+                                 unbounded, read_hi, read_lo, n, interpret=False):
+    """Per-query Pallas path over a `prepare_mirror`-cached layout. Only the
+    bounds (uint32[C] packed) and read revision (uint32 split) are converted
+    in-graph. Returns bool[P, n]."""
+    qhi31, qlo31 = _split31_jnp(
+        jnp.asarray(read_hi, jnp.uint32), jnp.asarray(read_lo, jnp.uint32)
+    )
+    s = _flip_sign_jnp(jnp.asarray(start, jnp.uint32))
+    e = _flip_sign_jnp(jnp.asarray(end, jnp.uint32))
+    unb = jnp.asarray(unbounded, jnp.int32)
+    f = lambda kt, h, l, t, v: scan_mask_pallas(
+        kt, h, l, t, v, s, e, unb, qhi31, qlo31, interpret=interpret
+    )
+    mask = jax.vmap(f)(keys_t, rh31, rl31, tomb8, nv)
+    return mask[:, :n]
+
+
 def prepare_blocks(chunks: np.ndarray, revs: np.ndarray, tomb: np.ndarray,
                    tile: int = LANE_TILE):
     """Row-major uint32 blocks -> pallas layout (padded, chunk-major)."""

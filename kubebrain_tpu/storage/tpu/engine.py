@@ -102,27 +102,84 @@ class _DeltaIndex:
 
 @jax.jit
 def _vis_batch(keys, rh, rl, tomb, nv, start, end, unb, qhi, qlo):
-    """Visibility masks for all partitions: [P, N] bool + [P] counts."""
+    """jnp visibility masks for all partitions: [P, N] bool + [P] counts.
+    Plain elementwise ops — GSPMD partitions them natively over the mesh."""
     f = lambda k, a, b, t, n: visibility_mask(k, a, b, t, n, start, end, unb, qhi, qlo)
     mask = jax.vmap(f)(keys, rh, rl, tomb, nv)
     return mask, jnp.sum(mask, axis=1, dtype=jnp.int32)
 
 
-@jax.jit
-def _vis_count(keys, rh, rl, tomb, nv, start, end, unb, qhi, qlo):
-    _, counts = _vis_batch(keys, rh, rl, tomb, nv, start, end, unb, qhi, qlo)
-    return counts
+@functools.partial(jax.jit, static_argnames=("n", "interpret", "mesh"))
+def _vis_batch_pallas(keys_t, rh31, rl31, tomb8, nv, start, end, unb, qhi, qlo,
+                      n, interpret=False, mesh=None):
+    """Pallas visibility masks over the `prepare_mirror`-cached layout.
+
+    ``mesh`` (static): pallas_call has no GSPMD partitioning rule, so on a
+    multi-device mesh the Pallas path is shard_map'd along ``part`` to keep
+    the mirror's sharding — otherwise XLA would replicate the whole
+    [P, C, Npad] key array to every device per scan.
+    """
+    from jax.sharding import PartitionSpec as PS
+
+    from ...ops.scan_pallas import visibility_mask_batch_cached
+
+    f = functools.partial(visibility_mask_batch_cached, n=n, interpret=interpret)
+    if mesh is not None and mesh.devices.size > 1:
+        part = PS("part")
+        rep = PS()
+        specs = dict(
+            in_specs=(part, part, part, part, part, rep, rep, rep, rep, rep),
+            out_specs=part,
+        )
+        shard_map = getattr(jax, "shard_map", None)
+        if shard_map is None:  # pre-0.8 jax
+            from jax.experimental.shard_map import shard_map
+
+            specs["check_rep"] = False
+        else:
+            # pallas_call's out_shape carries no vma annotation
+            specs["check_vma"] = False
+        f = shard_map(f, mesh=mesh, **specs)
+    mask = f(keys_t, rh31, rl31, tomb8, nv, start, end, unb, qhi, qlo)
+    return mask, jnp.sum(mask, axis=1, dtype=jnp.int32)
 
 
 @functools.partial(jax.jit, static_argnames=("size",))
-def _vis_indices(keys, rh, rl, tomb, nv, start, end, unb, qhi, qlo, size):
+def _indices_of_mask(mask, size):
     """Flat indices (p*N + row) of visible rows, device-compacted so the
     host transfer is O(results), not O(rows). ``size`` buckets to a power of
     two to bound recompiles."""
-    mask, _ = _vis_batch(keys, rh, rl, tomb, nv, start, end, unb, qhi, qlo)
     flat = mask.reshape(-1)
     (idx,) = jnp.nonzero(flat, size=size, fill_value=flat.shape[0])
     return idx
+
+
+def _resolve_scan_kernel(use_pallas: bool | None) -> str:
+    """Flag/env resolution for the scan kernel choice. Mosaic lowering needs
+    a real TPU backend; everywhere else the Pallas path runs interpreted
+    (slow — differential/testing only, like the reference's mock engines)."""
+    import os
+
+    if use_pallas is None:
+        use_pallas = os.environ.get("KB_USE_PALLAS", "").lower() in ("1", "true", "yes")
+    if not use_pallas:
+        return "jnp"
+    interp_env = os.environ.get("KB_PALLAS_INTERPRET", "").lower()
+    if interp_env in ("1", "true", "yes"):
+        kernel = "pallas_interpret"  # explicitly requested — no warning
+    elif interp_env in ("0", "false", "no"):
+        kernel = "pallas"
+    elif jax.default_backend() == "tpu":
+        kernel = "pallas"
+    else:
+        kernel = "pallas_interpret"
+        import logging
+
+        logging.getLogger("kubebrain").warning(
+            "--use-pallas without a TPU backend: running the Pallas kernel "
+            "under the interpreter (slow; differential/testing only)"
+        )
+    return kernel
 
 
 @functools.partial(jax.jit, static_argnames=("with_ttl",))
@@ -153,12 +210,18 @@ class TpuScanner(Scanner):
         key_width: int = keyops.KEY_WIDTH,
         merge_threshold: int = 4096,
         host_limit_threshold: int = 1024,
+        use_pallas: bool | None = None,
     ):
         super().__init__(store, get_compact_revision, retry_min_revision, compact_history, max_workers)
         self._mesh = mesh if mesh is not None else make_mesh()
         self._kw = key_width
         self._merge_threshold = merge_threshold
         self._host_limit_threshold = host_limit_threshold
+        self._scan_kernel = _resolve_scan_kernel(use_pallas)
+        # static mesh arg for the kernel dispatch: only the Pallas path needs
+        # it (shard_map); None keeps the jnp path's jit cache key mesh-free
+        self._kernel_mesh = self._mesh if self._scan_kernel != "jnp" else None
+        self._pallas_cache: tuple[Mirror, tuple] | None = None
         self._mlock = threading.RLock()
         self._mirror: Mirror | None = None
         self._delta = _DeltaIndex()
@@ -237,16 +300,66 @@ class TpuScanner(Scanner):
         e = jnp.asarray(keyops.pack_one(keyops.canonicalize_bound(end) if end else b"", self._kw))
         return s, e, jnp.asarray(unbounded)
 
-    def _vis_args(self, mirror: Mirror, start: bytes, end: bytes, read_rev: int):
-        """The (blocks..., bounds, revision) tuple every visibility kernel
-        takes — one assembly point so count/range can't diverge."""
+    def _shard_put(self, arr):
+        if self._mesh is None:
+            return jax.device_put(arr)
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        spec = PartitionSpec("part", *(None,) * (arr.ndim - 1))
+        return jax.device_put(arr, NamedSharding(self._mesh, spec))
+
+    def _pallas_layout(self, mirror: Mirror):
+        """Chunk-major sign-flipped device copies for the Pallas kernel,
+        computed once per mirror publish (identity-cached) — per-query work
+        is then O(C) bound conversion, not an O(P·N·C) re-layout."""
+        cached = self._pallas_cache
+        if cached is not None and cached[0] is mirror:
+            return cached[1]
+        from ...ops.scan_pallas import prepare_mirror
+
+        kt, rh31, rl31, t8, n = prepare_mirror(
+            mirror.keys_host,
+            np.asarray(mirror.revs_host, dtype=np.uint64),
+            mirror.tomb_host,
+        )
+        out = (
+            self._shard_put(kt), self._shard_put(rh31),
+            self._shard_put(rl31), self._shard_put(t8), n,
+        )
+        self._pallas_cache = (mirror, out)
+        return out
+
+    def _dev_mask(self, mirror: Mirror, start: bytes, end: bytes, read_rev: int):
+        """Visibility (mask [P, N] device array, counts [P]) through the
+        selected kernel — the one assembly point so count/range/stream can't
+        diverge and can't silently miss the kernel dispatch."""
         s, e, unb = self._query_bounds(start, end)
         qhi, qlo = keyops.split_revs(np.array([read_rev], dtype=np.uint64))
-        return (
-            mirror.keys_dev, mirror.rh_dev, mirror.rl_dev, mirror.tomb_dev,
-            mirror.n_valid_dev, s, e, unb,
-            jnp.asarray(qhi[0]), jnp.asarray(qlo[0]),
+        qhi, qlo = jnp.asarray(qhi[0]), jnp.asarray(qlo[0])
+        if self._scan_kernel == "jnp":
+            return _vis_batch(
+                mirror.keys_dev, mirror.rh_dev, mirror.rl_dev, mirror.tomb_dev,
+                mirror.n_valid_dev, s, e, unb, qhi, qlo,
+            )
+        kt, rh31, rl31, t8, n = self._pallas_layout(mirror)
+        return _vis_batch_pallas(
+            kt, rh31, rl31, t8, mirror.n_valid_dev, s, e, unb, qhi, qlo,
+            n=n, interpret=(self._scan_kernel == "pallas_interpret"),
+            mesh=self._kernel_mesh,
         )
+
+    def _dev_visible_indices(self, mask, counts, n_flat: int):
+        """(total, flat row indices) from a device mask — the shared
+        two-phase gather: counts first (tiny transfer), then the compacted
+        index list sized to the next power of two so the host never pulls
+        the full row mask."""
+        total = int(np.asarray(counts).sum())
+        bucket = 1
+        while bucket < max(total, 1):
+            bucket *= 2
+        bucket = min(bucket, n_flat)
+        idx = np.asarray(_indices_of_mask(mask, size=bucket))[:total]
+        return total, idx
 
     def range_(self, start: bytes, end: bytes, read_revision: int, limit: int = 0):
         if limit and limit <= self._host_limit_threshold:
@@ -256,17 +369,10 @@ class TpuScanner(Scanner):
         with self._mlock:
             mirror = self._mirror
             overlay = self._delta.overlay(start, end, read_revision)
-        # two-phase device gather: counts first (tiny transfer), then the
-        # compacted index list sized to the next power of two — the host
-        # never pulls the full row mask
-        args = self._vis_args(mirror, start, end, read_revision)
-        total = int(np.asarray(_vis_count(*args)).sum())
-        n_flat = mirror.keys_host.shape[0] * mirror.keys_host.shape[1]
-        bucket = 1
-        while bucket < max(total, 1):
-            bucket *= 2
-        bucket = min(bucket, n_flat)
-        idx = np.asarray(_vis_indices(*args, size=bucket))[:total]
+        mask, counts = self._dev_mask(mirror, start, end, read_revision)
+        total, idx = self._dev_visible_indices(
+            mask, counts, mirror.keys_host.shape[0] * mirror.keys_host.shape[1]
+        )
         n_rows = mirror.keys_host.shape[1]
         from ...backend.common import KeyValue
 
@@ -297,14 +403,10 @@ class TpuScanner(Scanner):
         with self._mlock:
             mirror = self._mirror
             overlay = self._delta.overlay(start, end, read_revision)
-        args = self._vis_args(mirror, start, end, read_revision)
-        total = int(np.asarray(_vis_count(*args)).sum())
-        n_flat = mirror.keys_host.shape[0] * mirror.keys_host.shape[1]
-        bucket = 1
-        while bucket < max(total, 1):
-            bucket *= 2
-        bucket = min(bucket, n_flat)
-        idx = np.asarray(_vis_indices(*args, size=bucket))[:total]
+        mask, counts = self._dev_mask(mirror, start, end, read_revision)
+        total, idx = self._dev_visible_indices(
+            mask, counts, mirror.keys_host.shape[0] * mirror.keys_host.shape[1]
+        )
         n_rows = mirror.keys_host.shape[1]
         extra = sorted(
             (k, v) for k, v in overlay.items() if v is not None
@@ -358,7 +460,8 @@ class TpuScanner(Scanner):
         with self._mlock:
             mirror = self._mirror
             overlay = self._delta.overlay(start, end, read_revision)
-        counts = np.asarray(_vis_count(*self._vis_args(mirror, start, end, read_revision)))
+        _, counts = self._dev_mask(mirror, start, end, read_revision)
+        counts = np.asarray(counts)
         total = int(counts.sum())
         for uk, entry in overlay.items():
             had = self._host_visible(mirror, uk, read_revision)
@@ -718,10 +821,14 @@ class _TrackedBatch(BatchWrite):
         self._rows = []
 
 
-def _tpu_factory(inner: str = "memkv", mesh=None, key_width: int = keyops.KEY_WIDTH, **inner_kw) -> TpuKvStorage:
+def _tpu_factory(inner: str = "memkv", mesh=None, key_width: int = keyops.KEY_WIDTH,
+                 use_pallas: bool | None = None, **inner_kw) -> TpuKvStorage:
     from .. import new_storage
 
-    return TpuKvStorage(new_storage(inner, **inner_kw), mesh=mesh, key_width=key_width)
+    scanner_kw = {} if use_pallas is None else {"use_pallas": use_pallas}
+    return TpuKvStorage(
+        new_storage(inner, **inner_kw), mesh=mesh, key_width=key_width, **scanner_kw
+    )
 
 
 register_engine("tpu", _tpu_factory)
