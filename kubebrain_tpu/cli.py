@@ -141,7 +141,14 @@ def build_endpoint(args):
     if getattr(args, "data_dir", ""):
         native_kw.update({"data_dir": args.data_dir, "fsync": args.fsync})
     if args.storage == "tpu":
-        inner_kw = native_kw if args.inner_storage == "native" else {}
+        if args.inner_storage == "native":
+            inner_kw = native_kw
+        elif args.inner_storage == "remote":
+            # the composed production topology: TPU data plane over the
+            # shared kbstored tier (reference: scanner over TiKV partitions)
+            inner_kw = {"address": args.storage_address, "pool": args.storage_pool}
+        else:
+            inner_kw = {}
         if args.use_pallas:
             inner_kw["use_pallas"] = True
         store = new_storage("tpu", inner=args.inner_storage, **inner_kw)

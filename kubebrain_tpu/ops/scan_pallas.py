@@ -185,9 +185,11 @@ def _split31_jnp(hi32: jnp.ndarray, lo32: jnp.ndarray):
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def visibility_mask_batch(keys, rh, rl, tomb, n_valid, start, end, unbounded,
                           read_hi, read_lo, interpret=False):
-    """Pallas visibility masks straight off the engine mirror's row-major
-    layout — the production entry point `TpuScanner` calls when the Pallas
-    path is enabled (`--use-pallas` / KB_USE_PALLAS).
+    """Pallas visibility masks straight off the row-major mirror layout,
+    converting in-graph on every call — the UNCACHED variant, kept as the
+    kernel-level differential-test entry point. Production (`TpuScanner`
+    under --use-pallas) uses `prepare_mirror` + `visibility_mask_batch_cached`
+    so the layout conversion happens once per mirror publish, not per query.
 
     Same contract as ``vmap(ops.scan.visibility_mask)``:
     keys uint32[P, N, C] big-endian chunks, rh/rl uint32[P, N] (32-bit rev

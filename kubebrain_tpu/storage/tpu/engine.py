@@ -250,14 +250,27 @@ class TpuScanner(Scanner):
         snapshot = self._store.get_timestamp_oracle()
         lo, hi = coder.internal_range(b"", b"")
         exporter = getattr(self._store, "untracked", lambda: self._store)()
+        arrays = None
         if hasattr(exporter, "export_mvcc"):
             # C++ host-shim bulk export: numpy arrays straight from the
             # engine, no per-row Python (SURVEY §2.8 fast path)
             from ...backend.common import TOMBSTONE
+            from ..errors import StorageError
 
-            arrays = exporter.export_mvcc(
-                lo, hi, snapshot, self._kw, coder.MAGIC, TOMBSTONE
-            )
+            try:
+                arrays = exporter.export_mvcc(
+                    lo, hi, snapshot, self._kw, coder.MAGIC, TOMBSTONE
+                )
+            except StorageError as exc:
+                # e.g. a kbstored daemon predating OP_EXPORT: degrade to the
+                # per-row path instead of failing every rebuild
+                import logging
+
+                logging.getLogger("kubebrain").warning(
+                    "bulk export unavailable (%s); mirror rebuild falling "
+                    "back to per-row iteration", exc,
+                )
+        if arrays is not None:
             self._mirror = build_mirror_from_arrays(
                 *arrays, self._mesh, self._kw, snapshot
             )
@@ -270,6 +283,7 @@ class TpuScanner(Scanner):
             self._mirror = build_mirror(rows, self._mesh, self._kw, snapshot)
         self._delta = _DeltaIndex()
         self._force_rebuild = False
+        self._pallas_cache = None  # old mirror's device copies must not pin
 
     def _merge_delta(self) -> None:
         """Dirty-partition-only merge: sort the delta alone, two-way merge it
@@ -288,6 +302,7 @@ class TpuScanner(Scanner):
             m = build_mirror_from_arrays(*merged, self._mesh, self._kw, ts)
         self._mirror = m
         self._delta = _DeltaIndex()
+        self._pallas_cache = None  # re-layout lazily on the next pallas query
 
     def publish(self) -> None:
         """Force the mirror fully up to date (bench/startup hook)."""
@@ -669,6 +684,7 @@ class TpuScanner(Scanner):
                     *merged, self._mesh, self._kw, self._store.get_timestamp_oracle()
                 )
                 self._delta = _DeltaIndex()
+                self._pallas_cache = None
         return stats
 
 

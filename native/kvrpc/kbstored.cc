@@ -34,6 +34,13 @@
 //   8 CHECKPOINT -                            -> ok
 //   9 INFO       -                            -> u8 support_ttl | u64 keys |
 //                                               u64 versions
+//  10 EXPORT     u64 snap | u64 key_width | u32 page_rows |
+//                u32 ml|magic | u32 tl|tomb | u32 sl|start | u32 el|end
+//                -> columnar MVCC page (see kb_mvcc_export_wire in
+//                kbstore.cc): u32 n | u8 more | u32 nl|next_start |
+//                keys u8[n*kw] | lens i32[n] | revs u64[n] | tomb u8[n] |
+//                u64 alen | arena | offsets u64[n+1]. Paged by rows AND by
+//                a 32 MB arena cap; resume with start = next_start.
 //
 // Scan paging is client-driven (stateless server): 'more' set when the page
 // cap truncated a forward scan; the client re-issues from last_key+\0.
@@ -103,13 +110,20 @@ int kb_mvcc_delete(void *s, const uint8_t *rev_key, size_t rkl,
                    const uint8_t *tombstone, size_t tl, const uint8_t *last_key,
                    size_t lkl, const uint8_t *last_val, size_t lvl,
                    uint8_t **prev_val, size_t *prev_len, uint64_t *latest);
+int kb_mvcc_export_wire(void *s, const uint8_t *start, size_t slen,
+                        const uint8_t *end, size_t elen, uint64_t snap,
+                        const uint8_t *magic, size_t magic_len,
+                        const uint8_t *tombstone, size_t tomb_len,
+                        uint64_t key_width, uint64_t max_rows,
+                        uint64_t arena_cap, uint8_t **out, size_t *out_len);
 }
 
 namespace {
 
 constexpr uint8_t OP_GET = 1, OP_TSO = 2, OP_BATCH = 3, OP_SCAN = 4,
                   OP_PARTITIONS = 5, OP_MVCC_WRITE = 6, OP_MVCC_DELETE = 7,
-                  OP_CHECKPOINT = 8, OP_INFO = 9;
+                  OP_CHECKPOINT = 8, OP_INFO = 9, OP_EXPORT = 10;
+constexpr uint64_t EXPORT_ARENA_CAP = 32u << 20;
 constexpr uint8_t ST_OK = 0, ST_NOT_FOUND = 1, ST_CONFLICT = 2, ST_WAL = 3,
                   ST_DRIFT = 4, ST_ERROR = 5;
 constexpr uint32_t SCAN_PAGE_CAP = 2048;
@@ -349,6 +363,39 @@ uint8_t op_mvcc_delete(Reader &r, std::string &body) {
   return ST_DRIFT;
 }
 
+uint8_t op_export(Reader &r, std::string &body) {
+  uint64_t snap = r.num<uint64_t>();
+  uint64_t key_width = r.num<uint64_t>();
+  uint32_t page_rows = r.num<uint32_t>();
+  std::string magic = r.bytes();
+  std::string tomb = r.bytes();
+  std::string start = r.bytes();
+  std::string end = r.bytes();
+  if (!r.ok || key_width == 0 || key_width > 4096) return ST_ERROR;
+  if (page_rows == 0 || page_rows > (1u << 20)) page_rows = 1u << 16;
+  // keep the whole response within the frame ethos: fixed per-row cost is
+  // key_width + lens(4) + revs(8) + tomb(1) + offsets(8); bound that block
+  // to 16 MB so total stays ~<= 48 MB + one value (u32 frame len is safe)
+  uint64_t row_budget = (16u << 20) / (key_width + 21);
+  if (page_rows > row_budget) page_rows = static_cast<uint32_t>(row_budget);
+  auto u8 = [](const std::string &s) {
+    return reinterpret_cast<const uint8_t *>(s.data());
+  };
+  uint8_t *out = nullptr;
+  size_t out_len = 0;
+  int rc = kb_mvcc_export_wire(
+      g_store, u8(start), start.size(), u8(end), end.size(), snap, u8(magic),
+      magic.size(), u8(tomb), tomb.size(), key_width, page_rows,
+      EXPORT_ARENA_CAP, &out, &out_len);
+  if (rc != 0) {
+    body = "export failed (key wider than key_width?)";
+    return ST_ERROR;
+  }
+  body.assign(reinterpret_cast<char *>(out), out_len);
+  kb_free(out);
+  return ST_OK;
+}
+
 uint8_t handle_op(uint8_t op, Reader &r, std::string &body) {
   switch (op) {
     case OP_GET: return op_get(r, body);
@@ -364,6 +411,7 @@ uint8_t handle_op(uint8_t op, Reader &r, std::string &body) {
         return ST_ERROR;
       }
       return ST_OK;
+    case OP_EXPORT: return op_export(r, body);
     case OP_INFO:
       put_u8(body, 1);  // engine expires TTLs natively
       put_num<uint64_t>(body, kb_key_count(g_store));
