@@ -492,6 +492,16 @@ def bench_grpc_insert() -> None:
             "--peer-port", str(free_port()), "--info-port", str(free_port())]
     if not use_pyclient:
         args += ["--front-port", str(port)]
+    use_tls = bool(os.environ.get("KB_BENCH_TLS")) and not use_pyclient
+    tls_dir = None
+    if use_tls:
+        import tempfile
+
+        from kubebrain_tpu.util.selfsigned import gen_self_signed
+
+        tls_dir = tempfile.mkdtemp(prefix="kb-bench-tls-")
+        cert_file, key_file = gen_self_signed(tls_dir, "kb-bench", (), ("127.0.0.1",))
+        args += ["--cert-file", cert_file, "--key-file", key_file]
     server = subprocess.Popen(args, cwd=repo, stderr=subprocess.DEVNULL)
     value = b"x" * 512
     probe = EtcdCompatClient(f"127.0.0.1:{port}")
@@ -530,10 +540,12 @@ def bench_grpc_insert() -> None:
         else:
             n_conns = int(os.environ.get("KB_BENCH_CLIENTS", 8))
             inflight = int(os.environ.get("KB_BENCH_INFLIGHT", 16))
+            lg_args = [loadgen, "127.0.0.1", str(port), str(n_ops),
+                       str(n_conns), str(inflight), "512"]
+            if use_tls:
+                lg_args.append("--tls")
             out = subprocess.run(
-                [loadgen, "127.0.0.1", str(port), str(n_ops), str(n_conns),
-                 str(inflight), "512"],
-                capture_output=True, text=True, timeout=300,
+                lg_args, capture_output=True, text=True, timeout=300,
             )
             if out.returncode != 0 or not out.stdout.strip():
                 raise RuntimeError(
@@ -542,13 +554,19 @@ def bench_grpc_insert() -> None:
             assert res["failed"] == 0, res
             rate = res["rate"]
             detail = {"ops": res["ops"], "conns": n_conns, "inflight": inflight,
-                      "value_bytes": 512, "transport": "etcd3 gRPC (kbfront)",
+                      "value_bytes": 512,
+                      "transport": "etcd3 gRPC (kbfront%s)" % (
+                          " TLS" if use_tls else ""),
                       "avg_ms": round(res["avg_us"] / 1e3, 2),
                       "p50_ms": round(res["p50_us"] / 1e3, 2),
                       "p99_ms": round(res["p99_us"] / 1e3, 2)}
     finally:
         server.terminate()
         server.wait(timeout=10)
+        if tls_dir is not None:
+            import shutil
+
+            shutil.rmtree(tls_dir, ignore_errors=True)  # unencrypted key
     print(json.dumps({
         "metric": "grpc insert ops/sec",
         "value": round(rate),

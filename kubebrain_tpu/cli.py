@@ -237,7 +237,9 @@ def build_endpoint(args):
 
         def run_with_front():
             _frun()
-            front.run(args.front_port, args.host)
+            front.run(args.front_port, args.host,
+                      cert_file=args.cert_file, key_file=args.key_file,
+                      ca_file=args.ca_file, secure_only=args.secure_only)
 
         def close_with_front(grace: float = 1.0):
             front.close()

@@ -127,11 +127,24 @@ class FrontServer:
 
     # ------------------------------------------------------------- lifecycle
     def run(self, tcp_port: int, host: str = "127.0.0.1",
-            socket_path: str | None = None) -> None:
-        """Start the backhaul loop thread + kbfront subprocess."""
+            socket_path: str | None = None, cert_file: str = "",
+            key_file: str = "", ca_file: str = "",
+            secure_only: bool = False) -> None:
+        """Start the backhaul loop thread + kbfront subprocess.
+
+        With cert/key, kbfront terminates TLS in its reactor (reference
+        secure modes, endpoint/config.go:159): both-modes by default,
+        plaintext refused when ``secure_only``."""
         self.socket_path = socket_path or f"/tmp/kbfront-{os.getpid()}-{tcp_port}.sock"
         self.tcp_port = tcp_port
         self.host = host
+        self._tls_args: list[str] = []
+        if cert_file and key_file:
+            self._tls_args = ["--cert", cert_file, "--key", key_file]
+            if ca_file:
+                self._tls_args += ["--ca", ca_file]
+            if secure_only:
+                self._tls_args.append("--secure-only")
         self._start_error: Exception | None = None
         self._thread = threading.Thread(
             target=self._thread_main, name="kb-front", daemon=True
@@ -155,7 +168,8 @@ class FrontServer:
             "native", "front", "kbfront",
         )
         self._proc = subprocess.Popen(
-            [binary, str(self.tcp_port), self.socket_path, self.host],
+            [binary, str(self.tcp_port), self.socket_path, self.host,
+             *getattr(self, "_tls_args", [])],
             stdout=subprocess.PIPE,
             stderr=subprocess.DEVNULL if os.environ.get("KB_FRONT_QUIET") else None,
         )

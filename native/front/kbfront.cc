@@ -13,6 +13,13 @@
 // Threading: one epoll reactor thread. All nghttp2 sessions, stream state
 // and the backhaul socket are owned by it; no locks.
 //
+// TLS: terminated in the reactor with OpenSSL memory BIOs (tls_min.h), the
+// same single-port story as the reference's secure path — cmux matches the
+// TLS record byte and serves HTTP+gRPC inside the session
+// (pkg/endpoint/security.go:49-97). Three modes like endpoint/config.go:159:
+// no certs = insecure-only; --cert/--key = both (first byte 0x16 => TLS,
+// else plaintext); + --secure-only = plaintext conns are refused.
+//
 // Backhaul wire protocol (little-endian), one frame per message:
 //   u32 payload_len | u32 conn_id | u32 stream_id | u8 kind | payload
 // kinds (front -> python):
@@ -49,6 +56,7 @@
 #include <vector>
 
 #include "nghttp2_min.h"
+#include "tls_min.h"
 
 namespace {
 
@@ -100,14 +108,43 @@ struct Conn {
   bool sniffed = false;
   nghttp2_session *session = nullptr;
   std::string pre;     // bytes read before protocol decision
-  std::string outbuf;  // pending socket writes
+  std::string outbuf;  // pending socket writes (ciphertext when TLS)
   std::string h1buf;   // http/1 request accumulation
   bool h1_close_after_write = false;
   bool want_write_reg = false;
   std::map<int32_t, Stream> streams;
   bool dead = false;
   bool dirty_flag = false;
+  // TLS termination (memory-BIO; null on plaintext conns)
+  SSL *ssl = nullptr;
+  BIO *rbio = nullptr;
+  BIO *wbio = nullptr;
+  bool tls_decided = false;
+  std::string plainbuf;  // plaintext egress deferred until handshake done
 };
+
+SSL_CTX *g_tls_ctx = nullptr;
+bool g_secure_only = false;
+
+// ALPN: gRPC clients require a negotiated "h2"; https clients may offer
+// http/1.1. Prefer h2, fall back to http/1.1, NOACK otherwise (plain TLS).
+int alpn_select(SSL *, const unsigned char **out, unsigned char *outlen,
+                const unsigned char *in, unsigned int inlen, void *) {
+  for (const char *want : {"h2", "http/1.1"}) {
+    size_t wlen = strlen(want);
+    for (unsigned int i = 0; i + 1 <= inlen;) {
+      unsigned char plen = in[i];
+      if (i + 1 + plen > inlen) break;
+      if (plen == wlen && memcmp(in + i + 1, want, wlen) == 0) {
+        *out = in + i + 1;
+        *outlen = plen;
+        return SSL_TLSEXT_ERR_OK;
+      }
+      i += 1 + plen;
+    }
+  }
+  return SSL_TLSEXT_ERR_NOACK;
+}
 
 struct Front {
   int epfd = -1;
@@ -177,19 +214,64 @@ void conn_update_epoll(Conn *c) {
 
 void conn_kill(Conn *c);
 
+// Drain queued ciphertext from the TLS write BIO into the socket buffer.
+void tls_flush_wbio(Conn *c) {
+  char tbuf[1 << 14];
+  while (BIO_ctrl_pending(c->wbio) > 0) {
+    int n = BIO_read(c->wbio, tbuf, sizeof tbuf);
+    if (n <= 0) break;
+    c->outbuf.append(tbuf, static_cast<size_t>(n));
+  }
+}
+
+// Plaintext egress sink: direct for plaintext conns; through SSL_write for
+// TLS conns (deferred to plainbuf until the handshake completes).
+void conn_emit(Conn *c, const char *data, size_t len) {
+  if (c->ssl == nullptr) {
+    c->outbuf.append(data, len);
+    return;
+  }
+  if (!SSL_is_init_finished(c->ssl) || !c->plainbuf.empty()) {
+    // parked bytes must go first or the h2 byte stream reorders
+    c->plainbuf.append(data, len);
+    return;
+  }
+  size_t off = 0;
+  while (off < len) {
+    int n = SSL_write(c->ssl, data + off, static_cast<int>(len - off));
+    if (n > 0) {
+      off += static_cast<size_t>(n);
+    } else {
+      // renegotiation stall: park the rest; pumped again next write round
+      c->plainbuf.append(data + off, len - off);
+      break;
+    }
+  }
+}
+
 // Pump nghttp2's egress into the conn buffer and the socket.
 void conn_pump_write(Conn *c) {
   if (c->dead) return;
+  // parked plaintext first: stream order must survive a handshake or
+  // renegotiation stall
+  if (c->ssl != nullptr && SSL_is_init_finished(c->ssl) &&
+      !c->plainbuf.empty()) {
+    std::string pending;
+    pending.swap(c->plainbuf);
+    conn_emit(c, pending.data(), pending.size());
+  }
   if (c->is_h2 && c->session) {
-    while (c->outbuf.size() < (1u << 20) &&
+    while (c->outbuf.size() + c->plainbuf.size() +
+               (c->ssl ? BIO_ctrl_pending(c->wbio) : 0) < (1u << 20) &&
            nghttp2_session_want_write(c->session)) {
       const uint8_t *out;
       ssize_t n = nghttp2_session_mem_send(c->session, &out);
       if (n <= 0) break;
-      c->outbuf.append(reinterpret_cast<const char *>(out),
-                       static_cast<size_t>(n));
+      conn_emit(c, reinterpret_cast<const char *>(out),
+                static_cast<size_t>(n));
     }
   }
+  if (c->ssl != nullptr) tls_flush_wbio(c);
   while (!c->outbuf.empty()) {
     ssize_t n = write(c->fd, c->outbuf.data(), c->outbuf.size());
     if (n > 0) {
@@ -221,6 +303,10 @@ void conn_kill(Conn *c) {
   if (c->session) {
     nghttp2_session_del(c->session);
     c->session = nullptr;
+  }
+  if (c->ssl) {
+    SSL_free(c->ssl);  // frees rbio/wbio too
+    c->ssl = nullptr;
   }
   g.conns.erase(c->id);
   g.graveyard.push_back(c);  // freed after the event batch
@@ -444,7 +530,7 @@ void h1_handle(Conn *c) {
 // ------------------------------------------------------------ conn ingest
 const char H2_PREFACE[] = "PRI * HTTP/2.0";
 
-void conn_ingest(Conn *c, const char *buf, size_t n) {
+void conn_ingest_plain(Conn *c, const char *buf, size_t n) {
   if (!c->sniffed) {
     c->pre.append(buf, n);
     size_t have = c->pre.size();
@@ -482,6 +568,64 @@ void conn_ingest(Conn *c, const char *buf, size_t n) {
   }
 }
 
+// Handshake + decrypt loop for a TLS conn; plaintext feeds the same
+// protocol code as a plain socket.
+void tls_pump(Conn *c) {
+  if (!SSL_is_init_finished(c->ssl)) {
+    int rv = SSL_do_handshake(c->ssl);
+    if (rv != 1) {
+      int err = SSL_get_error(c->ssl, rv);
+      if (err != SSL_ERROR_WANT_READ && err != SSL_ERROR_WANT_WRITE) {
+        // best-effort alert delivery, then drop
+        tls_flush_wbio(c);
+        if (!c->outbuf.empty())
+          (void)!write(c->fd, c->outbuf.data(), c->outbuf.size());
+        conn_kill(c);
+        return;
+      }
+    }
+  }
+  if (SSL_is_init_finished(c->ssl)) {
+    char pbuf[1 << 14];
+    while (!c->dead) {
+      int r = SSL_read(c->ssl, pbuf, sizeof pbuf);
+      if (r > 0) {
+        conn_ingest_plain(c, pbuf, static_cast<size_t>(r));
+        continue;
+      }
+      int err = SSL_get_error(c->ssl, r);
+      if (err == SSL_ERROR_WANT_READ || err == SSL_ERROR_WANT_WRITE) break;
+      conn_kill(c);  // close_notify or protocol error
+      return;
+    }
+  }
+  if (!c->dead) conn_pump_write(c);
+}
+
+// Socket-level ingest: TLS record sniff on the first byte (cmux.TLS()
+// analogue), then per-conn decrypt or direct protocol handling.
+void conn_ingest(Conn *c, const char *buf, size_t n) {
+  if (g_tls_ctx != nullptr && !c->tls_decided) {
+    c->tls_decided = true;
+    if (n > 0 && static_cast<uint8_t>(buf[0]) == 0x16) {
+      c->ssl = SSL_new(g_tls_ctx);
+      c->rbio = BIO_new(BIO_s_mem());
+      c->wbio = BIO_new(BIO_s_mem());
+      SSL_set_bio(c->ssl, c->rbio, c->wbio);
+      SSL_set_accept_state(c->ssl);
+    } else if (g_secure_only) {
+      conn_kill(c);  // reference secure-only mode refuses plaintext
+      return;
+    }
+  }
+  if (c->ssl == nullptr) {
+    conn_ingest_plain(c, buf, n);
+    return;
+  }
+  BIO_write(c->rbio, buf, static_cast<int>(n));
+  tls_pump(c);
+}
+
 // -------------------------------------------------------- backhaul ingest
 void handle_back_frame(uint32_t cid, int32_t sid, uint8_t kind,
                        const char *payload, size_t len) {
@@ -500,8 +644,8 @@ void handle_back_frame(uint32_t cid, int32_t sid, uint8_t kind,
                         "HTTP/1.1 %u %s\r\nContent-Type: text/plain\r\n"
                         "Content-Length: %zu\r\nConnection: close\r\n\r\n",
                         status, status == 200 ? "OK" : "Error", blen);
-      c->outbuf.append(hdr, static_cast<size_t>(hl));
-      c->outbuf.append(body, blen);
+      conn_emit(c, hdr, static_cast<size_t>(hl));
+      conn_emit(c, body, blen);
       c->h1_close_after_write = true;
       c->streams.erase(sid);
       conn_pump_write(c);
@@ -583,13 +727,65 @@ void back_ingest(const char *buf, size_t n) {
 
 int main(int argc, char **argv) {
   if (argc < 3) {
-    fprintf(stderr, "usage: kbfront <tcp-port> <backhaul-unix-path> [host]\n");
+    fprintf(stderr,
+            "usage: kbfront <tcp-port> <backhaul-unix-path> [host] "
+            "[--cert F --key F [--ca F] [--secure-only]]\n");
     return 1;
   }
   signal(SIGPIPE, SIG_IGN);
   int port = atoi(argv[1]);
   const char *upath = argv[2];
-  const char *host = argc > 3 ? argv[3] : "127.0.0.1";
+  const char *host = "127.0.0.1";
+  const char *cert = nullptr, *key = nullptr, *ca = nullptr;
+  for (int i = 3; i < argc; i++) {
+    if (strcmp(argv[i], "--cert") == 0) {
+      if (++i >= argc) { fprintf(stderr, "--cert needs a value\n"); return 1; }
+      cert = argv[i];
+    } else if (strcmp(argv[i], "--key") == 0) {
+      if (++i >= argc) { fprintf(stderr, "--key needs a value\n"); return 1; }
+      key = argv[i];
+    } else if (strcmp(argv[i], "--ca") == 0) {
+      if (++i >= argc) { fprintf(stderr, "--ca needs a value\n"); return 1; }
+      ca = argv[i];
+    } else if (strcmp(argv[i], "--secure-only") == 0) {
+      g_secure_only = true;
+    } else if (argv[i][0] == '-') {
+      fprintf(stderr, "unknown flag %s\n", argv[i]);
+      return 1;
+    } else {
+      host = argv[i];
+    }
+  }
+  if ((cert != nullptr) != (key != nullptr)) {
+    fprintf(stderr, "[kbfront] --cert and --key must be set together\n");
+    return 1;
+  }
+  if (cert != nullptr && key != nullptr) {
+    g_tls_ctx = SSL_CTX_new(TLS_server_method());
+    if (g_tls_ctx == nullptr ||
+        SSL_CTX_use_certificate_chain_file(g_tls_ctx, cert) != 1 ||
+        SSL_CTX_use_PrivateKey_file(g_tls_ctx, key, SSL_FILETYPE_PEM) != 1 ||
+        SSL_CTX_check_private_key(g_tls_ctx) != 1) {
+      char err[256];
+      ERR_error_string_n(ERR_get_error(), err, sizeof err);
+      fprintf(stderr, "[kbfront] TLS init failed (%s / %s): %s\n", cert, key,
+              err);
+      return 1;
+    }
+    SSL_CTX_set_alpn_select_cb(g_tls_ctx, alpn_select, nullptr);
+    if (ca != nullptr) {  // mTLS: require + verify client certs
+      if (SSL_CTX_load_verify_locations(g_tls_ctx, ca, nullptr) != 1) {
+        fprintf(stderr, "[kbfront] TLS CA load failed: %s\n", ca);
+        return 1;
+      }
+      SSL_CTX_set_verify(
+          g_tls_ctx, SSL_VERIFY_PEER | SSL_VERIFY_FAIL_IF_NO_PEER_CERT,
+          nullptr);
+    }
+  } else if (g_secure_only) {
+    fprintf(stderr, "[kbfront] --secure-only requires --cert/--key\n");
+    return 1;
+  }
 
   // backhaul first: python owns our lifecycle
   g.back_fd = socket(AF_UNIX, SOCK_STREAM, 0);
@@ -621,7 +817,8 @@ int main(int argc, char **argv) {
   ev.data.fd = g.back_fd;
   epoll_ctl(g.epfd, EPOLL_CTL_ADD, g.back_fd, &ev);
 
-  logf("listening on %s:%d (backhaul %s)", host, port, upath);
+  logf("listening on %s:%d (backhaul %s, tls=%s%s)", host, port, upath,
+       g_tls_ctx ? "on" : "off", g_secure_only ? " secure-only" : "");
   // readiness handshake: the supervisor (endpoint/front.py) waits for this
   // line so a bind/backhaul failure fails startup loudly instead of
   // degrading to a dead port

@@ -31,6 +31,7 @@
 #include <vector>
 
 #include "nghttp2_min.h"
+#include "tls_min.h"
 
 namespace {
 
@@ -141,6 +142,11 @@ struct LoadConn {
   std::string outbuf;
   std::map<int32_t, LoadStream> streams;
   int inflight = 0;
+  // TLS client mode (memory-BIO; null when plaintext)
+  SSL *ssl = nullptr;
+  BIO *rbio = nullptr;
+  BIO *wbio = nullptr;
+  std::string plainbuf;
 };
 
 struct Gen {
@@ -257,14 +263,49 @@ int on_stream_close(nghttp2_session *, int32_t sid, uint32_t error_code,
   return 0;
 }
 
+void tls_flush_wbio(LoadConn *c) {
+  char tbuf[1 << 14];
+  while (BIO_ctrl_pending(c->wbio) > 0) {
+    int n = BIO_read(c->wbio, tbuf, sizeof tbuf);
+    if (n <= 0) break;
+    c->outbuf.append(tbuf, static_cast<size_t>(n));
+  }
+}
+
+void conn_emit(LoadConn *c, const char *data, size_t len) {
+  if (c->ssl == nullptr) {
+    c->outbuf.append(data, len);
+    return;
+  }
+  if (!SSL_is_init_finished(c->ssl) || !c->plainbuf.empty()) {
+    c->plainbuf.append(data, len);
+    return;
+  }
+  size_t off = 0;
+  while (off < len) {
+    int n = SSL_write(c->ssl, data + off, static_cast<int>(len - off));
+    if (n > 0) off += static_cast<size_t>(n);
+    else {
+      c->plainbuf.append(data + off, len - off);
+      break;
+    }
+  }
+}
+
 void conn_flush(LoadConn *c) {
+  if (c->ssl != nullptr && SSL_is_init_finished(c->ssl) &&
+      !c->plainbuf.empty()) {
+    std::string pending;
+    pending.swap(c->plainbuf);
+    conn_emit(c, pending.data(), pending.size());
+  }
   while (nghttp2_session_want_write(c->session)) {
     const uint8_t *out;
     ssize_t n = nghttp2_session_mem_send(c->session, &out);
     if (n <= 0) break;
-    c->outbuf.append(reinterpret_cast<const char *>(out),
-                     static_cast<size_t>(n));
+    conn_emit(c, reinterpret_cast<const char *>(out), static_cast<size_t>(n));
   }
+  if (c->ssl != nullptr) tls_flush_wbio(c);
   while (!c->outbuf.empty()) {
     ssize_t w = write(c->fd, c->outbuf.data(), c->outbuf.size());
     if (w > 0) {
@@ -293,7 +334,19 @@ int main(int argc, char **argv) {
   int nconns = argc > 4 ? atoi(argv[4]) : 8;
   int inflight = argc > 5 ? atoi(argv[5]) : 32;
   g.value_bytes = argc > 6 ? atoi(argv[6]) : 512;
-  if (argc > 7) g.prefix = argv[7];
+  bool use_tls = false;
+  for (int i = 7; i < argc; i++) {
+    if (strcmp(argv[i], "--tls") == 0) use_tls = true;
+    else g.prefix = argv[i];
+  }
+  SSL_CTX *tls_ctx = nullptr;
+  if (use_tls) {
+    tls_ctx = SSL_CTX_new(TLS_client_method());
+    if (tls_ctx == nullptr) {
+      fprintf(stderr, "TLS ctx init failed\n");
+      return 1;
+    }
+  }
   g.value.assign(static_cast<size_t>(g.value_bytes), 'x');
   g.lat_us.reserve(static_cast<size_t>(g.total_ops));
 
@@ -326,6 +379,16 @@ int main(int argc, char **argv) {
         {NGHTTP2_SETTINGS_INITIAL_WINDOW_SIZE, 1 << 20},
     };
     nghttp2_submit_settings(c->session, NGHTTP2_FLAG_NONE, iv, 2);
+    if (tls_ctx != nullptr) {
+      c->ssl = SSL_new(tls_ctx);
+      c->rbio = BIO_new(BIO_s_mem());
+      c->wbio = BIO_new(BIO_s_mem());
+      SSL_set_bio(c->ssl, c->rbio, c->wbio);
+      SSL_set_connect_state(c->ssl);
+      static const unsigned char alpn[] = {2, 'h', '2'};
+      SSL_set_alpn_protos(c->ssl, alpn, sizeof alpn);
+      SSL_do_handshake(c->ssl);  // queues the ClientHello into wbio
+    }
     conns.push_back(c);
     epoll_event ev{};
     ev.events = EPOLLIN;
@@ -352,13 +415,46 @@ int main(int argc, char **argv) {
       LoadConn *c = conns[events[i].data.u32];
       ssize_t r;
       while ((r = read(c->fd, buf, sizeof buf)) > 0) {
-        ssize_t rv = nghttp2_session_mem_recv(
-            c->session, reinterpret_cast<uint8_t *>(buf),
-            static_cast<size_t>(r));
-        if (rv < 0) {
-          fprintf(stderr, "mem_recv: %s\n", nghttp2_strerror((int)rv));
-          return 1;
+        if (c->ssl == nullptr) {
+          ssize_t rv = nghttp2_session_mem_recv(
+              c->session, reinterpret_cast<uint8_t *>(buf),
+              static_cast<size_t>(r));
+          if (rv < 0) {
+            fprintf(stderr, "mem_recv: %s\n", nghttp2_strerror((int)rv));
+            return 1;
+          }
+          continue;
         }
+        BIO_write(c->rbio, buf, static_cast<int>(r));
+        if (!SSL_is_init_finished(c->ssl)) {
+          int hrv = SSL_do_handshake(c->ssl);
+          if (hrv != 1) {
+            int err = SSL_get_error(c->ssl, hrv);
+            if (err != SSL_ERROR_WANT_READ && err != SSL_ERROR_WANT_WRITE) {
+              fprintf(stderr, "TLS handshake failed (%d)\n", err);
+              return 1;
+            }
+          }
+        }
+        if (SSL_is_init_finished(c->ssl)) {
+          char pb[1 << 14];
+          int pr;
+          while ((pr = SSL_read(c->ssl, pb, sizeof pb)) > 0) {
+            ssize_t rv = nghttp2_session_mem_recv(
+                c->session, reinterpret_cast<uint8_t *>(pb),
+                static_cast<size_t>(pr));
+            if (rv < 0) {
+              fprintf(stderr, "mem_recv: %s\n", nghttp2_strerror((int)rv));
+              return 1;
+            }
+          }
+          int err = SSL_get_error(c->ssl, pr);
+          if (err != SSL_ERROR_WANT_READ && err != SSL_ERROR_WANT_WRITE) {
+            fprintf(stderr, "TLS read failed (%d)\n", err);
+            return 1;
+          }
+        }
+        conn_flush(c);
       }
       if (r == 0) {
         fprintf(stderr, "server closed connection\n");

@@ -1,0 +1,73 @@
+// Minimal OpenSSL 3 ABI declarations for kbfront's TLS termination.
+//
+// This image ships the system libssl.so.3 / libcrypto.so.3 runtimes but no
+// development headers — the same situation nghttp2_min.h handles for
+// libnghttp2. These are hand-written declarations of the stable public ABI
+// (all opaque pointers + int/size_t scalars); only the handful of symbols
+// the kbfront reactor uses. Category (b) similarity: the signatures are
+// fixed by OpenSSL's public ABI and cannot differ.
+//
+// Usage pattern (memory-BIO, non-blocking reactor): raw socket bytes go
+// into rbio via BIO_write; SSL_read hands back plaintext; SSL_write queues
+// ciphertext into wbio which BIO_read drains into the socket buffer.
+#pragma once
+
+#include <cstddef>
+
+extern "C" {
+
+typedef struct ssl_ctx_st SSL_CTX;
+typedef struct ssl_st SSL;
+typedef struct bio_st BIO;
+typedef struct bio_method_st BIO_METHOD;
+typedef struct ssl_method_st SSL_METHOD;
+
+const SSL_METHOD *TLS_server_method(void);
+SSL_CTX *SSL_CTX_new(const SSL_METHOD *m);
+void SSL_CTX_free(SSL_CTX *ctx);
+int SSL_CTX_use_certificate_chain_file(SSL_CTX *ctx, const char *file);
+int SSL_CTX_use_PrivateKey_file(SSL_CTX *ctx, const char *file, int type);
+int SSL_CTX_check_private_key(const SSL_CTX *ctx);
+int SSL_CTX_load_verify_locations(SSL_CTX *ctx, const char *ca_file,
+                                  const char *ca_path);
+void SSL_CTX_set_verify(SSL_CTX *ctx, int mode, void *verify_callback);
+
+SSL *SSL_new(SSL_CTX *ctx);
+void SSL_free(SSL *ssl);  // also frees the BIOs set via SSL_set_bio
+void SSL_set_accept_state(SSL *ssl);
+void SSL_set_connect_state(SSL *ssl);
+int SSL_set_alpn_protos(SSL *ssl, const unsigned char *protos,
+                        unsigned int protos_len);  // 0 = success
+const SSL_METHOD *TLS_client_method(void);
+void SSL_set_bio(SSL *ssl, BIO *rbio, BIO *wbio);
+int SSL_do_handshake(SSL *ssl);
+int SSL_is_init_finished(const SSL *ssl);
+int SSL_read(SSL *ssl, void *buf, int num);
+int SSL_write(SSL *ssl, const void *buf, int num);
+int SSL_get_error(const SSL *ssl, int ret);
+
+const BIO_METHOD *BIO_s_mem(void);
+BIO *BIO_new(const BIO_METHOD *type);
+int BIO_write(BIO *b, const void *data, int dlen);
+int BIO_read(BIO *b, void *data, int dlen);
+size_t BIO_ctrl_pending(BIO *b);
+
+unsigned long ERR_get_error(void);
+void ERR_error_string_n(unsigned long e, char *buf, size_t len);
+
+typedef int (*SSL_CTX_alpn_select_cb_func)(SSL *ssl, const unsigned char **out,
+                                           unsigned char *outlen,
+                                           const unsigned char *in,
+                                           unsigned int inlen, void *arg);
+void SSL_CTX_set_alpn_select_cb(SSL_CTX *ctx, SSL_CTX_alpn_select_cb_func cb,
+                                void *arg);
+
+}  // extern "C"
+
+constexpr int SSL_FILETYPE_PEM = 1;
+constexpr int SSL_ERROR_NONE = 0, SSL_ERROR_SSL = 1, SSL_ERROR_WANT_READ = 2,
+              SSL_ERROR_WANT_WRITE = 3, SSL_ERROR_SYSCALL = 5,
+              SSL_ERROR_ZERO_RETURN = 6;
+constexpr int SSL_VERIFY_NONE = 0, SSL_VERIFY_PEER = 1,
+              SSL_VERIFY_FAIL_IF_NO_PEER_CERT = 2;
+constexpr int SSL_TLSEXT_ERR_OK = 0, SSL_TLSEXT_ERR_NOACK = 3;

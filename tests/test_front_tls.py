@@ -1,0 +1,198 @@
+"""TLS termination on the native frontend (kbfront + OpenSSL memory BIOs).
+
+Round 2's gap: the fast path (kbfront) and the secure path (python
+listeners) were mutually exclusive. The reference serves secure and
+insecure on the client port with three modes
+(pkg/endpoint/security.go:49-97, config.go:80-159); kbfront now does the
+same — TLS record sniff on the first byte, h2+h1 demux inside the session.
+"""
+
+import os
+import socket
+import ssl
+import time
+import urllib.request
+
+import grpc
+import pytest
+
+from kubebrain_tpu.backend import Backend, BackendConfig
+from kubebrain_tpu.endpoint.front import FrontServer
+from kubebrain_tpu.proto import rpc_pb2
+from kubebrain_tpu.server import Server
+from kubebrain_tpu.server.service import SingleNodePeerService
+from kubebrain_tpu.storage import new_storage
+
+FRONT_BIN = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "native", "front", "kbfront",
+)
+
+pytestmark = pytest.mark.skipif(
+    not os.path.exists(FRONT_BIN), reason="kbfront not built (make -C native)"
+)
+
+
+def free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+@pytest.fixture(scope="module")
+def certs(tmp_path_factory):
+    from kubebrain_tpu.util.selfsigned import gen_self_signed
+
+    d = tmp_path_factory.mktemp("front-certs")
+    return gen_self_signed(str(d), "kbfront-test")
+
+
+class TlsFrontFixture:
+    def __init__(self, certs, secure_only=False):
+        self.store = new_storage("memkv")
+        self.backend = Backend(
+            self.store, BackendConfig(event_ring_capacity=4096, watch_cache_capacity=4096)
+        )
+        self.peers = SingleNodePeerService(self.backend, "front-tls:0")
+        self.server = Server(
+            self.backend, self.peers, None, "front-tls:0", client_urls=[]
+        )
+        self.front = FrontServer(
+            self.backend, self.peers, self.server, "front-tls:0",
+            brain=self.server.brain,
+        )
+        self.port = free_port()
+        self.cert_file, self.key_file = certs
+        self.front.run(self.port, cert_file=self.cert_file,
+                       key_file=self.key_file, secure_only=secure_only)
+        with open(self.cert_file, "rb") as f:
+            self.root_pem = f.read()
+
+    def secure_channel(self):
+        creds = grpc.ssl_channel_credentials(root_certificates=self.root_pem)
+        ch = grpc.secure_channel(f"localhost:{self.port}", creds)
+        grpc.channel_ready_future(ch).result(timeout=15)
+        return ch
+
+    def kv_stubs(self, channel):
+        p = rpc_pb2
+        txn = channel.unary_unary(
+            "/etcdserverpb.KV/Txn",
+            request_serializer=p.TxnRequest.SerializeToString,
+            response_deserializer=p.TxnResponse.FromString,
+        )
+        rng = channel.unary_unary(
+            "/etcdserverpb.KV/Range",
+            request_serializer=p.RangeRequest.SerializeToString,
+            response_deserializer=p.RangeResponse.FromString,
+        )
+        return txn, rng
+
+    def close(self):
+        self.front.close()
+        self.backend.close()
+        self.store.close()
+
+
+def _create_req(key, value):
+    p = rpc_pb2
+    return p.TxnRequest(
+        compare=[p.Compare(target=p.Compare.MOD, key=key, mod_revision=0)],
+        success=[p.RequestOp(request_put=p.PutRequest(key=key, value=value))],
+        failure=[p.RequestOp(request_range=p.RangeRequest(key=key))],
+    )
+
+
+@pytest.fixture(scope="module")
+def tfront(certs):
+    f = TlsFrontFixture(certs)
+    yield f
+    f.close()
+
+
+def test_tls_grpc_create_and_range(tfront):
+    txn, rng = tfront.kv_stubs(tfront.secure_channel())
+    r = txn(_create_req(b"/registry/tls/a", b"v1"), timeout=10)
+    assert r.succeeded
+    resp = rng(rpc_pb2.RangeRequest(
+        key=b"/registry/tls/", range_end=b"/registry/tls0"), timeout=10)
+    assert [kv.key for kv in resp.kvs] == [b"/registry/tls/a"]
+
+
+def test_plaintext_still_served_in_both_mode(tfront):
+    ch = grpc.insecure_channel(f"127.0.0.1:{tfront.port}")
+    grpc.channel_ready_future(ch).result(timeout=15)
+    txn, rng = tfront.kv_stubs(ch)
+    r = txn(_create_req(b"/registry/tls/plain", b"v2"), timeout=10)
+    assert r.succeeded
+    resp = rng(rpc_pb2.RangeRequest(
+        key=b"/registry/tls/", range_end=b"/registry/tls0"), timeout=10)
+    assert len(resp.kvs) >= 1
+    ch.close()
+
+
+def test_https_and_http_health_same_port(tfront):
+    ctx = ssl.create_default_context(cadata=tfront.root_pem.decode())
+    with urllib.request.urlopen(
+        f"https://localhost:{tfront.port}/health", context=ctx, timeout=10
+    ) as resp:
+        assert resp.status == 200
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{tfront.port}/health", timeout=10
+    ) as resp:
+        assert resp.status == 200
+
+
+def test_secure_only_refuses_plaintext(certs):
+    f = TlsFrontFixture(certs, secure_only=True)
+    try:
+        # TLS works
+        txn, _ = f.kv_stubs(f.secure_channel())
+        assert txn(_create_req(b"/registry/so/a", b"v"), timeout=10).succeeded
+        # plaintext HTTP is dropped without a response
+        with pytest.raises(Exception):
+            urllib.request.urlopen(f"http://127.0.0.1:{f.port}/health", timeout=5)
+        # and a raw plaintext h2 preface gets the connection closed
+        s = socket.create_connection(("127.0.0.1", f.port), timeout=5)
+        s.sendall(b"PRI * HTTP/2.0\r\n\r\nSM\r\n\r\n")
+        s.settimeout(5)
+        assert s.recv(1024) == b""  # EOF: refused
+        s.close()
+    finally:
+        f.close()
+
+
+def test_tls_watch_stream(tfront):
+    """A watch stream inside the TLS session: create events arrive."""
+    p = rpc_pb2
+    ch = tfront.secure_channel()
+    watch = ch.stream_stream(
+        "/etcdserverpb.Watch/Watch",
+        request_serializer=p.WatchRequest.SerializeToString,
+        response_deserializer=p.WatchResponse.FromString,
+    )
+    import queue
+    import threading
+
+    req_q = queue.Queue()
+    req_q.put(p.WatchRequest(create_request=p.WatchCreateRequest(
+        key=b"/registry/tlsw/", range_end=b"/registry/tlsw0")))
+
+    def reqs():
+        while True:
+            item = req_q.get()
+            if item is None:
+                return
+            yield item
+
+    stream = watch(reqs())
+    first = next(stream)
+    assert first.created
+    txn, _ = tfront.kv_stubs(ch)
+    assert txn(_create_req(b"/registry/tlsw/p1", b"v1"), timeout=10).succeeded
+    evt = next(stream)
+    assert evt.events and evt.events[0].kv.key == b"/registry/tlsw/p1"
+    req_q.put(None)
+    stream.cancel()
