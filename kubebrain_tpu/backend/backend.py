@@ -350,6 +350,18 @@ class Backend:
         kvs, more = self.scanner.range_(start, end, read_rev, limit)
         return RangeResult(kvs=kvs, revision=read_rev, more=more, count=len(kvs))
 
+    def list_wire(self, start: bytes, end: bytes, revision: int = 0,
+                  limit: int = 0):
+        """Range read returning ready RangeResponse.kvs wire bytes when the
+        engine scanner has a C wire encoder; None otherwise. Returns
+        (kvs_blob, count, more, read_rev)."""
+        fast = getattr(self.scanner, "list_wire", None)
+        if fast is None:
+            return None
+        read_rev = self._read_revision_checked(revision)
+        blob, n, more = fast(start, end, read_rev, limit)
+        return blob, n, more, read_rev
+
     def count(self, start: bytes, end: bytes, revision: int = 0) -> tuple[int, int]:
         read_rev = self._read_revision_checked(revision)
         return self.scanner.count(start, end, read_rev), read_rev

@@ -49,6 +49,9 @@ def _status_num(code) -> int:
 
 
 _SYNC_CTX = _SyncContextAdapter()
+# the backhaul forwards pre-serialized responses verbatim, so handlers may
+# take the raw wire fast path (kv.py _list / _RawResponse)
+_SYNC_CTX.kb_raw_ok = True
 _END_OK = struct.pack("<IH", 0, 0)  # END payload: status 0, empty message
 
 
@@ -328,7 +331,7 @@ class FrontServer:
         response message or raises."""
         try:
             resp = result()
-            out = resp.SerializeToString()
+            out = bytes(resp) if isinstance(resp, bytes) else resp.SerializeToString()
             w = self._writer
             if w is not None and not w.is_closing():
                 # MSG + END in one write() call

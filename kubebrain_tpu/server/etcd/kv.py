@@ -41,6 +41,14 @@ ERR_COMPACTED = "etcdserver: mvcc: required revision has been compacted"
 ERR_FUTURE_REV = "etcdserver: mvcc: required revision is a future revision"
 
 
+class _RawResponse(bytes):
+    """Pre-serialized response body; the native-front backhaul sends it
+    verbatim (front.py skips SerializeToString for bytes)."""
+
+    def SerializeToString(self) -> bytes:  # grpc-python serializer hook
+        return bytes(self)
+
+
 class KVService:
     def __init__(self, backend: Backend, peers=None, limiter=None):
         self.backend = backend
@@ -49,6 +57,9 @@ class KVService:
 
     # ------------------------------------------------------------------ Range
     def Range(self, request: rpc_pb2.RangeRequest, context) -> rpc_pb2.RangeResponse:
+        # the native-front backhaul forwards pre-serialized bytes verbatim;
+        # python-grpc listeners reserialize, so the raw path is front-only
+        raw_ok = bool(getattr(context, "kb_raw_ok", False))
         if self.peers is not None:
             self.peers.sync_read_revision()
         # etcd range conventions: empty range_end = the single key;
@@ -78,7 +89,7 @@ class KVService:
                 return self._partitions(request)
             if single_key:
                 return self._get(request)
-            return self._list(request, range_end)
+            return self._list(request, range_end, raw_ok)
         except CompactedError:
             context.abort(grpc.StatusCode.OUT_OF_RANGE, ERR_COMPACTED)
         except FutureRevisionError:
@@ -112,7 +123,25 @@ class KVService:
             for kv in resp.kvs:
                 kv.version = kv.mod_revision
 
-    def _list(self, request, range_end: bytes) -> rpc_pb2.RangeResponse:
+    def _list(self, request, range_end: bytes, raw_ok: bool = False) -> rpc_pb2.RangeResponse:
+        # raw fast path: the C engine encodes RangeResponse.kvs wire bytes
+        # directly (kb_mvcc_list_wire) and the native frontend forwards them
+        # without reserialization — no per-row Python anywhere on the list
+        # hot path. Only for the default sort/shape kube-apiserver uses.
+        if (raw_ok
+                and request.sort_target == rpc_pb2.RangeRequest.KEY
+                and request.sort_order == rpc_pb2.RangeRequest.NONE
+                and not request.keys_only
+                and request.key != COMPACT_REV_KEY):
+            fast = self.backend.list_wire(
+                request.key, range_end, request.revision, int(request.limit)
+            )
+            if fast is not None:
+                blob, n, more, read_rev = fast
+                scalar = rpc_pb2.RangeResponse(
+                    header=shim.header(read_rev), more=more, count=n
+                ).SerializeToString()
+                return _RawResponse(scalar + blob)
         res = self.backend.list_(
             request.key, range_end, request.revision, int(request.limit)
         )

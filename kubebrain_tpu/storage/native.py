@@ -21,6 +21,9 @@ import os
 import subprocess
 import threading
 
+from .. import coder
+from ..backend.common import KeyValue
+from ..backend.scanner import Scanner
 from . import BatchWrite, Iter, KvStorage, Partition, register_engine
 from .errors import CASFailedError, Conflict, KeyNotFoundError, StorageError
 
@@ -88,6 +91,41 @@ def _load_lib() -> ctypes.CDLL:
             ctypes.POINTER(u8p), ctypes.POINTER(ctypes.c_size_t),
         ]
         lib.kb_iter_close.argtypes = [ctypes.c_void_p]
+        lib.kb_scan_page.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t,
+            ctypes.c_char_p, ctypes.c_size_t, ctypes.c_uint64, ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_int),
+        ]
+        lib.kb_scan_page.restype = ctypes.c_uint64
+        lib.kb_mvcc_list_page.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t,
+            ctypes.c_char_p, ctypes.c_size_t, ctypes.c_uint64, ctypes.c_uint64,
+            ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p, ctypes.c_size_t,
+            ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_size_t,
+            ctypes.POINTER(ctypes.c_size_t), ctypes.POINTER(ctypes.c_int),
+        ]
+        lib.kb_mvcc_list_page.restype = ctypes.c_uint64
+        lib.kb_mvcc_list_wire.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t,
+            ctypes.c_char_p, ctypes.c_size_t, ctypes.c_uint64, ctypes.c_uint64,
+            ctypes.c_char_p, ctypes.c_size_t, ctypes.c_char_p, ctypes.c_size_t,
+            ctypes.c_uint64, ctypes.c_uint64,
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+            ctypes.POINTER(ctypes.c_size_t),
+            ctypes.POINTER(ctypes.c_uint8), ctypes.c_size_t,
+            ctypes.POINTER(ctypes.c_size_t), ctypes.POINTER(ctypes.c_int),
+        ]
+        lib.kb_mvcc_list_wire.restype = ctypes.c_uint64
         lib.kb_split_keys.argtypes = [
             ctypes.c_void_p, ctypes.c_int, ctypes.c_char_p, ctypes.c_size_t,
             ctypes.POINTER(ctypes.c_size_t),
@@ -206,6 +244,11 @@ class NativeKv(KvStorage):
 
     def iter(self, start: bytes, end: bytes, snapshot_ts: int | None = None, limit: int = 0) -> Iter:
         reverse = 1 if (end and start > end) else 0
+        if not reverse:
+            # forward scans page through ONE FFI call per 1024 rows instead
+            # of 3 calls + 2 copies per row (the etcd list hot path)
+            snap = snapshot_ts or self.get_timestamp_oracle()
+            return _PagedNativeIter(self._lib, self._store, start, end, snap, limit)
         handle = self._lib.kb_iter_open(
             self._store, start, len(start), end, len(end),
             snapshot_ts or 0, limit, reverse,
@@ -384,10 +427,270 @@ class NativeKv(KvStorage):
             raise StorageError("WAL append failed; bulk GC aborted")
         return int(got)
 
+    def mvcc_list_page(self, start: bytes, end: bytes, snapshot_ts: int,
+                       read_rev: int, max_rows: int = 4096,
+                       val_cap: int = 4 << 20):
+        """One page of MVCC-visible (user_key, value, revision) rows — the
+        whole visibility rule runs in C (kb_mvcc_list_page). Returns
+        (rows, more, next_start)."""
+        import numpy as np
+
+        from .. import coder
+        from ..backend.common import TOMBSTONE
+
+        u8 = ctypes.POINTER(ctypes.c_uint8)
+        u64 = ctypes.POINTER(ctypes.c_uint64)
+        key_cap = 1 << 18
+        next_cap = 4096
+        while True:
+            if key_cap > (1 << 30) or val_cap > (1 << 30):
+                raise StorageError("mvcc list row exceeds 1GB arena cap")
+            karena = np.empty(key_cap, dtype=np.uint8)
+            varena = np.empty(val_cap, dtype=np.uint8)
+            koffs = np.empty(max_rows + 1, dtype=np.uint64)
+            voffs = np.empty(max_rows + 1, dtype=np.uint64)
+            revs = np.empty(max_rows, dtype=np.uint64)
+            nxt = np.empty(next_cap, dtype=np.uint8)
+            nxt_len = ctypes.c_size_t()
+            more = ctypes.c_int()
+            n = int(self._lib.kb_mvcc_list_page(
+                self._store, start, len(start), end, len(end),
+                snapshot_ts, read_rev,
+                coder.MAGIC, len(coder.MAGIC), TOMBSTONE, len(TOMBSTONE),
+                max_rows,
+                karena.ctypes.data_as(u8), key_cap, koffs.ctypes.data_as(u64),
+                varena.ctypes.data_as(u8), val_cap, voffs.ctypes.data_as(u64),
+                revs.ctypes.data_as(u64),
+                nxt.ctypes.data_as(u8), next_cap, ctypes.byref(nxt_len),
+                ctypes.byref(more),
+            ))
+            if more.value == 2:
+                next_cap = int(nxt_len.value) + 64
+                continue
+            if n == 0 and more.value:
+                # a single row larger than an arena; C can't say which, so
+                # grow both (bounded above)
+                val_cap *= 4
+                key_cap *= 4
+                continue
+            break
+        ko = koffs[: n + 1].astype(np.int64)
+        vo = voffs[: n + 1].astype(np.int64)
+        kb = karena[: int(ko[-1]) if n else 0].tobytes()
+        vb = varena[: int(vo[-1]) if n else 0].tobytes()
+        rows = [
+            (kb[ko[i]:ko[i + 1]], vb[vo[i]:vo[i + 1]], int(revs[i]))
+            for i in range(n)
+        ]
+        return rows, bool(more.value), bytes(nxt[: nxt_len.value])
+
+    def mvcc_list_wire(self, start: bytes, end: bytes, snapshot_ts: int,
+                       read_rev: int, max_rows: int = 65536,
+                       byte_cap: int = 32 << 20):
+        """One MVCC list page as ready RangeResponse.kvs protobuf bytes —
+        the entire list hot path (visibility + wire encoding) in one C call.
+        Returns (blob, rows, more, next_start)."""
+        from .. import coder
+        from ..backend.common import TOMBSTONE
+
+        out = ctypes.POINTER(ctypes.c_uint8)()
+        out_len = ctypes.c_size_t()
+        nxt_len = ctypes.c_size_t()
+        more = ctypes.c_int()
+        next_cap = 4096
+        while True:
+            nxt = (ctypes.c_uint8 * next_cap)()
+            rows = int(self._lib.kb_mvcc_list_wire(
+                self._store, start, len(start), end, len(end),
+                snapshot_ts, read_rev,
+                coder.MAGIC, len(coder.MAGIC), TOMBSTONE, len(TOMBSTONE),
+                max_rows, byte_cap,
+                ctypes.byref(out), ctypes.byref(out_len),
+                nxt, next_cap, ctypes.byref(nxt_len), ctypes.byref(more),
+            ))
+            blob = ctypes.string_at(out, out_len.value)
+            self._lib.kb_free(out)
+            if more.value == 2:
+                next_cap = int(nxt_len.value) + 64
+                continue
+            return blob, rows, bool(more.value), bytes(nxt[: nxt_len.value])
+
+    def make_scanner(self, **kwargs):
+        return NativeScanner(self, **kwargs)
+
     def close(self) -> None:
         if self._store:
             self._lib.kb_close(self._store)
             self._store = None
+
+
+class NativeScanner(Scanner):
+    """Generic scanner with the list hot paths served by the engine's C
+    MVCC pass (kb_mvcc_list_page) — one FFI call per page instead of a
+    per-row Python loop. Compact keeps the generic (partition-parallel)
+    implementation. Reference analogue: the scan worker loop
+    (scanner.go:389-516) running inside the Badger-role engine."""
+
+    PAGE_ROWS = 4096
+
+    def _list_pages(self, lo: bytes, hi: bytes, snapshot: int, read_rev: int,
+                    max_rows: int):
+        cursor = lo
+        while True:
+            rows, more, nxt = self._store.mvcc_list_page(
+                cursor, hi, snapshot, read_rev, max_rows
+            )
+            yield rows
+            if not more or not nxt:
+                return
+            cursor = nxt
+
+    def range_(self, start: bytes, end: bytes, read_revision: int, limit: int = 0):
+        lo, hi = coder.internal_range(start, end)
+        snapshot = self._snapshot_checked(read_revision)
+        kvs: list[KeyValue] = []
+        want = min(limit + 1, self.PAGE_ROWS) if limit else self.PAGE_ROWS
+        for rows in self._list_pages(lo, hi, snapshot, read_revision, want):
+            kvs.extend(KeyValue(k, v, r) for k, v, r in rows)
+            if limit and len(kvs) > limit:
+                break
+        if limit:
+            return kvs[:limit], len(kvs) > limit
+        return kvs, False
+
+    def count(self, start: bytes, end: bytes, read_revision: int) -> int:
+        lo, hi = coder.internal_range(start, end)
+        snapshot = self._snapshot_checked(read_revision)
+        total = 0
+        for rows in self._list_pages(lo, hi, snapshot, read_revision, self.PAGE_ROWS):
+            total += len(rows)
+        return total
+
+    def list_wire(self, start: bytes, end: bytes, read_revision: int,
+                  limit: int = 0) -> tuple[bytes, int, bool]:
+        """Visible range as ready RangeResponse.kvs wire bytes (C encoder).
+        Returns (kvs_blob, n_rows, more)."""
+        lo, hi = coder.internal_range(start, end)
+        snapshot = self._snapshot_checked(read_revision)
+        blobs: list[bytes] = []
+        total = 0
+        cursor = lo
+        while True:
+            want = min(limit - total, self.PAGE_ROWS) if limit else self.PAGE_ROWS
+            blob, n, more, nxt = self._store.mvcc_list_wire(
+                cursor, hi, snapshot, read_revision, want
+            )
+            blobs.append(blob)
+            total += n
+            if limit and total >= limit:
+                # the C more flag is exact: set only when a further visible
+                # non-tombstone row exists — etcd's More semantics directly
+                return b"".join(blobs), total, more
+            if not more or not nxt:
+                return b"".join(blobs), total, False
+            cursor = nxt
+
+    def range_stream(self, start: bytes, end: bytes, read_revision: int,
+                     batch_size: int = 300):
+        lo, hi = coder.internal_range(start, end)
+        snapshot = self._snapshot_checked(read_revision)
+
+        def generate():
+            batch: list[KeyValue] = []
+            for rows in self._list_pages(lo, hi, snapshot, read_revision,
+                                         self.PAGE_ROWS):
+                for k, v, r in rows:
+                    batch.append(KeyValue(k, v, r))
+                    if len(batch) >= batch_size:
+                        out, b2 = batch[:], []
+                        batch = b2
+                        yield out
+            if batch:
+                yield batch
+
+        return generate()
+
+
+class _PagedNativeIter(Iter):
+    """Forward scan over kb_scan_page: bulk pages, zero per-row FFI."""
+
+    PAGE_ROWS = 1024
+    KEY_CAP = 1 << 18
+    VAL_CAP = 4 << 20
+
+    def __init__(self, lib, store, start, end, snap, limit):
+        self._lib = lib
+        self._store = store
+        self._cursor = start
+        self._end = end
+        self._snap = snap
+        self._limit = limit
+        self._served = 0
+        self._rows: list[tuple[bytes, bytes]] = []
+        self._pos = 0
+        self._more = True
+        self._val_cap = self.VAL_CAP
+
+    def _fetch(self) -> None:
+        import numpy as np
+
+        want = self.PAGE_ROWS
+        if self._limit:
+            want = min(want, self._limit - self._served)
+        while True:
+            if getattr(self, "_karena", None) is None or len(self._varena) < self._val_cap:
+                self._karena = np.empty(self.KEY_CAP, dtype=np.uint8)
+                self._varena = np.empty(self._val_cap, dtype=np.uint8)
+                self._koffs = np.empty(self.PAGE_ROWS + 1, dtype=np.uint64)
+                self._voffs = np.empty(self.PAGE_ROWS + 1, dtype=np.uint64)
+            karena, varena = self._karena, self._varena
+            koffs, voffs = self._koffs, self._voffs
+            more = ctypes.c_int()
+            u8 = ctypes.POINTER(ctypes.c_uint8)
+            u64 = ctypes.POINTER(ctypes.c_uint64)
+            n = int(self._lib.kb_scan_page(
+                self._store, self._cursor, len(self._cursor),
+                self._end, len(self._end), self._snap, want,
+                karena.ctypes.data_as(u8), self.KEY_CAP,
+                koffs.ctypes.data_as(u64),
+                varena.ctypes.data_as(u8), self._val_cap,
+                voffs.ctypes.data_as(u64),
+                ctypes.byref(more),
+            ))
+            if n == 0 and more.value:
+                # single row larger than the value arena: grow and retry
+                self._val_cap *= 4
+                continue
+            break
+        ko = koffs[: n + 1].astype(np.int64)
+        vo = voffs[: n + 1].astype(np.int64)
+        kb = karena[: int(ko[-1]) if n else 0].tobytes()
+        vb = varena[: int(vo[-1]) if n else 0].tobytes()
+        self._rows = [
+            (kb[ko[i]:ko[i + 1]], vb[vo[i]:vo[i + 1]]) for i in range(n)
+        ]
+        self._pos = 0
+        self._more = bool(more.value)
+        if n:
+            self._cursor = self._rows[-1][0] + b"\x00"
+
+    def next(self) -> tuple[bytes, bytes]:
+        if self._limit and self._served >= self._limit:
+            raise StopIteration
+        if self._pos >= len(self._rows):
+            if not self._more:
+                raise StopIteration
+            self._fetch()
+            if not self._rows:
+                raise StopIteration
+        kv = self._rows[self._pos]
+        self._pos += 1
+        self._served += 1
+        return kv
+
+    def close(self) -> None:
+        self._rows = []
+        self._more = False
 
 
 class _NativeIter(Iter):

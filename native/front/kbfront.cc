@@ -214,52 +214,16 @@ void conn_update_epoll(Conn *c) {
 
 void conn_kill(Conn *c);
 
-// Drain queued ciphertext from the TLS write BIO into the socket buffer.
-void tls_flush_wbio(Conn *c) {
-  char tbuf[1 << 14];
-  while (BIO_ctrl_pending(c->wbio) > 0) {
-    int n = BIO_read(c->wbio, tbuf, sizeof tbuf);
-    if (n <= 0) break;
-    c->outbuf.append(tbuf, static_cast<size_t>(n));
-  }
-}
-
-// Plaintext egress sink: direct for plaintext conns; through SSL_write for
-// TLS conns (deferred to plainbuf until the handshake completes).
+// TLS pump shared with kbloadgen (tls_min.h): thin local names.
+void tls_flush_wbio(Conn *c) { kb_tls_flush_wbio(c); }
 void conn_emit(Conn *c, const char *data, size_t len) {
-  if (c->ssl == nullptr) {
-    c->outbuf.append(data, len);
-    return;
-  }
-  if (!SSL_is_init_finished(c->ssl) || !c->plainbuf.empty()) {
-    // parked bytes must go first or the h2 byte stream reorders
-    c->plainbuf.append(data, len);
-    return;
-  }
-  size_t off = 0;
-  while (off < len) {
-    int n = SSL_write(c->ssl, data + off, static_cast<int>(len - off));
-    if (n > 0) {
-      off += static_cast<size_t>(n);
-    } else {
-      // renegotiation stall: park the rest; pumped again next write round
-      c->plainbuf.append(data + off, len - off);
-      break;
-    }
-  }
+  kb_tls_emit(c, data, len);
 }
 
 // Pump nghttp2's egress into the conn buffer and the socket.
 void conn_pump_write(Conn *c) {
   if (c->dead) return;
-  // parked plaintext first: stream order must survive a handshake or
-  // renegotiation stall
-  if (c->ssl != nullptr && SSL_is_init_finished(c->ssl) &&
-      !c->plainbuf.empty()) {
-    std::string pending;
-    pending.swap(c->plainbuf);
-    conn_emit(c, pending.data(), pending.size());
-  }
+  kb_tls_replay_parked(c);  // parked plaintext first: keeps stream order
   if (c->is_h2 && c->session) {
     while (c->outbuf.size() + c->plainbuf.size() +
                (c->ssl ? BIO_ctrl_pending(c->wbio) : 0) < (1u << 20) &&

@@ -263,49 +263,19 @@ int on_stream_close(nghttp2_session *, int32_t sid, uint32_t error_code,
   return 0;
 }
 
-void tls_flush_wbio(LoadConn *c) {
-  char tbuf[1 << 14];
-  while (BIO_ctrl_pending(c->wbio) > 0) {
-    int n = BIO_read(c->wbio, tbuf, sizeof tbuf);
-    if (n <= 0) break;
-    c->outbuf.append(tbuf, static_cast<size_t>(n));
-  }
-}
-
 void conn_emit(LoadConn *c, const char *data, size_t len) {
-  if (c->ssl == nullptr) {
-    c->outbuf.append(data, len);
-    return;
-  }
-  if (!SSL_is_init_finished(c->ssl) || !c->plainbuf.empty()) {
-    c->plainbuf.append(data, len);
-    return;
-  }
-  size_t off = 0;
-  while (off < len) {
-    int n = SSL_write(c->ssl, data + off, static_cast<int>(len - off));
-    if (n > 0) off += static_cast<size_t>(n);
-    else {
-      c->plainbuf.append(data + off, len - off);
-      break;
-    }
-  }
+  kb_tls_emit(c, data, len);  // shared pump, tls_min.h
 }
 
 void conn_flush(LoadConn *c) {
-  if (c->ssl != nullptr && SSL_is_init_finished(c->ssl) &&
-      !c->plainbuf.empty()) {
-    std::string pending;
-    pending.swap(c->plainbuf);
-    conn_emit(c, pending.data(), pending.size());
-  }
+  kb_tls_replay_parked(c);
   while (nghttp2_session_want_write(c->session)) {
     const uint8_t *out;
     ssize_t n = nghttp2_session_mem_send(c->session, &out);
     if (n <= 0) break;
     conn_emit(c, reinterpret_cast<const char *>(out), static_cast<size_t>(n));
   }
-  if (c->ssl != nullptr) tls_flush_wbio(c);
+  if (c->ssl != nullptr) kb_tls_flush_wbio(c);
   while (!c->outbuf.empty()) {
     ssize_t w = write(c->fd, c->outbuf.data(), c->outbuf.size());
     if (w > 0) {

@@ -13,6 +13,9 @@
 #pragma once
 
 #include <cstddef>
+#include <cstring>
+
+#include <string>
 
 extern "C" {
 
@@ -71,3 +74,54 @@ constexpr int SSL_ERROR_NONE = 0, SSL_ERROR_SSL = 1, SSL_ERROR_WANT_READ = 2,
 constexpr int SSL_VERIFY_NONE = 0, SSL_VERIFY_PEER = 1,
               SSL_VERIFY_FAIL_IF_NO_PEER_CERT = 2;
 constexpr int SSL_TLSEXT_ERR_OK = 0, SSL_TLSEXT_ERR_NOACK = 3;
+
+// ---- shared memory-BIO pump (kbfront server side + kbloadgen client side)
+// For any conn type with fields: SSL *ssl; BIO *wbio;
+// std::string plainbuf, outbuf. Plaintext egress goes through kb_tls_emit;
+// ciphertext drains from the write BIO into outbuf via kb_tls_flush_wbio.
+
+template <typename C>
+inline void kb_tls_flush_wbio(C *c) {
+  char tbuf[1 << 14];
+  while (BIO_ctrl_pending(c->wbio) > 0) {
+    int n = BIO_read(c->wbio, tbuf, sizeof tbuf);
+    if (n <= 0) break;
+    c->outbuf.append(tbuf, static_cast<size_t>(n));
+  }
+}
+
+template <typename C>
+inline void kb_tls_emit(C *c, const char *data, size_t len) {
+  if (c->ssl == nullptr) {
+    c->outbuf.append(data, len);
+    return;
+  }
+  if (!SSL_is_init_finished(c->ssl) || !c->plainbuf.empty()) {
+    // parked bytes must go first or the byte stream reorders
+    c->plainbuf.append(data, len);
+    return;
+  }
+  size_t off = 0;
+  while (off < len) {
+    int n = SSL_write(c->ssl, data + off, static_cast<int>(len - off));
+    if (n > 0) {
+      off += static_cast<size_t>(n);
+    } else {
+      // renegotiation stall: park the rest; pumped again next write round
+      c->plainbuf.append(data + off, len - off);
+      break;
+    }
+  }
+}
+
+// Replay parked plaintext (call BEFORE pumping new egress so stream order
+// survives a handshake or renegotiation stall).
+template <typename C>
+inline void kb_tls_replay_parked(C *c) {
+  if (c->ssl != nullptr && SSL_is_init_finished(c->ssl) &&
+      !c->plainbuf.empty()) {
+    std::string pending;
+    pending.swap(c->plainbuf);
+    kb_tls_emit(c, pending.data(), pending.size());
+  }
+}
