@@ -277,7 +277,25 @@ def test_composed_topology_failover_differential():
         assert len(ls) == 1, "cluster must elect exactly one leader"
         leader = ls[0]
 
+        # the kbfront subprocess starts after the python listeners; under
+        # full-suite CPU load it can lag leadership by seconds — wait for it
+        def wait_front(node, deadline=60):
+            end = time.time() + deadline
+            while time.time() < end:
+                rc = node.proc.poll()
+                if rc is not None:
+                    raise AssertionError(f"node died (exit {rc}) before kbfront came up")
+                try:
+                    s = socket.create_connection(
+                        ("127.0.0.1", node.front_port), timeout=1.0)
+                    s.close()
+                    return
+                except OSError:
+                    time.sleep(0.2)
+            raise AssertionError(f"kbfront on :{node.front_port} never came up")
+
         # writes go through the native front port (the production path)
+        wait_front(leader)
         c = EtcdCompatClient(f"127.0.0.1:{leader.front_port}")
         acked = []
         for i in range(40):
@@ -319,6 +337,7 @@ def test_composed_topology_failover_differential():
             (kv.key, kv.value)
             for kv in oracle.list_(b"/registry/comp/", b"/registry/comp0").kvs
         )
+        wait_front(new_leader)
         c2 = EtcdCompatClient(f"127.0.0.1:{new_leader.front_port}")
         got = []
         deadline = time.time() + 30

@@ -1,0 +1,290 @@
+"""Linearizability checking (VERDICT r2 next #8).
+
+The reference leaves Jepsen-style verification as a TODO
+(/root/reference/README.md:30-34). `kubebrain_tpu/lincheck.py` is a
+porcupine-style checker over recorded op histories; this file proves it
+on hand-built histories (including ones it MUST reject), on a live
+contended-key soak against the real backend, and on a seeded stale-read
+bug that the checker is required to catch.
+"""
+
+import math
+import threading
+import time
+import random
+
+import pytest
+
+from kubebrain_tpu.backend import Backend, BackendConfig
+from kubebrain_tpu.backend.errors import CASRevisionMismatchError, KeyExistsError
+from kubebrain_tpu.lincheck import History, Op, _apply, _check_key
+from kubebrain_tpu.storage import new_storage
+from kubebrain_tpu.storage.errors import KeyNotFoundError
+
+
+# --------------------------------------------------------------- unit: model
+def test_model_create_then_read():
+    s0 = (False, b"", 0)
+    (s1,) = _apply(Op(0, "create", b"k", 0, 1, value=b"a", ok=True, rev=5), s0)
+    assert s1 == (True, b"a", 5)
+    assert _apply(Op(0, "get", b"k", 2, 3, value=b"a", ok=True, rev=5), s1) == [s1]
+    assert _apply(Op(0, "get", b"k", 2, 3, value=b"stale", ok=True, rev=5), s1) == []
+    assert _apply(Op(0, "get", b"k", 2, 3, ok=False), s1) == []
+
+
+def test_model_cas_chain():
+    s = (True, b"a", 5)
+    # CAS success requires matching prev_rev and a larger new rev
+    assert _apply(Op(0, "update", b"k", 0, 1, value=b"b", prev_rev=5, ok=True, rev=9), s) \
+        == [(True, b"b", 9)]
+    assert _apply(Op(0, "update", b"k", 0, 1, value=b"b", prev_rev=4, ok=True, rev=9), s) == []
+    assert _apply(Op(0, "update", b"k", 0, 1, value=b"b", prev_rev=5, ok=True, rev=3), s) == []
+    # a conflict against the matching revision is unjustified
+    assert _apply(Op(0, "update", b"k", 0, 1, prev_rev=5, ok=False, err="conflict"), s) == []
+    assert _apply(Op(0, "update", b"k", 0, 1, prev_rev=4, ok=False, err="conflict"), s) == [s]
+
+
+def test_model_unknown_write_then_revealing_read():
+    """An unacknowledged create may have landed; a later read reveals its rev."""
+    s0 = (False, b"", 0)
+    (s1,) = _apply(Op(0, "create", b"k", 0, math.inf, value=b"a", ok=None), s0)
+    assert s1 == (True, b"a", -1)
+    assert _apply(Op(1, "get", b"k", 5, 6, value=b"a", ok=True, rev=77), s1) \
+        == [(True, b"a", 77)]
+
+
+# -------------------------------------------------------------- unit: search
+def _seq(*ops):
+    h = History()
+    h.ops = list(ops)
+    return h.check()
+
+
+def test_sequential_history_passes():
+    r = _seq(
+        Op(0, "create", b"k", 0.0, 1.0, value=b"a", ok=True, rev=1),
+        Op(0, "get", b"k", 2.0, 3.0, value=b"a", ok=True, rev=1),
+        Op(0, "update", b"k", 4.0, 5.0, value=b"b", prev_rev=1, ok=True, rev=2),
+        Op(0, "delete", b"k", 6.0, 7.0, prev_rev=2, ok=True, rev=3),
+        Op(0, "get", b"k", 8.0, 9.0, ok=False),
+    )
+    assert r["ok"], r
+
+
+def test_concurrent_overlap_passes():
+    # two overlapping creates: one wins, one conflicts — legal
+    r = _seq(
+        Op(0, "create", b"k", 0.0, 5.0, value=b"a", ok=True, rev=1),
+        Op(1, "create", b"k", 0.1, 5.1, value=b"b", ok=False, err="conflict", conflict_rev=1),
+    )
+    assert r["ok"], r
+
+
+def test_stale_read_rejected():
+    """A read that returns the OLD value after the overwrite completed (in
+    real time) has no linearization point — must be rejected."""
+    r = _seq(
+        Op(0, "create", b"k", 0.0, 1.0, value=b"a", ok=True, rev=1),
+        Op(0, "update", b"k", 2.0, 3.0, value=b"b", prev_rev=1, ok=True, rev=2),
+        Op(1, "get", b"k", 4.0, 5.0, value=b"a", ok=True, rev=1),  # stale!
+    )
+    assert not r["ok"]
+
+
+def test_lost_acked_write_rejected():
+    # acked create, then a completed read says not-found
+    r = _seq(
+        Op(0, "create", b"k", 0.0, 1.0, value=b"a", ok=True, rev=1),
+        Op(1, "get", b"k", 2.0, 3.0, ok=False),
+    )
+    assert not r["ok"]
+
+
+def test_duplicate_revision_rejected():
+    r = _seq(
+        Op(0, "create", b"a", 0.0, 1.0, value=b"x", ok=True, rev=7),
+        Op(1, "create", b"b", 0.0, 1.0, value=b"y", ok=True, rev=7),
+    )
+    assert not r["ok"] and "twice" in r["violation"]
+
+
+def test_cross_key_realtime_revision_rejected():
+    # A finished (rev 9) before B started, yet B got a smaller revision
+    r = _seq(
+        Op(0, "create", b"a", 0.0, 1.0, value=b"x", ok=True, rev=9),
+        Op(1, "create", b"b", 2.0, 3.0, value=b"y", ok=True, rev=4),
+    )
+    assert not r["ok"] and "real-time" in r["violation"]
+
+
+def test_unjustified_conflict_rejected():
+    # create conflicts but nothing ever wrote the key
+    r = _seq(
+        Op(0, "create", b"k", 0.0, 1.0, ok=False, err="conflict", value=b"a"),
+    )
+    assert not r["ok"]
+
+
+def test_unknown_op_both_branches():
+    # unacked create: history is legal whether it landed or not
+    ok_landed = _seq(
+        Op(0, "create", b"k", 0.0, math.inf, value=b"a", ok=None),
+        Op(1, "get", b"k", 5.0, 6.0, value=b"a", ok=True, rev=3),
+    )
+    assert ok_landed["ok"], ok_landed
+    ok_skipped = _seq(
+        Op(0, "create", b"k", 0.0, math.inf, value=b"a", ok=None),
+        Op(1, "get", b"k", 5.0, 6.0, ok=False),
+    )
+    assert ok_skipped["ok"], ok_skipped
+    # but it cannot have landed BEFORE an earlier completed not-found read
+    # and still be read back afterward with no other writer
+    bad = _seq(
+        Op(1, "get", b"k", 0.0, 1.0, value=b"a", ok=True, rev=3),
+        Op(0, "create", b"k", 2.0, math.inf, value=b"a", ok=None),
+    )
+    assert not bad["ok"]
+
+
+# ------------------------------------------------- live soak vs real backend
+class _Recorder:
+    """Wraps a Backend; records every op into a History."""
+
+    def __init__(self, backend):
+        self.b = backend
+        self.h = History()
+        self._lock = threading.Lock()
+
+    def _rec(self, **kw):
+        with self._lock:
+            self.h.record(**kw)
+
+    def create(self, client, key, value):
+        t0 = time.monotonic()
+        try:
+            rev = self.b.create(key, value)
+            self._rec(client=client, kind="create", key=key, call=t0,
+                      ret=time.monotonic(), value=value, ok=True, rev=rev)
+            return rev
+        except KeyExistsError as e:
+            self._rec(client=client, kind="create", key=key, call=t0,
+                      ret=time.monotonic(), value=value, ok=False,
+                      err="conflict", conflict_rev=e.revision)
+            return None
+
+    def update(self, client, key, value, prev_rev):
+        t0 = time.monotonic()
+        try:
+            rev = self.b.update(key, value, prev_rev)
+            self._rec(client=client, kind="update", key=key, call=t0,
+                      ret=time.monotonic(), value=value, prev_rev=prev_rev,
+                      ok=True, rev=rev)
+            return rev
+        except CASRevisionMismatchError as e:
+            self._rec(client=client, kind="update", key=key, call=t0,
+                      ret=time.monotonic(), value=value, prev_rev=prev_rev,
+                      ok=False, err="conflict", conflict_rev=e.revision)
+            return None
+
+    def delete(self, client, key, prev_rev=0):
+        t0 = time.monotonic()
+        try:
+            rev, _prev = self.b.delete(key, prev_rev)
+            self._rec(client=client, kind="delete", key=key, call=t0,
+                      ret=time.monotonic(), prev_rev=prev_rev, ok=True, rev=rev)
+            return rev
+        except KeyNotFoundError:
+            self._rec(client=client, kind="delete", key=key, call=t0,
+                      ret=time.monotonic(), prev_rev=prev_rev, ok=False,
+                      err="notfound")
+        except CASRevisionMismatchError as e:
+            self._rec(client=client, kind="delete", key=key, call=t0,
+                      ret=time.monotonic(), prev_rev=prev_rev, ok=False,
+                      err="conflict", conflict_rev=e.revision)
+        return None
+
+    def get(self, client, key):
+        t0 = time.monotonic()
+        try:
+            kv = self.b.get(key)
+            self._rec(client=client, kind="get", key=key, call=t0,
+                      ret=time.monotonic(), value=bytes(kv.value), ok=True,
+                      rev=kv.revision)
+            return kv
+        except KeyNotFoundError:
+            self._rec(client=client, kind="get", key=key, call=t0,
+                      ret=time.monotonic(), ok=False)
+            return None
+
+
+def _soak(rec, n_clients=6, n_ops=120, n_keys=4, seed=1):
+    def worker(c):
+        rng = random.Random(seed * 1000 + c)
+        for _ in range(n_ops):
+            key = b"/lin/hot-%d" % rng.randrange(n_keys)
+            roll = rng.random()
+            if roll < 0.35:
+                rec.get(c, key)
+            elif roll < 0.55:
+                rec.create(c, key, b"c%d" % c)
+            elif roll < 0.9:
+                kv = rec.get(c, key)
+                if kv is not None:
+                    rec.update(c, key, b"u%d" % c, kv.revision)
+            else:
+                kv = rec.get(c, key)
+                if kv is not None:
+                    rec.delete(c, key, kv.revision)
+
+    threads = [threading.Thread(target=worker, args=(c,)) for c in range(n_clients)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+
+@pytest.mark.parametrize("engine", ["memkv", "native"])
+def test_live_backend_is_linearizable(engine):
+    store = new_storage(engine)
+    b = Backend(store, BackendConfig(event_ring_capacity=65536))
+    try:
+        rec = _Recorder(b)
+        _soak(rec)
+        res = rec.h.check()
+        assert res["ok"], res["violation"]
+        assert res["ops"] > 500
+    finally:
+        b.close()
+        store.close()
+
+
+def test_seeded_stale_read_bug_is_caught():
+    """Break the backend on purpose — serve reads from a never-invalidated
+    cache — and require the checker to reject the history."""
+    store = new_storage("memkv")
+    b = Backend(store, BackendConfig(event_ring_capacity=65536))
+    try:
+        rec = _Recorder(b)
+        cache = {}
+        real_get = rec.get
+
+        def buggy_get(client, key):
+            t0 = time.monotonic()
+            if key in cache:
+                kv = cache[key]  # stale: ignores every later write
+                rec._rec(client=client, kind="get", key=key, call=t0,
+                         ret=time.monotonic(), value=bytes(kv.value), ok=True,
+                         rev=kv.revision)
+                return kv
+            kv = real_get(client, key)
+            if kv is not None:
+                cache[key] = kv
+            return kv
+
+        rec.get = buggy_get
+        _soak(rec, seed=7)
+        res = rec.h.check()
+        assert not res["ok"], "checker failed to catch the seeded stale-read bug"
+    finally:
+        b.close()
+        store.close()
