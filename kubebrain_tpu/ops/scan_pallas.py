@@ -14,8 +14,9 @@ Same math as ops.scan.visibility_mask, tiled explicitly for the TPU VPU:
   tile's first key/candidate across grid steps (TPU grid iterations are
   sequential, so the carry is well-defined — the Pallas analogue of the scan
   worker's prev-key carry, scanner.go:408-414);
-- the lex compare avoids argmax/gather: first-differing-chunk selection via
-  an exclusive cumsum over the not-equal mask.
+- the lex compare avoids argmax/gather/cumsum (none lower through Mosaic):
+  first-differing-chunk selection via an unrolled prefix-AND over the
+  static chunk axis.
 
 Falls back to interpret mode off-TPU (tests run it on CPU against the jnp
 kernel as oracle).
@@ -49,14 +50,22 @@ def split_revs31(revs: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
 
 
 def _lex_less(keys, bound, neq, lt):
-    """columns of keys < bound, via exclusive-cumsum first-diff selection.
+    """columns of keys < bound: first-differing-chunk decides.
 
     keys/neq/lt: [C, T]; bound: [C, 1]. Returns [1, T] bool.
+
+    Unrolled prefix-AND over the (static, small) chunk axis — Mosaic has no
+    cumsum lowering, and C is 16 for 64-byte keys, so a trace-time loop of
+    plain VPU mask ops is both lowerable and cheap.
     """
     del keys, bound
-    before = jnp.cumsum(neq.astype(jnp.int32), axis=0) - neq.astype(jnp.int32)
-    first_diff = neq & (before == 0)
-    return jnp.any(first_diff & lt, axis=0, keepdims=True)
+    c = neq.shape[0]
+    out = lt[0:1, :]
+    prefix_eq = ~neq[0:1, :]
+    for ci in range(1, c):
+        out = out | (prefix_eq & lt[ci : ci + 1, :])
+        prefix_eq = prefix_eq & ~neq[ci : ci + 1, :]
+    return out
 
 
 def _kernel(scal_ref, start_ref, end_ref,
@@ -95,7 +104,7 @@ def _kernel(scal_ref, start_ref, end_ref,
     idx = t * tile + lane
     valid = idx < n_valid
 
-    cand = valid & in_range & rev_le & True
+    cand = valid & in_range & rev_le
 
     # same-key-as-next within the tile; the last column compares against the
     # carried first key of the NEXT tile (processed in the previous step)
@@ -104,19 +113,20 @@ def _kernel(scal_ref, start_ref, end_ref,
     is_last_col = lane == (tile - 1)
     nxt_keys = jnp.where(is_last_col, carried, nxt_keys)
     same_next = jnp.all(keys == nxt_keys, axis=0, keepdims=True)
-    have_next = (t + 1) * tile < n_valid
-    same_next = same_next & (~is_last_col | have_next)
+    # scalar bools broadcast into vector selects lower as i8->i1 truncations
+    # Mosaic rejects; keep the carried flags in int32 until the final compare
+    have_i = ((t + 1) * tile < n_valid).astype(jnp.int32)
+    same_next = same_next & (jnp.where(is_last_col, have_i, 1) != 0)
 
-    cand_next = jnp.roll(cand, -1, axis=1)
-    carried_cand = carry_flag[0] != 0
-    cand_next = jnp.where(is_last_col, carried_cand & have_next, cand_next)
+    cand_next_i = jnp.roll(cand.astype(jnp.int32), -1, axis=1)
+    cand_next = jnp.where(is_last_col, carry_flag[0] * have_i, cand_next_i) != 0
 
     visible = cand & ~(same_next & cand_next) & ~tomb
     mask_ref[:, :] = visible.astype(jnp.int8)
 
     # publish this tile's first column for the next grid step (tile t-1)
     carry_key[:, :] = keys[:, 0:1]
-    carry_flag[0] = cand[0, 0].astype(jnp.int32)
+    carry_flag[0] = cand.astype(jnp.int32)[0, 0]
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
