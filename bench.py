@@ -867,7 +867,12 @@ def main() -> None:
         }))
         return
 
-    use_pallas = os.environ.get("KB_BENCH_PALLAS") == "1"
+    # On a real TPU the Mosaic-lowered Pallas kernel is the production scan
+    # path (8.5x the jnp kernel on v5e); default to it there, keep the jnp
+    # kernel as the off-TPU / opt-out (KB_BENCH_PALLAS=0) path.
+    on_tpu = dev.platform in ("tpu", "axon")
+    env_pallas = os.environ.get("KB_BENCH_PALLAS")
+    use_pallas = on_tpu if env_pallas is None else env_pallas == "1"
     if use_pallas:
         from kubebrain_tpu.ops import scan_pallas as sp
 
@@ -879,7 +884,7 @@ def main() -> None:
         p_args = [jax.device_put(jnp.asarray(x), dev) for x in (keys_t, rh31, rl31, tomb8)]
         p_bounds = [jax.device_put(jnp.asarray(x), dev) for x in (s_f, e_f)]
 
-        interp = dev.platform not in ("tpu", "axon")  # pallas needs interpret off-TPU
+        interp = not on_tpu  # pallas needs interpret mode off-TPU
 
         @jax.jit
         def scan_count_pallas_sum(kt, a, b, t, s, e):
@@ -899,9 +904,15 @@ def main() -> None:
             mask = visibility_mask(keys, a, b, t, nv, s, e, jnp.asarray(False), hi, lo)
             return jnp.sum(mask, dtype=jnp.int32)
 
-    d_args = [jax.device_put(x, dev) for x in (chunks, rh, rl, tomb)]
-    s_dev, e_dev = jax.device_put(start, dev), jax.device_put(end, dev)
-    nv = jnp.asarray(np.int32(min(n, 2**31 - 1)))
+    if use_pallas:
+        # the pallas closure ignores these; don't ship a second ~1.3GB
+        # row-major copy of the dataset to HBM alongside the pallas layout
+        d_args = [None] * 4
+        s_dev = e_dev = nv = None
+    else:
+        d_args = [jax.device_put(x, dev) for x in (chunks, rh, rl, tomb)]
+        s_dev, e_dev = jax.device_put(start, dev), jax.device_put(end, dev)
+        nv = jnp.asarray(np.int32(min(n, 2**31 - 1)))
     t0 = time.time()
     out = scan_count(d_args[0], d_args[1], d_args[2], d_args[3], nv, s_dev, e_dev, qhi, qlo)
     out.block_until_ready()
@@ -932,6 +943,7 @@ def main() -> None:
             "scan_p50_ms": round(p50 * 1e3, 2),
             "cpu_numpy_rows_per_sec": round(cpu_rate),
             "device": str(dev),
+            "kernel": "pallas" if use_pallas else "jnp",
         },
     }))
 
