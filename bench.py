@@ -218,21 +218,52 @@ def bench_compact() -> None:
     cpu_rate = n / cpu_dt
 
     dev = jax.devices()[0]
-    d = [jax.device_put(jnp.asarray(x), dev) for x in (chunks, rh, rl, tomb, ttl)]
-    nv = jnp.asarray(np.int32(n))
-    qs = [jnp.asarray(np.uint32(x[0])) for x in (chi, clo, thi, tlo)]
+    on_tpu = dev.platform in ("tpu", "axon")
+    env_pallas = os.environ.get("KB_BENCH_PALLAS")
+    use_pallas = on_tpu if env_pallas is None else env_pallas == "1"
 
     # THE PRODUCTION PATH (TpuScanner.compact, storage/tpu/engine.py): the
-    # victim rule runs as a device kernel, the bool mask (1 byte/row) comes
-    # back, and the survivor gather + store deletes run on host arrays — on
-    # both CPU and TPU the expensive segmented group logic is the kernel's.
-    @jax.jit
-    def mask_step(keys, a, b, t, x, n_valid, c1, c2, t1, t2):
-        return victim_mask(keys, a, b, t, x, n_valid, c1, c2, t1, t2, with_ttl=False)
+    # victim rule runs as a device kernel (pallas on TPU, jnp elsewhere), the
+    # bool mask (1 byte/row) comes back, and the survivor gather + store
+    # deletes run on host arrays.
+    if use_pallas:
+        from kubebrain_tpu.ops import compact_pallas as cpal
+        from kubebrain_tpu.ops import scan_pallas as sp
+
+        revs_u64 = (rh.astype(np.uint64) << np.uint64(32)) | rl.astype(np.uint64)
+        keys_t, rh31, rl31, tomb8, n_real = sp.prepare_blocks(chunks, revs_u64, tomb)
+        ttl8 = np.zeros(keys_t.shape[1], dtype=np.int8)
+        chi31, clo31 = sp.split_revs31(np.array([compact_rev], dtype=np.uint64))
+        lo_bound = sp.pack_bound_flipped(pack_bound(b""))
+        d = [jax.device_put(jnp.asarray(x), dev)
+             for x in (keys_t, rh31, rl31, tomb8, ttl8)]
+        bounds_d = [jax.device_put(jnp.asarray(lo_bound), dev)] * 2
+
+        @jax.jit
+        def mask_step_pallas(kt, a, b, t8, x8, s, e):
+            return cpal.victim_mask_pallas(
+                kt, a, b, t8, x8, np.int32(n_real), s, e, np.int32(1),
+                np.int32(chi31[0]), np.int32(clo31[0]),
+                np.int32(0), np.int32(0),
+                with_ttl=False, interpret=not on_tpu,
+            )
+
+        def compute_mask():
+            return np.asarray(mask_step_pallas(*d, *bounds_d))[:n]
+    else:
+        d = [jax.device_put(jnp.asarray(x), dev) for x in (chunks, rh, rl, tomb, ttl)]
+        nv = jnp.asarray(np.int32(n))
+        qs = [jnp.asarray(np.uint32(x[0])) for x in (chi, clo, thi, tlo)]
+
+        @jax.jit
+        def mask_step(keys, a, b, t, x, n_valid, c1, c2, t1, t2):
+            return victim_mask(keys, a, b, t, x, n_valid, c1, c2, t1, t2, with_ttl=False)
+
+        def compute_mask():
+            return np.asarray(mask_step(*d, nv, *qs))
 
     def compact_production():
-        mk = np.asarray(mask_step(*d, nv, *qs))
-        keep = ~mk
+        keep = ~compute_mask()
         return chunks[keep], rh[keep], rl[keep], tomb[keep]
 
     out = compact_production()
@@ -246,18 +277,28 @@ def bench_compact() -> None:
     rate = n / p50
 
     # all-device variant (mask + on-device gather; the TPU mirror-shrink
-    # shape that avoids pulling 70B keys to the host) for the record
+    # shape that avoids pulling 70B keys to the host) for the record —
+    # row-major device copies + the jnp mask (the gather dominates it; the
+    # kernel choice is the production number above). Reuse the jnp branch's
+    # copies when they exist; only the pallas branch needs fresh ones.
+    if use_pallas:
+        dj = [jax.device_put(jnp.asarray(x), dev) for x in (chunks, rh, rl, tomb, ttl)]
+        nvj = jnp.asarray(np.int32(n))
+        qsj = [jnp.asarray(np.uint32(x[0])) for x in (chi, clo, thi, tlo)]
+    else:
+        dj, nvj, qsj = d, nv, qs
+
     @jax.jit
     def compact_all_device(keys, a, b, t, x, n_valid, c1, c2, t1, t2):
         mask = victim_mask(keys, a, b, t, x, n_valid, c1, c2, t1, t2, with_ttl=False)
         return compact_block(keys, a, b, t, mask)
 
-    out_dev = compact_all_device(*d, nv, *qs)
+    out_dev = compact_all_device(*dj, nvj, *qsj)
     jax.block_until_ready(out_dev)
     lat_dev = []
     for _ in range(max(3, iters // 2)):
         t0 = time.time()
-        jax.block_until_ready(compact_all_device(*d, nv, *qs))
+        jax.block_until_ready(compact_all_device(*dj, nvj, *qsj))
         lat_dev.append(time.time() - t0)
     p50_dev = sorted(lat_dev)[len(lat_dev) // 2]
     assert int(out_dev[4]) == kept == keep_np, (int(out_dev[4]), kept, keep_np)
@@ -276,6 +317,7 @@ def bench_compact() -> None:
             "all_device_rows_per_sec": round(n / p50_dev),
             "cpu_numpy_rows_per_sec": round(cpu_rate),
             "device": str(dev),
+            "kernel": "pallas" if use_pallas else "jnp",
         },
     }))
 

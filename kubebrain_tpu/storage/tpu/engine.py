@@ -109,37 +109,41 @@ def _vis_batch(keys, rh, rl, tomb, nv, start, end, unb, qhi, qlo):
     return mask, jnp.sum(mask, axis=1, dtype=jnp.int32)
 
 
+def _maybe_shard_map(f, mesh, n_part_args: int, n_rep_args: int):
+    """shard_map ``f`` along ``part`` when the mesh is multi-device:
+    pallas_call has no GSPMD partitioning rule, so without this XLA would
+    replicate the whole mirror layout to every device per call. First
+    ``n_part_args`` args shard on axis 0; the rest replicate."""
+    if mesh is None or mesh.devices.size <= 1:
+        return f
+    from jax.sharding import PartitionSpec as PS
+
+    specs = dict(
+        in_specs=(PS("part"),) * n_part_args + (PS(),) * n_rep_args,
+        out_specs=PS("part"),
+    )
+    shard_map = getattr(jax, "shard_map", None)
+    if shard_map is None:  # pre-0.8 jax
+        from jax.experimental.shard_map import shard_map
+
+        specs["check_rep"] = False
+    else:
+        # pallas_call's out_shape carries no vma annotation
+        specs["check_vma"] = False
+    return shard_map(f, mesh=mesh, **specs)
+
+
 @functools.partial(jax.jit, static_argnames=("n", "interpret", "mesh"))
 def _vis_batch_pallas(keys_t, rh31, rl31, tomb8, nv, start, end, unb, qhi, qlo,
                       n, interpret=False, mesh=None):
-    """Pallas visibility masks over the `prepare_mirror`-cached layout.
-
-    ``mesh`` (static): pallas_call has no GSPMD partitioning rule, so on a
-    multi-device mesh the Pallas path is shard_map'd along ``part`` to keep
-    the mirror's sharding — otherwise XLA would replicate the whole
-    [P, C, Npad] key array to every device per scan.
-    """
-    from jax.sharding import PartitionSpec as PS
-
+    """Pallas visibility masks over the `prepare_mirror`-cached layout,
+    shard_map'd along ``part`` on a multi-device ``mesh`` (static)."""
     from ...ops.scan_pallas import visibility_mask_batch_cached
 
-    f = functools.partial(visibility_mask_batch_cached, n=n, interpret=interpret)
-    if mesh is not None and mesh.devices.size > 1:
-        part = PS("part")
-        rep = PS()
-        specs = dict(
-            in_specs=(part, part, part, part, part, rep, rep, rep, rep, rep),
-            out_specs=part,
-        )
-        shard_map = getattr(jax, "shard_map", None)
-        if shard_map is None:  # pre-0.8 jax
-            from jax.experimental.shard_map import shard_map
-
-            specs["check_rep"] = False
-        else:
-            # pallas_call's out_shape carries no vma annotation
-            specs["check_vma"] = False
-        f = shard_map(f, mesh=mesh, **specs)
+    f = _maybe_shard_map(
+        functools.partial(visibility_mask_batch_cached, n=n, interpret=interpret),
+        mesh, n_part_args=5, n_rep_args=5,
+    )
     mask = f(keys_t, rh31, rl31, tomb8, nv, start, end, unb, qhi, qlo)
     return mask, jnp.sum(mask, axis=1, dtype=jnp.int32)
 
@@ -194,6 +198,22 @@ def _victim_batch(keys, rh, rl, tomb, ttl, nv, start, end, unb, chi, clo, thi, t
     return mask & rng
 
 
+@functools.partial(jax.jit, static_argnames=("with_ttl", "interpret", "mesh"))
+def _victim_batch_pallas(keys_t, rh31, rl31, tomb8, ttl8, nv, start, end, unb,
+                         chi, clo, thi, tlo, with_ttl=True, interpret=False,
+                         mesh=None):
+    """Pallas victim masks over the cached chunk-major layout, shard_map'd
+    along ``part`` on a multi-device ``mesh`` (static)."""
+    from ...ops.compact_pallas import victim_mask_batch_cached
+
+    f = _maybe_shard_map(
+        functools.partial(victim_mask_batch_cached, with_ttl=with_ttl,
+                          interpret=interpret),
+        mesh, n_part_args=6, n_rep_args=7,
+    )
+    return f(keys_t, rh31, rl31, tomb8, ttl8, nv, start, end, unb, chi, clo, thi, tlo)
+
+
 class TpuScanner(Scanner):
     """Scanner contract over the device mirror; host fallback for small
     limit queries (one engine iter beats a kernel launch for a 500-row page).
@@ -222,6 +242,7 @@ class TpuScanner(Scanner):
         # it (shard_map); None keeps the jnp path's jit cache key mesh-free
         self._kernel_mesh = self._mesh if self._scan_kernel != "jnp" else None
         self._pallas_cache: tuple[Mirror, tuple] | None = None
+        self._pallas_ttl_cache: tuple[Mirror, object] | None = None
         self._mlock = threading.RLock()
         self._mirror: Mirror | None = None
         self._delta = _DeltaIndex()
@@ -284,6 +305,7 @@ class TpuScanner(Scanner):
         self._delta = _DeltaIndex()
         self._force_rebuild = False
         self._pallas_cache = None  # old mirror's device copies must not pin
+        self._pallas_ttl_cache = None
 
     def _merge_delta(self) -> None:
         """Dirty-partition-only merge: sort the delta alone, two-way merge it
@@ -303,6 +325,7 @@ class TpuScanner(Scanner):
         self._mirror = m
         self._delta = _DeltaIndex()
         self._pallas_cache = None  # re-layout lazily on the next pallas query
+        self._pallas_ttl_cache = None
 
     def publish(self) -> None:
         """Force the mirror fully up to date (bench/startup hook)."""
@@ -343,6 +366,21 @@ class TpuScanner(Scanner):
         )
         self._pallas_cache = (mirror, out)
         return out
+
+    def _pallas_ttl8(self, mirror: Mirror, npad: int):
+        """TTL flag column in the pallas layout, built lazily on first
+        compact() use (scan-only workloads never pay the ttl_dev round trip);
+        identity-cached per mirror like `_pallas_layout`."""
+        cached = self._pallas_ttl_cache
+        if cached is not None and cached[0] is mirror:
+            return cached[1]
+        ttl_h = np.asarray(jax.device_get(mirror.ttl_dev)).astype(np.int8)
+        pad = npad - ttl_h.shape[1]
+        if pad:
+            ttl_h = np.pad(ttl_h, ((0, 0), (0, pad)))
+        ttl8 = self._shard_put(ttl_h)
+        self._pallas_ttl_cache = (mirror, ttl8)
+        return ttl8
 
     def _dev_mask(self, mirror: Mirror, start: bytes, end: bytes, read_rev: int):
         """Visibility (mask [P, N] device array, counts [P]) through the
@@ -541,15 +579,29 @@ class TpuScanner(Scanner):
         s, e, unb = self._query_bounds(s_user, e_user)
         chi, clo = keyops.split_revs(np.array([compact_revision], dtype=np.uint64))
         thi, tlo = keyops.split_revs(np.array([ttl_cutoff], dtype=np.uint64))
-        mask = np.asarray(
-            _victim_batch(
-                mirror.keys_dev, mirror.rh_dev, mirror.rl_dev, mirror.tomb_dev,
-                mirror.ttl_dev, mirror.n_valid_dev, s, e, unb,
-                jnp.asarray(chi[0]), jnp.asarray(clo[0]),
-                jnp.asarray(thi[0]), jnp.asarray(tlo[0]),
-                with_ttl=ttl_cutoff > 0,
+        if self._scan_kernel == "jnp":
+            mask = np.asarray(
+                _victim_batch(
+                    mirror.keys_dev, mirror.rh_dev, mirror.rl_dev, mirror.tomb_dev,
+                    mirror.ttl_dev, mirror.n_valid_dev, s, e, unb,
+                    jnp.asarray(chi[0]), jnp.asarray(clo[0]),
+                    jnp.asarray(thi[0]), jnp.asarray(tlo[0]),
+                    with_ttl=ttl_cutoff > 0,
+                )
             )
-        )
+        else:
+            kt, rh31, rl31, t8, _n = self._pallas_layout(mirror)
+            ttl8 = self._pallas_ttl8(mirror, kt.shape[2])
+            mask = np.asarray(
+                _victim_batch_pallas(
+                    kt, rh31, rl31, t8, ttl8, mirror.n_valid_dev, s, e, unb,
+                    jnp.asarray(chi[0]), jnp.asarray(clo[0]),
+                    jnp.asarray(thi[0]), jnp.asarray(tlo[0]),
+                    with_ttl=ttl_cutoff > 0,
+                    interpret=(self._scan_kernel == "pallas_interpret"),
+                    mesh=self._kernel_mesh,
+                )
+            )  # padded cols are never victims (valid=False); mask[p][:nv] below
 
         stats = CompactStats(scanned=mirror.rows)
         retry_min = self._retry_min_revision()
@@ -685,6 +737,7 @@ class TpuScanner(Scanner):
                 )
                 self._delta = _DeltaIndex()
                 self._pallas_cache = None
+                self._pallas_ttl_cache = None
         return stats
 
 
