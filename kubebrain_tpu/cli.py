@@ -256,6 +256,24 @@ def main(argv=None) -> int:
         print(f"kubebrain-tpu {__version__} (storage engines: memkv, tpu, native)")
         return 0
 
+    # server-profile gc: the default thresholds collect every ~700
+    # allocations — at informer fan-out scale (10k watch streams, 100k+
+    # protobuf deliveries) collection pauses halved write throughput in the
+    # config-5 sim. Protobufs/events are acyclic; raise the thresholds.
+    # KB_GC_THRESHOLD=a[,b[,c]] overrides; 0 keeps Python defaults.
+    gc_env = os.environ.get("KB_GC_THRESHOLD", "")
+    if gc_env != "0":
+        import gc
+
+        try:
+            parts = [int(x) for x in gc_env.split(",") if x.strip()]
+        except ValueError:
+            print(f"ignoring malformed KB_GC_THRESHOLD={gc_env!r}", file=sys.stderr)
+            parts = []
+        if not parts or parts[0] <= 0:  # gc.set_threshold(0,..) would disable gc
+            parts = [200_000, 1000, 1000]
+        gc.set_threshold(*parts[:3])
+
     endpoint, backend, store = build_endpoint(args)
     stop = threading.Event()
     watchdog: list[threading.Timer] = []

@@ -89,6 +89,18 @@ class FrontServer:
         self._thread: threading.Thread | None = None
         self._proc: subprocess.Popen | None = None
         self._writer: asyncio.StreamWriter | None = None
+        # corked backhaul writes: every response frame lands here and ONE
+        # flusher task does one write()+drain() per burst — per-message
+        # write/drain was the loop thread's top cost in the 10k-watcher sim
+        self._cork: list[bytes] = []
+        self._cork_bytes = 0
+        self._cork_event: asyncio.Event | None = None
+        # producer gate: cleared while the cork backlog is over the high-water
+        # mark so stream producers pause (keeps the hub's slow-consumer drop
+        # reachable); unary replies are bounded by kbfront's in-flight request
+        # window and bypass the gate
+        self._gate: asyncio.Event | None = None
+        self._flusher: asyncio.Task | None = None
         self._streams: dict[tuple[int, int], _Stream] = {}
         # unary fast path: (cid, sid) -> [(req_cls, fn), raw_request_bytes]
         self._unary_pending: dict[tuple[int, int], list] = {}
@@ -221,11 +233,43 @@ class FrontServer:
             pass
 
     # --------------------------------------------------------------- framing
+    _CORK_HIGH_WATER = 4 << 20
+
     def _send(self, cid: int, sid: int, kind: int, payload: bytes = b"") -> None:
         w = self._writer
         if w is None or w.is_closing():
             return
-        w.write(_HDR.pack(len(payload), cid, sid, kind) + payload)
+        frame = _HDR.pack(len(payload), cid, sid, kind) + payload
+        self._cork.append(frame)
+        self._cork_bytes += len(frame)
+        if self._cork_bytes > self._CORK_HIGH_WATER and self._gate is not None:
+            self._gate.clear()
+        if self._cork_event is not None:
+            self._cork_event.set()
+
+    async def _send_gated(self, cid: int, sid: int, kind: int,
+                          payload: bytes = b"") -> None:
+        """_send for stream producers: waits out a backlogged backhaul first
+        (the pump stalls, its hub queue fills, the hub drops it if slow)."""
+        if self._gate is not None and not self._gate.is_set():
+            await self._gate.wait()
+        self._send(cid, sid, kind, payload)
+
+    async def _flush_loop(self, writer: asyncio.StreamWriter) -> None:
+        ev = self._cork_event
+        try:
+            while True:
+                await ev.wait()
+                ev.clear()
+                if self._cork:
+                    bufs, self._cork = self._cork, []
+                    self._cork_bytes = 0
+                    writer.write(b"".join(bufs))
+                    await writer.drain()  # sole backpressure point
+                    if self._gate is not None and self._cork_bytes <= self._CORK_HIGH_WATER:
+                        self._gate.set()
+        except (ConnectionResetError, BrokenPipeError):
+            pass
 
     def _send_end(self, cid: int, sid: int, status: int = 0, msg: str = "") -> None:
         raw = msg.encode()[:65535]
@@ -234,6 +278,11 @@ class FrontServer:
     async def _on_backhaul(self, reader: asyncio.StreamReader,
                            writer: asyncio.StreamWriter) -> None:
         self._writer = writer
+        self._cork_event = asyncio.Event()
+        self._gate = asyncio.Event()
+        self._gate.set()
+        self._flusher = asyncio.get_running_loop().create_task(
+            self._flush_loop(writer))
         logger.info("kbfront connected on %s", self.socket_path)
         buf = b""
         try:
@@ -252,11 +301,16 @@ class FrontServer:
                     off += 13 + plen
                     self._handle(cid, sid, kind, payload)
                 buf = buf[off:]
-                if self._writer is not None:
-                    await self._writer.drain()
         except (ConnectionResetError, BrokenPipeError):
             pass
         finally:
+            if self._flusher is not None:
+                self._flusher.cancel()
+                self._flusher = None
+            self._cork.clear()
+            self._cork_bytes = 0
+            if self._gate is not None:
+                self._gate.set()  # unblock producers so their tasks can exit
             for key, st in list(self._streams.items()):
                 if st.task is not None:
                     st.task.cancel()
@@ -334,11 +388,13 @@ class FrontServer:
             out = bytes(resp) if isinstance(resp, bytes) else resp.SerializeToString()
             w = self._writer
             if w is not None and not w.is_closing():
-                # MSG + END in one write() call
-                w.write(
+                # MSG + END corked as one frame pair
+                self._cork.append(
                     _HDR.pack(len(out), cid, sid, K_MSG) + out
                     + _HDR.pack(6, cid, sid, K_END) + _END_OK
                 )
+                if self._cork_event is not None:
+                    self._cork_event.set()
         except _AbortError as e:
             self._send_end(cid, sid, _status_num(e.code), e.details)
         except Exception as exc:
@@ -383,9 +439,7 @@ class FrontServer:
                         resp = await loop.run_in_executor(None, next, it, None)
                         if resp is None:
                             break
-                        self._send(cid, sid, K_MSG, resp.SerializeToString())
-                        if self._writer is not None:
-                            await self._writer.drain()
+                        await self._send_gated(cid, sid, K_MSG, resp.SerializeToString())
                 except _AbortError as e:
                     self._send_end(cid, sid, _status_num(e.code), e.details)
                     return
@@ -423,9 +477,7 @@ class FrontServer:
         ctx = _FrontStreamContext()
         try:
             async for resp in self.watch.Watch(req_iter(), ctx):
-                self._send(cid, sid, K_MSG, resp.SerializeToString())
-                if self._writer is not None:
-                    await self._writer.drain()
+                await self._send_gated(cid, sid, K_MSG, resp.SerializeToString())
         except _AbortError as e:
             self._send_end(cid, sid, _status_num(e.code), e.details)
             return

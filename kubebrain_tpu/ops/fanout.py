@@ -91,9 +91,23 @@ class FanoutMatcher:
         spec = PartitionSpec(axis, *(None,) * (a.ndim - 1))
         return jax.device_put(a, NamedSharding(self._mesh, spec))
 
-    def _watcher_table(self, specs: list[tuple[int, bytes, bytes, int]]):
-        cache_key = tuple(specs)
+    @staticmethod
+    def _bucket(n: int, lo: int) -> int:
+        b = lo
+        while b < n:
+            b *= 2
+        return b
+
+    def _watcher_table(self, specs: list[tuple[int, bytes, bytes, int]],
+                       version=None):
+        """Packed watcher table, W-padded to a power-of-2 bucket so watcher
+        churn doesn't change the kernel shape (each distinct shape is an XLA
+        compile). ``version`` (the hub's watcher-set counter) makes the cache
+        check O(1); without it the fallback key is the O(W) spec tuple."""
+        cache_key = version if version is not None else tuple(specs)
         if cache_key != self._cache_key:
+            w = len(specs)
+            wpad = self._bucket(max(w, 1), 64)
             # canonicalize NUL-bearing bounds (single-key watches use
             # end = key + b"\0", which zero-pads equal to the key)
             starts, _ = keyops.pack_keys(
@@ -104,6 +118,19 @@ class FanoutMatcher:
             )
             unbounded = np.array([not e for _, _, e, _ in specs])
             hi, lo = keyops.split_revs(np.array([r for _, _, _, r in specs], dtype=np.uint64))
+            if wpad > w:
+                # padding watchers can never match: start = max key, bounded
+                # end = 0 (empty range)
+                pad = wpad - w
+                starts = np.concatenate(
+                    [starts, np.full((pad, starts.shape[1]), 0xFFFFFFFF, starts.dtype)]
+                )
+                ends = np.concatenate(
+                    [ends, np.zeros((pad, ends.shape[1]), ends.dtype)]
+                )
+                unbounded = np.concatenate([unbounded, np.zeros(pad, bool)])
+                hi = np.concatenate([hi, np.zeros(pad, hi.dtype)])
+                lo = np.concatenate([lo, np.zeros(pad, lo.dtype)])
             self._cached = (
                 self._put_watcher(starts), self._put_watcher(ends),
                 self._put_watcher(unbounded),
@@ -112,11 +139,20 @@ class FanoutMatcher:
             self._cache_key = cache_key
         return self._cached
 
-    def __call__(self, events, watcher_specs):
-        ws, we, wu, whi, wlo = self._watcher_table(watcher_specs)
-        ek, _ = keyops.pack_keys([e.key for e in events], self._width)
-        ehi, elo = keyops.split_revs(np.array([e.revision for e in events], dtype=np.uint64))
+    def __call__(self, events, watcher_specs, version=None):
+        ws, we, wu, whi, wlo = self._watcher_table(watcher_specs, version)
+        e = len(events)
+        # E-pad to a bucket: event batches arrive in every size from 1 to the
+        # ring's drain depth; without bucketing each size is its own compile
+        epad = self._bucket(max(e, 1), 8)
+        keys = [ev.key for ev in events]
+        revs = [ev.revision for ev in events]
+        if epad > e:
+            keys += [b""] * (epad - e)
+            revs += [0] * (epad - e)
+        ek, _ = keyops.pack_keys(keys, self._width)
+        ehi, elo = keyops.split_revs(np.array(revs, dtype=np.uint64))
         mask = fanout_mask_range(
             jnp.asarray(ek), jnp.asarray(ehi), jnp.asarray(elo), ws, we, wu, whi, wlo
         )
-        return np.asarray(mask)
+        return np.asarray(mask)[:e, :len(watcher_specs)]

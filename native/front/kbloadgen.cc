@@ -10,8 +10,16 @@
 // exact transaction kube-apiserver emits, reference etcd/kv.go:160).
 //
 // usage: kbloadgen <host> <port> <total_ops> [conns] [inflight] [value_bytes]
-//        [key_prefix]
+//        [key_prefix] [--tls] [--watchers N] [--ns M]
 // Prints one JSON line: {"ops":N,"seconds":S,"rate":R,"p50_us":..,"p99_us":..}
+//
+// --watchers N turns on the kube-apiserver informer simulation (BASELINE
+// config 5): N long-lived etcd Watch streams are opened first (namespace
+// prefixes, round-robin over connections — the 50k-node cluster's informer
+// population), then the insert load runs against the watched namespaces
+// with a monotonic send-timestamp embedded in each value; every delivered
+// watch event's latency is measured watcher-side. The reference measures
+// this as "insert event latency" (docs/data/benchmark_insert.csv).
 
 #include <arpa/inet.h>
 #include <netinet/in.h>
@@ -87,6 +95,63 @@ std::string encode_txn_create(const std::string &key, const std::string &val) {
   return txn;
 }
 
+// WatchRequest{create_request{key, range_end}} for one namespace prefix
+std::string encode_watch_create(const std::string &key,
+                                const std::string &range_end) {
+  std::string cr;
+  pb_bytes(cr, 1, key);
+  pb_bytes(cr, 2, range_end);
+  std::string req;
+  pb_bytes(req, 1, cr);  // WatchRequest.create_request
+  return req;
+}
+
+// ------------------------------------------------- minimal protobuf cursor
+struct PbCursor {
+  const uint8_t *p;
+  size_t n;
+  size_t off = 0;
+  bool ok = true;
+
+  uint64_t varint() {
+    uint64_t v = 0;
+    int shift = 0;
+    while (off < n) {
+      uint8_t b = p[off++];
+      v |= static_cast<uint64_t>(b & 0x7f) << shift;
+      if (!(b & 0x80)) return v;
+      shift += 7;
+    }
+    ok = false;
+    return 0;
+  }
+  // next field; returns false at end. wire-2 payload in (sub, sublen).
+  bool next(int *field, int *wire, const uint8_t **sub, size_t *sublen,
+            uint64_t *ival) {
+    if (off >= n || !ok) return false;
+    uint64_t tag = varint();
+    *field = static_cast<int>(tag >> 3);
+    *wire = static_cast<int>(tag & 7);
+    if (*wire == 0) {
+      *ival = varint();
+    } else if (*wire == 2) {
+      uint64_t len = varint();
+      if (off + len > n) { ok = false; return false; }
+      *sub = p + off;
+      *sublen = len;
+      off += len;
+    } else if (*wire == 5) {
+      off += 4;
+    } else if (*wire == 1) {
+      off += 8;
+    } else {
+      ok = false;
+      return false;
+    }
+    return ok;
+  }
+};
+
 // TxnResponse top-level scan for field 2 (succeeded, varint)
 bool parse_txn_succeeded(const uint8_t *p, size_t n) {
   size_t off = 0;
@@ -134,6 +199,8 @@ struct LoadStream {
   size_t off = 0;
   uint64_t start_us = 0;
   std::string resp;
+  bool is_watch = false;  // long-lived: body kept open, resp parsed as frames
+  size_t parsed = 0;      // bytes of resp already consumed as gRPC frames
 };
 
 struct LoadConn {
@@ -160,9 +227,55 @@ struct Gen {
   std::string prefix = "/registry/pods/load";
   std::vector<uint64_t> lat_us;
   std::string value;
+  // informer-sim watch mode
+  int n_watchers = 0;
+  int n_ns = 500;
+  long watch_created = 0;
+  long watch_closed = 0;
+  long deliveries = 0;
+  std::vector<uint64_t> ev_lat_us;
 };
 
 Gen g;
+
+// WatchResponse: created(3) counts the stream up; events(11) -> Event.kv(2)
+// -> KeyValue.value(5) whose first 16 bytes are the writer's hex-coded
+// monotonic send time (hex survives any utf-8/bytes handling unchanged).
+void handle_watch_msg(const uint8_t *p, size_t n) {
+  PbCursor top{p, n};
+  int f, w;
+  const uint8_t *sub = nullptr;
+  size_t sublen = 0;
+  uint64_t iv = 0;
+  while (top.next(&f, &w, &sub, &sublen, &iv)) {
+    if (f == 3 && w == 0 && iv) g.watch_created++;
+    if (f == 11 && w == 2) {  // one Event
+      PbCursor ev{sub, sublen};
+      int f2, w2;
+      const uint8_t *kv = nullptr;
+      size_t kvlen = 0;
+      uint64_t iv2 = 0;
+      while (ev.next(&f2, &w2, &kv, &kvlen, &iv2)) {
+        if (f2 != 2 || w2 != 2) continue;  // Event.kv
+        PbCursor kvc{kv, kvlen};
+        int f3, w3;
+        const uint8_t *val = nullptr;
+        size_t vallen = 0;
+        uint64_t iv3 = 0;
+        while (kvc.next(&f3, &w3, &val, &vallen, &iv3)) {
+          if (f3 == 5 && w3 == 2 && vallen >= 16) {  // KeyValue.value
+            uint64_t sent = strtoull(
+                std::string(reinterpret_cast<const char *>(val), 16).c_str(),
+                nullptr, 16);
+            uint64_t now = now_us();
+            if (sent != 0 && now >= sent) g.ev_lat_us.push_back(now - sent);
+          }
+        }
+        g.deliveries++;
+      }
+    }
+  }
+}
 
 }  // namespace
 
@@ -177,10 +290,13 @@ static ssize_t body_read_lookup_cb(nghttp2_session *session, int32_t sid,
   if (it == c->streams.end()) return NGHTTP2_ERR_TEMPORAL_CALLBACK_FAILURE;
   LoadStream &st = it->second;
   size_t left = st.body.size() - st.off;
+  if (left == 0 && st.is_watch)
+    return NGHTTP2_ERR_DEFERRED;  // keep the request side open (bidi watch)
   size_t n = left < length ? left : length;
   memcpy(buf, st.body.data() + st.off, n);
   st.off += n;
-  if (st.off == st.body.size()) *data_flags |= NGHTTP2_DATA_FLAG_EOF;
+  if (st.off == st.body.size() && !st.is_watch)
+    *data_flags |= NGHTTP2_DATA_FLAG_EOF;
   (void)session;
   return static_cast<ssize_t>(n);
 }
@@ -201,7 +317,19 @@ void submit_one_v2(LoadConn *c) {
   if (g.started >= g.total_ops) return;
   long seq = g.started++;
   char keybuf[160];
-  snprintf(keybuf, sizeof keybuf, "%s-%012ld", g.prefix.c_str(), seq);
+  if (g.n_watchers > 0) {
+    // informer sim: land in a watched namespace, stamp the send time into
+    // the value head (16 hex chars) for watcher-side latency. The pid tag
+    // keeps repeat runs against one server from colliding on create.
+    snprintf(keybuf, sizeof keybuf, "/registry/pods/ns-%05d/pod-%d-%012ld",
+             static_cast<int>(seq % g.n_ns), getpid(), seq);
+    char ts[17];
+    snprintf(ts, sizeof ts, "%016llx",
+             static_cast<unsigned long long>(now_us()));
+    g.value.replace(0, 16, ts, 16);
+  } else {
+    snprintf(keybuf, sizeof keybuf, "%s-%012ld", g.prefix.c_str(), seq);
+  }
   std::string msg = encode_txn_create(keybuf, g.value);
   std::string framed;
   framed.push_back('\0');
@@ -234,12 +362,65 @@ void submit_one_v2(LoadConn *c) {
   c->inflight++;
 }
 
+void submit_watch(LoadConn *c, int widx) {
+  char key[64];
+  snprintf(key, sizeof key, "/registry/pods/ns-%05d/",
+           widx % g.n_ns);
+  std::string end(key);
+  end.back() = '0';  // '/' + 1: the namespace prefix range end
+  std::string msg = encode_watch_create(key, end);
+  std::string framed;
+  framed.push_back('\0');
+  uint8_t l4[4] = {static_cast<uint8_t>(msg.size() >> 24),
+                   static_cast<uint8_t>(msg.size() >> 16),
+                   static_cast<uint8_t>(msg.size() >> 8),
+                   static_cast<uint8_t>(msg.size())};
+  framed.append(reinterpret_cast<char *>(l4), 4);
+  framed.append(msg);
+
+  static char authority[64];
+  snprintf(authority, sizeof authority, "%s:%d", g.host.c_str(), g.port);
+  nghttp2_nv hdrs[] = {
+      mknv(":method", "POST"),       mknv(":scheme", "http"),
+      mknv(":authority", authority), mknv(":path", "/etcdserverpb.Watch/Watch"),
+      mknv("content-type", "application/grpc"), mknv("te", "trailers"),
+  };
+  nghttp2_data_provider prd;
+  prd.source.ptr = nullptr;
+  prd.read_callback = body_read_lookup_cb;
+  int32_t sid = nghttp2_submit_request(c->session, nullptr, hdrs, 6, &prd, nullptr);
+  if (sid < 0) {
+    fprintf(stderr, "submit_watch: %s\n", nghttp2_strerror(sid));
+    exit(1);
+  }
+  LoadStream &st = c->streams[sid];
+  st.body = std::move(framed);
+  st.is_watch = true;
+}
+
 int on_data_chunk(nghttp2_session *, uint8_t, int32_t sid, const uint8_t *data,
                   size_t len, void *user_data) {
   LoadConn *c = static_cast<LoadConn *>(user_data);
   auto it = c->streams.find(sid);
-  if (it != c->streams.end())
-    it->second.resp.append(reinterpret_cast<const char *>(data), len);
+  if (it == c->streams.end()) return 0;
+  LoadStream &st = it->second;
+  st.resp.append(reinterpret_cast<const char *>(data), len);
+  if (!st.is_watch) return 0;
+  // long-lived stream: consume complete gRPC frames as they arrive
+  while (st.resp.size() - st.parsed >= 5) {
+    const uint8_t *d =
+        reinterpret_cast<const uint8_t *>(st.resp.data()) + st.parsed;
+    uint32_t mlen = (static_cast<uint32_t>(d[1]) << 24) |
+                    (static_cast<uint32_t>(d[2]) << 16) |
+                    (static_cast<uint32_t>(d[3]) << 8) | d[4];
+    if (st.resp.size() - st.parsed - 5 < mlen) break;
+    handle_watch_msg(d + 5, mlen);
+    st.parsed += 5 + static_cast<size_t>(mlen);
+  }
+  if (st.parsed > (1u << 16)) {
+    st.resp.erase(0, st.parsed);
+    st.parsed = 0;
+  }
   return 0;
 }
 
@@ -249,6 +430,11 @@ int on_stream_close(nghttp2_session *, int32_t sid, uint32_t error_code,
   auto it = c->streams.find(sid);
   if (it == c->streams.end()) return 0;
   LoadStream &st = it->second;
+  if (st.is_watch) {
+    g.watch_closed++;  // server ended a watch stream (unexpected mid-run)
+    c->streams.erase(it);
+    return 0;
+  }
   bool ok = false;
   if (error_code == 0 && st.resp.size() > 5) {
     ok = parse_txn_succeeded(
@@ -295,7 +481,7 @@ int main(int argc, char **argv) {
   if (argc < 4) {
     fprintf(stderr,
             "usage: kbloadgen <host> <port> <total_ops> [conns] [inflight] "
-            "[value_bytes] [key_prefix]\n");
+            "[value_bytes] [key_prefix] [--tls] [--watchers N] [--ns M]\n");
     return 1;
   }
   g.host = argv[1];
@@ -307,7 +493,20 @@ int main(int argc, char **argv) {
   bool use_tls = false;
   for (int i = 7; i < argc; i++) {
     if (strcmp(argv[i], "--tls") == 0) use_tls = true;
+    else if (strcmp(argv[i], "--watchers") == 0 && i + 1 < argc)
+      g.n_watchers = atoi(argv[++i]);
+    else if (strcmp(argv[i], "--ns") == 0 && i + 1 < argc)
+      g.n_ns = atoi(argv[++i]);
     else g.prefix = argv[i];
+  }
+  if (g.n_watchers > 0 && g.value_bytes < 16) g.value_bytes = 16;
+  // kbfront advertises SETTINGS_MAX_CONCURRENT_STREAMS=4096 and watch
+  // streams never close, so the excess would queue forever in nghttp2
+  if (g.n_watchers > 0 && static_cast<long>(g.n_watchers) > 4096L * nconns) {
+    fprintf(stderr,
+            "--watchers %d exceeds %d conns x 4096 streams; raise [conns]\n",
+            g.n_watchers, nconns);
+    return 1;
   }
   SSL_CTX *tls_ctx = nullptr;
   if (use_tls) {
@@ -366,20 +565,16 @@ int main(int argc, char **argv) {
     epoll_ctl(epfd, EPOLL_CTL_ADD, fd, &ev);
   }
 
-  uint64_t t0 = now_us();
-  for (LoadConn *c : conns) {
-    for (int j = 0; j < inflight && g.started < g.total_ops; j++) submit_one_v2(c);
-    conn_flush(c);
-  }
-
   char buf[1 << 16];
   epoll_event events[64];
-  while (g.completed < g.total_ops) {
-    int n = epoll_wait(epfd, events, 64, 1000);
+  // one epoll round: read + feed nghttp2 (TLS-aware); returns false on a
+  // fatal transport error. top_up_inserts keeps the txn pipeline full.
+  auto pump = [&](int timeout_ms, bool top_up_inserts) -> bool {
+    int n = epoll_wait(epfd, events, 64, timeout_ms);
     if (n < 0) {
-      if (errno == EINTR) continue;
+      if (errno == EINTR) return true;
       perror("epoll_wait");
-      return 1;
+      return false;
     }
     for (int i = 0; i < n; i++) {
       LoadConn *c = conns[events[i].data.u32];
@@ -391,7 +586,7 @@ int main(int argc, char **argv) {
               static_cast<size_t>(r));
           if (rv < 0) {
             fprintf(stderr, "mem_recv: %s\n", nghttp2_strerror((int)rv));
-            return 1;
+            return false;
           }
           continue;
         }
@@ -402,7 +597,7 @@ int main(int argc, char **argv) {
             int err = SSL_get_error(c->ssl, hrv);
             if (err != SSL_ERROR_WANT_READ && err != SSL_ERROR_WANT_WRITE) {
               fprintf(stderr, "TLS handshake failed (%d)\n", err);
-              return 1;
+              return false;
             }
           }
         }
@@ -415,31 +610,80 @@ int main(int argc, char **argv) {
                 static_cast<size_t>(pr));
             if (rv < 0) {
               fprintf(stderr, "mem_recv: %s\n", nghttp2_strerror((int)rv));
-              return 1;
+              return false;
             }
           }
           int err = SSL_get_error(c->ssl, pr);
           if (err != SSL_ERROR_WANT_READ && err != SSL_ERROR_WANT_WRITE) {
             fprintf(stderr, "TLS read failed (%d)\n", err);
-            return 1;
+            return false;
           }
         }
         conn_flush(c);
       }
       if (r == 0) {
         fprintf(stderr, "server closed connection\n");
-        return 1;
+        return false;
       }
       if (r < 0 && errno != EAGAIN && errno != EWOULDBLOCK) {
         perror("read");
-        return 1;
+        return false;
       }
-      // top up the pipeline
-      while (c->inflight < inflight && g.started < g.total_ops) submit_one_v2(c);
+      if (top_up_inserts)
+        while (c->inflight < inflight && g.started < g.total_ops)
+          submit_one_v2(c);
       conn_flush(c);
     }
+    return true;
+  };
+
+  // phase 1: establish the informer population before any write lands
+  if (g.n_watchers > 0) {
+    for (int wi = 0; wi < g.n_watchers; wi++)
+      submit_watch(conns[static_cast<size_t>(wi) % conns.size()], wi);
+    for (LoadConn *c : conns) conn_flush(c);
+    uint64_t deadline = now_us() + 180u * 1000000u;
+    while (g.watch_created < g.n_watchers) {
+      if (!pump(1000, false)) return 1;
+      if (now_us() > deadline) {
+        fprintf(stderr, "watch establishment timeout: %ld/%d created\n",
+                g.watch_created, g.n_watchers);
+        return 1;
+      }
+    }
   }
+
+  // phase 2: the insert load
+  uint64_t t0 = now_us();
+  for (LoadConn *c : conns) {
+    for (int j = 0; j < inflight && g.started < g.total_ops; j++) submit_one_v2(c);
+    conn_flush(c);
+  }
+  while (g.completed < g.total_ops)
+    if (!pump(1000, true)) return 1;
   uint64_t dt = now_us() - t0;
+
+  // phase 3: drain in-flight watch deliveries (exact expected count)
+  long expected = 0;
+  if (g.n_watchers > 0) {
+    for (int k = 0; k < g.n_ns; k++) {
+      long ops_k = g.total_ops / g.n_ns + (k < g.total_ops % g.n_ns ? 1 : 0);
+      long w_k = g.n_watchers / g.n_ns + (k < g.n_watchers % g.n_ns ? 1 : 0);
+      expected += ops_k * w_k;
+    }
+    uint64_t cap = now_us() + 120u * 1000000u;
+    long last = -1;
+    uint64_t last_progress = now_us();
+    while (g.deliveries < expected && now_us() < cap) {
+      if (!pump(500, false)) return 1;
+      if (g.deliveries != last) {
+        last = g.deliveries;
+        last_progress = now_us();
+      } else if (now_us() - last_progress > 15u * 1000000u) {
+        break;  // idle 15s: report what arrived
+      }
+    }
+  }
 
   std::sort(g.lat_us.begin(), g.lat_us.end());
   auto pct = [&](double p) -> uint64_t {
@@ -447,17 +691,41 @@ int main(int argc, char **argv) {
     size_t idx = static_cast<size_t>(p * (g.lat_us.size() - 1));
     return g.lat_us[idx];
   };
-  printf(
-      "{\"ops\": %ld, \"failed\": %ld, \"seconds\": %.3f, \"rate\": %.0f, "
-      "\"avg_us\": %.0f, \"p50_us\": %lu, \"p99_us\": %lu}\n",
-      g.completed, g.failed, dt / 1e6, g.completed / (dt / 1e6),
-      g.lat_us.empty() ? 0.0
-                       : [&] {
-                           double s = 0;
-                           for (uint64_t v : g.lat_us) s += static_cast<double>(v);
-                           return s / static_cast<double>(g.lat_us.size());
-                         }(),
-      pct(0.5), pct(0.99));
+  double avg_us =
+      g.lat_us.empty() ? 0.0 : [&] {
+        double s = 0;
+        for (uint64_t v : g.lat_us) s += static_cast<double>(v);
+        return s / static_cast<double>(g.lat_us.size());
+      }();
+  if (g.n_watchers > 0) {
+    std::sort(g.ev_lat_us.begin(), g.ev_lat_us.end());
+    auto epct = [&](double p) -> uint64_t {
+      if (g.ev_lat_us.empty()) return 0;
+      size_t idx = static_cast<size_t>(p * (g.ev_lat_us.size() - 1));
+      return g.ev_lat_us[idx];
+    };
+    double ev_avg =
+        g.ev_lat_us.empty() ? 0.0 : [&] {
+          double s = 0;
+          for (uint64_t v : g.ev_lat_us) s += static_cast<double>(v);
+          return s / static_cast<double>(g.ev_lat_us.size());
+        }();
+    printf(
+        "{\"ops\": %ld, \"failed\": %ld, \"seconds\": %.3f, \"rate\": %.0f, "
+        "\"avg_us\": %.0f, \"p50_us\": %lu, \"p99_us\": %lu, "
+        "\"watchers\": %d, \"namespaces\": %d, \"deliveries\": %ld, "
+        "\"expected_deliveries\": %ld, \"watch_closed\": %ld, "
+        "\"ev_avg_ms\": %.2f, \"ev_p50_ms\": %.2f, \"ev_p99_ms\": %.2f}\n",
+        g.completed, g.failed, dt / 1e6, g.completed / (dt / 1e6), avg_us,
+        pct(0.5), pct(0.99), g.n_watchers, g.n_ns, g.deliveries, expected,
+        g.watch_closed, ev_avg / 1e3, epct(0.5) / 1e3, epct(0.99) / 1e3);
+  } else {
+    printf(
+        "{\"ops\": %ld, \"failed\": %ld, \"seconds\": %.3f, \"rate\": %.0f, "
+        "\"avg_us\": %.0f, \"p50_us\": %lu, \"p99_us\": %lu}\n",
+        g.completed, g.failed, dt / 1e6, g.completed / (dt / 1e6), avg_us,
+        pct(0.5), pct(0.99));
+  }
   for (LoadConn *c : conns) {
     nghttp2_session_del(c->session);
     close(c->fd);
