@@ -890,6 +890,125 @@ def _bench_sim_wire() -> None:
     }))
 
 
+def bench_watcurve() -> None:
+    """Scan QPS vs the ``wat`` (read-replica) mesh axis — SURVEY P6.
+
+    Blocks are sharded over ``part`` and REPLICATED over ``wat``; a batch of
+    Q concurrent scan queries is sharded over ``wat`` so each replica group
+    serves its own query subset. Reports the QPS curve for wat in {1,2,4,8}
+    on the available mesh (8 virtual CPU devices in CI — the curve's SHAPE
+    is the deliverable there; real chips give it real slope).
+    Reference analogue: follower read replicas (README.md:21-24)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from kubebrain_tpu.ops import keys as keyops
+    from kubebrain_tpu.ops.scan import visibility_mask
+    from kubebrain_tpu.parallel.mesh import make_mesh
+
+    n_keys = int(os.environ.get("KB_BENCH_KEYS", 50_000))
+    revs = int(os.environ.get("KB_BENCH_REVS", 20))
+    iters = int(os.environ.get("KB_BENCH_ITERS", 7))
+    n_q = int(os.environ.get("KB_BENCH_QUERIES", 8))
+    n_dev = len(jax.devices())
+
+    chunks, rh, rl, tomb = build_dataset(n_keys, revs)
+    n = len(chunks)
+    # distinct per-query bounds: staggered sub-ranges of the key space
+    starts, ends, qrevs = [], [], []
+    for qi in range(n_q):
+        lo = b"/registry/pods/default/pod-%08d" % (qi * (n_keys // n_q))
+        hi = b"/registry/pods/default/pod-%08d" % ((qi + 1) * (n_keys // n_q))
+        starts.append(pack_bound(lo))
+        ends.append(pack_bound(hi))
+        qrevs.append(n * (qi + 2) // (n_q + 2))
+    s_q = np.stack(starts)
+    e_q = np.stack(ends)
+    qhi, qlo = keyops.split_revs(np.array(qrevs, dtype=np.uint64))
+
+    curve = {}
+    for wat in (1, 2, 4, 8):
+        if n_dev % wat or wat > n_dev or n_q % wat:
+            continue
+        part = n_dev // wat
+        mesh = make_mesh(axes=("part", "wat"), shape=(part, wat))
+        rows_per = (n // part) // 8 * 8
+        usable = rows_per * part
+        P3, P1 = P("part", None, None), P("part", None)
+        sh = lambda a, spec: jax.device_put(
+            a, jax.sharding.NamedSharding(mesh, spec))
+        keys_s = sh(chunks[:usable].reshape(part, rows_per, CHUNKS), P3)
+        rh_s = sh(rh[:usable].reshape(part, rows_per), P1)
+        rl_s = sh(rl[:usable].reshape(part, rows_per), P1)
+        tomb_s = sh(tomb[:usable].reshape(part, rows_per), P1)
+        nv_s = sh(np.full(part, rows_per, np.int32), P("part"))
+        sq = sh(s_q, P("wat", None))
+        eq = sh(e_q, P("wat", None))
+        hq = sh(qhi, P("wat"))
+        lq = sh(qlo, P("wat"))
+
+        @partial_shard_map_scan(mesh)
+        def scan_batch(keys, a, b, t, nv, ss, ee, hh, ll):
+            def one_query(s1, e1, h1, l1):
+                vis = jax.vmap(
+                    lambda k, x, y, z, m: visibility_mask(
+                        k, x, y, z, m, s1, e1, jnp.asarray(False), h1, l1)
+                )(keys, a, b, t, nv)
+                return jax.lax.psum(jnp.sum(vis, dtype=jnp.int32), "part")
+            return jax.vmap(one_query)(ss, ee, hh, ll)
+
+        out = scan_batch(keys_s, rh_s, rl_s, tomb_s, nv_s, sq, eq, hq, lq)
+        jax.block_until_ready(out)
+        lat = []
+        for _ in range(iters):
+            t0 = time.time()
+            jax.block_until_ready(
+                scan_batch(keys_s, rh_s, rl_s, tomb_s, nv_s, sq, eq, hq, lq))
+            lat.append(time.time() - t0)
+        p50 = sorted(lat)[len(lat) // 2]
+        curve[wat] = round(n_q / p50, 1)
+
+    base = curve.get(1) or 1.0
+    best_wat = max(curve, key=curve.get)
+    print(json.dumps({
+        "metric": "scan QPS vs wat (read-replica axis)",
+        "value": curve[best_wat],
+        "unit": "queries/sec",
+        "vs_baseline": round(curve[best_wat] / base, 3),
+        "detail": {
+            "curve_qps": {str(k): v for k, v in curve.items()},
+            "queries": n_q, "rows": n, "devices": n_dev,
+            "best_wat": best_wat,
+            "note": "blocks replicated over wat, queries sharded over wat",
+        },
+    }))
+
+
+def partial_shard_map_scan(mesh):
+    """shard_map decorator for the wat-curve scan (part x wat mesh)."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    def deco(f):
+        shard_map = getattr(jax, "shard_map", None)
+        kw = {}
+        if shard_map is None:
+            from jax.experimental.shard_map import shard_map
+
+            kw["check_rep"] = False
+        specs = dict(
+            mesh=mesh,
+            in_specs=(P("part", None, None), P("part", None), P("part", None),
+                      P("part", None), P("part"),
+                      P("wat", None), P("wat", None), P("wat"), P("wat")),
+            out_specs=P("wat"),
+        )
+        return jax.jit(shard_map(f, **specs, **kw))
+
+    return deco
+
+
 def main() -> None:
     n_keys = int(os.environ.get("KB_BENCH_KEYS", 200_000))
     revs = int(os.environ.get("KB_BENCH_REVS", 100))
@@ -919,6 +1038,8 @@ def main() -> None:
         return bench_sim()
     if metric == "rebuild":
         return bench_rebuild()
+    if metric == "watcurve":
+        return bench_watcurve()
 
     import jax
     import jax.numpy as jnp

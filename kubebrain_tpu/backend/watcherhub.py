@@ -48,6 +48,12 @@ class _RangeIndex:
 
     __slots__ = ("_bounds", "_cover", "dense")
 
+    # average covering-watchers-per-segment beyond which the index is worse
+    # than vectorized matching; construction aborts early at this point so a
+    # degenerate population (e.g. thousands of unbounded from-key watches)
+    # never pays the O(W^2) segment-list materialization
+    DENSE_COVER = 64
+
     def __init__(self, filters: dict[int, tuple[bytes, bytes, int]]):
         events = []  # (key, is_end, wid)
         for wid, (start, end, _minrev) in filters.items():
@@ -60,6 +66,7 @@ class _RangeIndex:
         cover: list[tuple[int, ...]] = [()]
         active: set[int] = set()
         total_cover = 0
+        self.dense = False
         i = 0
         n = len(events)
         while i < n:
@@ -74,9 +81,13 @@ class _RangeIndex:
                 bounds.append(key)
                 cover.append(tuple(active))
             total_cover += len(active)
+            if len(cover) >= 64 and total_cover > self.DENSE_COVER * len(cover):
+                # too nested to index: abandon construction (lookup must not
+                # be used — the hub falls back to matcher / linear filtering)
+                self.dense = True
+                break
         self._bounds = bounds
         self._cover = cover
-        self.dense = len(cover) > 0 and total_cover > 64 * len(cover)
 
     def lookup(self, key: bytes) -> tuple[int, ...]:
         """Watcher ids whose [start, end) contains ``key`` (min_revision NOT
@@ -237,6 +248,8 @@ class WatcherHub:
                 self._index = _RangeIndex(filters)
                 self._index_version = version
             index = self._index
+            if index.dense and self._fanout_matcher is None:
+                index = None  # aborted build, no kernel either: linear filter
 
         # the kernel beats the index only where a chip makes the (E x W) mask
         # ~free: big batches on a real TPU, or populations too nested for the
@@ -280,6 +293,7 @@ class WatcherHub:
                 else:
                     g[1].append(ev)
             per_watcher = {}
+            multi: dict[int, list[list]] = {}  # broad watchers: pieces to merge
             for cover, evs in groups.values():
                 first_rev = evs[0].revision
                 for wid in cover:
@@ -290,14 +304,21 @@ class WatcherHub:
                     )
                     if not mine:
                         continue
-                    cur = per_watcher.get(wid)
-                    if cur is None:
-                        per_watcher[wid] = mine
+                    if wid in multi:
+                        multi[wid].append(mine)
+                    elif wid in per_watcher:
+                        multi[wid] = [per_watcher.pop(wid), mine]
                     else:
-                        # watcher spans multiple cover segments (broad range
-                        # crossing boundaries): merge, keeping revision order
-                        merged = sorted(cur + mine, key=lambda e: e.revision)
-                        per_watcher[wid] = merged
+                        per_watcher[wid] = mine
+            # a watcher spanning several cover segments merges its
+            # revision-ordered pieces once, not per segment
+            if multi:
+                import heapq
+
+                for wid, pieces in multi.items():
+                    per_watcher[wid] = list(
+                        heapq.merge(*pieces, key=lambda e: e.revision)
+                    )
         else:
             per_watcher = {}
             for wid, _q in subs:

@@ -270,7 +270,8 @@ def main(argv=None) -> int:
         except ValueError:
             print(f"ignoring malformed KB_GC_THRESHOLD={gc_env!r}", file=sys.stderr)
             parts = []
-        if not parts or parts[0] <= 0:  # gc.set_threshold(0,..) would disable gc
+        if not parts or any(p <= 0 for p in parts):
+            # zero disables gc entirely; negatives crash set_threshold
             parts = [200_000, 1000, 1000]
         gc.set_threshold(*parts[:3])
 

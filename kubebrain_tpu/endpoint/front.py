@@ -268,8 +268,16 @@ class FrontServer:
                     await writer.drain()  # sole backpressure point
                     if self._gate is not None and self._cork_bytes <= self._CORK_HIGH_WATER:
                         self._gate.set()
-        except (ConnectionResetError, BrokenPipeError):
-            pass
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            # ANY transport failure: the backhaul is done for — don't leave
+            # producers parked on a gate nobody will ever open
+            logger.exception("backhaul flusher died; closing writer")
+            writer.close()
+        finally:
+            if self._gate is not None:
+                self._gate.set()
 
     def _send_end(self, cid: int, sid: int, status: int = 0, msg: str = "") -> None:
         raw = msg.encode()[:65535]
@@ -388,11 +396,17 @@ class FrontServer:
             out = bytes(resp) if isinstance(resp, bytes) else resp.SerializeToString()
             w = self._writer
             if w is not None and not w.is_closing():
-                # MSG + END corked as one frame pair
-                self._cork.append(
+                # MSG + END corked as one frame pair; counted against the
+                # high-water gate (unary sends bypass the gate but their
+                # bytes must still backpressure the stream producers)
+                frame = (
                     _HDR.pack(len(out), cid, sid, K_MSG) + out
                     + _HDR.pack(6, cid, sid, K_END) + _END_OK
                 )
+                self._cork.append(frame)
+                self._cork_bytes += len(frame)
+                if self._cork_bytes > self._CORK_HIGH_WATER and self._gate is not None:
+                    self._gate.clear()
                 if self._cork_event is not None:
                     self._cork_event.set()
         except _AbortError as e:

@@ -347,3 +347,105 @@ def test_pool_heals_after_server_restart():
         s.close()
         proc.terminate()
         proc.wait(timeout=5)
+
+
+def test_reverse_scan_pages_past_server_page_cap(stored):
+    """A reverse scan over more rows than one kbstored page (2048) must page
+    seamlessly — the point-get path over a user key with a huge version
+    chain (VERDICT r2 weak #6). Forward/backward full-range differential."""
+    s = new_storage("remote", address=f"127.0.0.1:{stored}", pool=2)
+    try:
+        n = 2048 + 700
+        b = s.begin_batch_write()
+        for i in range(n):
+            b.put(b"/rvp/%06d" % i, b"v%d" % i)
+        b.commit()
+        fwd = [(k, v) for k, v in s.iter(b"/rvp/", b"/rvp0")]
+        assert len(fwd) == n
+        rev = [(k, v) for k, v in s.iter(b"/rvp/\xff", b"/rvp/")]
+        assert len(rev) == n, f"reverse paging lost rows: {len(rev)}"
+        assert rev == fwd[::-1]
+        # limited reverse scans still honor the limit across page joins
+        rev_l = [(k, v) for k, v in s.iter(b"/rvp/\xff", b"/rvp/", limit=2500)]
+        assert rev_l == fwd[::-1][:2500]
+    finally:
+        s.close()
+
+
+def test_stored_restart_under_live_write_load():
+    """kbstored (the shared tier, a documented SPOF) is restarted while
+    writers run. Client contract to verify: the outage window classifies as
+    UncertainResultError (never silent loss or phantom success), the pool
+    heals, and every ACKED write is durable after the restart
+    (reference error contract: pkg/storage/tikv/batch.go:110-146)."""
+    import threading
+
+    port = free_port()
+    data_dir = "/tmp/kb-restart-%d" % os.getpid()
+    os.makedirs(data_dir, exist_ok=True)
+    proc = subprocess.Popen([STORED_BIN, str(port), data_dir],
+                            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL)
+    assert b"READY" in proc.stdout.readline()
+    s = new_storage("remote", address=f"127.0.0.1:{port}", pool=3)
+    acked: dict[bytes, bytes] = {}
+    uncertain: list[bytes] = []
+    lock = threading.Lock()
+    stop = threading.Event()
+
+    def writer(w):
+        i = 0
+        while not stop.is_set():
+            key = b"/rst/w%d-%05d" % (w, i)
+            try:
+                put(s, key, b"v%d" % i)
+                with lock:
+                    acked[key] = b"v%d" % i
+            except UncertainResultError:
+                with lock:
+                    uncertain.append(key)
+            except Exception:
+                pass  # pool slot mid-heal
+            i += 1
+            time.sleep(0.002)
+
+    threads = [threading.Thread(target=writer, args=(w,)) for w in range(3)]
+    for t in threads:
+        t.start()
+    try:
+        time.sleep(1.0)
+        proc.terminate()  # SIGTERM checkpoints + exits
+        proc.wait(timeout=10)
+        time.sleep(0.5)  # writers hammer a dead tier: uncertain results
+        proc = subprocess.Popen([STORED_BIN, str(port), data_dir],
+                                stdout=subprocess.PIPE, stderr=subprocess.DEVNULL)
+        assert b"READY" in proc.stdout.readline()
+        time.sleep(1.5)  # pool heals, writers make progress again
+    finally:
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+
+    try:
+        with lock:
+            n_acked = len(acked)
+            n_uncertain = len(uncertain)
+        assert n_uncertain > 0, "restart window must surface as uncertain"
+        assert n_acked > 200, f"writers made little progress: {n_acked}"
+        # acked writes from BEFORE the restart survived it; acked writes
+        # from after landed on healed connections
+        missing = [k for k, v in acked.items() if _get_or_none(s, k) != v]
+        assert not missing, f"lost {len(missing)} acked writes: {missing[:5]}"
+    finally:
+        s.close()
+        proc.terminate()
+        proc.wait(timeout=5)
+        import shutil
+
+        shutil.rmtree(data_dir, ignore_errors=True)
+
+
+def _get_or_none(s, key):
+    try:
+        return s.get(key)
+    except KeyNotFoundError:
+        return None

@@ -177,3 +177,27 @@ def test_watch_oldest_minus_one_ok_before_eviction(backend):
     events = collect(q, 2)
     assert [e.revision for e in events] == [r1, r2]
     backend.unwatch(wid)
+
+
+def test_hub_dense_population_falls_back_correctly():
+    """Hundreds of overlapping unbounded (from-key) watchers: the interval
+    index aborts its build (dense) and the hub must still deliver exactly
+    right via the fallback path."""
+    from kubebrain_tpu.backend.common import Verb, WatchEvent
+    from kubebrain_tpu.backend.watcherhub import WatcherHub, _RangeIndex
+
+    hub = WatcherHub()
+    qs = {}
+    for i in range(200):
+        # nested unbounded ranges: [/k-000.., inf), [/k-001.., inf), ...
+        wid, q = hub.add_watcher(b"/k-%03d" % i, b"", 0)
+        qs[wid] = (i, q)
+    idx = _RangeIndex({w: (b"/k-%03d" % i, b"", 0) for w, (i, _) in qs.items()})
+    assert idx.dense, "200 nested unbounded ranges must flag dense"
+
+    ev = WatchEvent(revision=5, verb=Verb.CREATE, key=b"/k-100x", value=b"v",
+                    valid=True)
+    hub.stream([ev])
+    got = sorted(i for i, q in qs.values() if not q.empty())
+    # watchers 0..100 have start <= /k-100x; 101.. start above it
+    assert got == list(range(101)), (len(got), got[:5], got[-5:])
