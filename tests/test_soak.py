@@ -16,8 +16,13 @@ from test_multinode import Node
 
 
 def test_failover_under_load_no_acked_writes_lost():
+    import math
+
+    from kubebrain_tpu.lincheck import History
+
     store = new_storage("memkv")
     nodes = [Node(store) for _ in range(3)]
+    history = History()  # record() is one list.append: thread-safe under GIL
     try:
         deadline = time.time() + 10
         while time.time() < deadline and not any(n.peers.is_leader() for n in nodes):
@@ -34,14 +39,35 @@ def test_failover_under_load_no_acked_writes_lost():
                 key = b"/registry/soak/w%02d-%05d" % (w, i)
                 wrote = False
                 for n in list(live_nodes):
+                    t0 = time.monotonic()
                     try:
                         resp = n.client.create(key, b"v")
                     except Exception:
+                        # node died mid-call: outcome unknown — the op may
+                        # or may not have landed (Jepsen :info op)
+                        history.record(w, "create", key, t0, math.inf,
+                                       value=b"v", ok=None)
                         continue
                     if resp.succeeded:
                         rev = resp.responses[0].response_put.header.revision
                         with acked_lock:
                             acked[key] = rev
+                        history.record(w, "create", key, t0, time.monotonic(),
+                                       value=b"v", ok=True, rev=rev)
+                        wrote = True
+                        break
+                    else:
+                        # keys are writer-unique: a conflict proves this
+                        # writer's own earlier unknown-outcome create landed
+                        # — move on instead of livelocking on the key
+                        crev = 0
+                        try:
+                            crev = resp.responses[0].response_range.kvs[0].mod_revision
+                        except (IndexError, AttributeError):
+                            pass
+                        history.record(w, "create", key, t0, time.monotonic(),
+                                       value=b"v", ok=False, err="conflict",
+                                       conflict_rev=crev)
                         wrote = True
                         break
                 if wrote:
@@ -83,6 +109,26 @@ def test_failover_under_load_no_acked_writes_lost():
         assert not missing, f"lost {len(missing)} acknowledged writes: {missing[:5]}"
         wrong_rev = [k for k, rv in acked.items() if server[k] != rv]
         assert not wrong_rev, f"acked revision changed for {wrong_rev[:5]}"
+
+        # linearizability: fold the survivor's final state into the history
+        # as completed reads, then check the whole concurrent run — acked
+        # creates must be readable at their revision, unknown-outcome ops
+        # may have landed or not, revisions must respect real time
+        # (reference README.md:30-34 lists Jepsen as TODO; lincheck.py)
+        t_end = time.monotonic()
+        seen_keys = set()
+        for kv in r.kvs:
+            seen_keys.add(bytes(kv.key))
+            history.record(99, "get", bytes(kv.key), t_end, t_end + 0.001,
+                           value=bytes(kv.value), ok=True, rev=kv.mod_revision)
+        for op in list(history.ops):
+            if op.key not in seen_keys and op.kind == "create":
+                # key absent from the final state: a completed not-found read
+                history.record(99, "get", op.key, t_end, t_end + 0.001, ok=False)
+                seen_keys.add(op.key)
+        res = history.check()
+        assert res["ok"], f"soak history not linearizable: {res['violation']}"
+        assert res["ops"] > 100
     finally:
         for n in nodes:
             try:
