@@ -32,7 +32,16 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-LANE_TILE = 1024  # rows per grid step
+import os as _os
+
+# Rows per grid step. Grid iteration overhead dominates at small tiles (a
+# 20M-row scan is ~20k steps at 1024) and VMEM per step is only ~66B * TILE,
+# so larger tiles should win on-chip — env-tunable (KB_PALLAS_TILE) for the
+# sweep; 1024 stays the default until a real-chip run validates bigger.
+LANE_TILE = int(_os.environ.get("KB_PALLAS_TILE", "1024"))
+if LANE_TILE <= 0 or LANE_TILE % 128:
+    raise ValueError(
+        f"KB_PALLAS_TILE={LANE_TILE} must be a positive multiple of 128 lanes")
 
 
 def flip_sign(chunks: np.ndarray) -> np.ndarray:
