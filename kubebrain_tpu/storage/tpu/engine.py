@@ -158,6 +158,35 @@ def _indices_of_mask(mask, size):
     return idx
 
 
+def _pow2_bucket(want: int, n_flat: int) -> int:
+    """Index-transfer size bucketed to a power of two (bounds jit
+    recompiles), clamped to the flat row count."""
+    bucket = 1
+    while bucket < max(want, 1):
+        bucket *= 2
+    return min(bucket, n_flat)
+
+
+@jax.jit
+def _victim_counts(mask, nv):
+    """(victims, valid rows) as two device scalars — the host reads 8 bytes
+    to decide which index set (victims or survivors) is cheaper to pull."""
+    valid = jnp.arange(mask.shape[-1], dtype=jnp.int32)[None, :] < nv[:, None]
+    return jnp.sum(mask, dtype=jnp.int32), jnp.sum(valid, dtype=jnp.int32)
+
+
+@functools.partial(jax.jit, static_argnames=("size",))
+def _survivor_indices(mask, nv, size):
+    """Flat indices of valid non-victim rows, device-compacted like
+    ``_indices_of_mask`` (which serves the victim side directly — the victim
+    kernels already gate validity; only the survivor complement needs the
+    explicit ``valid`` conjunction)."""
+    valid = jnp.arange(mask.shape[-1], dtype=jnp.int32)[None, :] < nv[:, None]
+    flat = (valid & ~mask).reshape(-1)
+    (idx,) = jnp.nonzero(flat, size=size, fill_value=flat.shape[0])
+    return idx
+
+
 def _resolve_scan_kernel(use_pallas: bool | None) -> str:
     """Flag/env resolution for the scan kernel choice. Mosaic lowering needs
     a real TPU backend; everywhere else the Pallas path runs interpreted
@@ -407,10 +436,7 @@ class TpuScanner(Scanner):
         index list sized to the next power of two so the host never pulls
         the full row mask."""
         total = int(np.asarray(counts).sum())
-        bucket = 1
-        while bucket < max(total, 1):
-            bucket *= 2
-        bucket = min(bucket, n_flat)
+        bucket = _pow2_bucket(total, n_flat)
         idx = np.asarray(_indices_of_mask(mask, size=bucket))[:total]
         return total, idx
 
@@ -554,6 +580,38 @@ class TpuScanner(Scanner):
         return p
 
     # -------------------------------------------------------------- compact
+    def _pull_victim_mask(self, mask_dev, mirror) -> np.ndarray:
+        """Host bool victim mask via the adaptive two-phase transfer: read
+        two device scalars (victims, valid), then pull only the SMALLER
+        index set — victim indices on an incremental compact (few victims),
+        survivor indices on a bulk one (few survivors) — and rebuild the
+        mask locally. Over the axon tunnel the full [P, N] byte mask
+        dominates compaction latency (docs/bench_results_tpu.md: 429ms ->
+        286ms); the wire should carry victim identities, not the keyspace
+        (reference deletes victims by key batch, scanner.go:445-491)."""
+        nv_dev = mirror.n_valid_dev
+        vic, valid = (int(x) for x in jax.device_get(_victim_counts(mask_dev, nv_dev)))
+        shape = mask_dev.shape
+        n_flat = int(np.prod(shape))
+        survivors = (valid - vic) < vic
+        want = (valid - vic) if survivors else vic
+        bucket = _pow2_bucket(want, n_flat)
+        if survivors:
+            idx = np.asarray(_survivor_indices(mask_dev, nv_dev, size=bucket))[:want]
+        else:
+            idx = np.asarray(_indices_of_mask(mask_dev, size=bucket))[:want]
+        if not survivors:
+            mask = np.zeros(n_flat, dtype=bool)
+            mask[idx] = True
+            return mask.reshape(shape)
+        # victims = valid & ~survivor
+        mask = np.arange(shape[-1], dtype=np.int64)[None, :] < np.asarray(
+            mirror.n_valid
+        )[:, None]
+        flat = mask.reshape(-1)
+        flat[idx] = False
+        return flat.reshape(shape)
+
     def compact(self, start: bytes, end: bytes, compact_revision: int) -> CompactStats:
         """Device-side victim marking + host deletes (the north-star
         compaction path). ``start``/``end`` are internal-key borders from the
@@ -580,28 +638,25 @@ class TpuScanner(Scanner):
         chi, clo = keyops.split_revs(np.array([compact_revision], dtype=np.uint64))
         thi, tlo = keyops.split_revs(np.array([ttl_cutoff], dtype=np.uint64))
         if self._scan_kernel == "jnp":
-            mask = np.asarray(
-                _victim_batch(
-                    mirror.keys_dev, mirror.rh_dev, mirror.rl_dev, mirror.tomb_dev,
-                    mirror.ttl_dev, mirror.n_valid_dev, s, e, unb,
-                    jnp.asarray(chi[0]), jnp.asarray(clo[0]),
-                    jnp.asarray(thi[0]), jnp.asarray(tlo[0]),
-                    with_ttl=ttl_cutoff > 0,
-                )
+            mask_dev = _victim_batch(
+                mirror.keys_dev, mirror.rh_dev, mirror.rl_dev, mirror.tomb_dev,
+                mirror.ttl_dev, mirror.n_valid_dev, s, e, unb,
+                jnp.asarray(chi[0]), jnp.asarray(clo[0]),
+                jnp.asarray(thi[0]), jnp.asarray(tlo[0]),
+                with_ttl=ttl_cutoff > 0,
             )
         else:
             kt, rh31, rl31, t8, _n = self._pallas_layout(mirror)
             ttl8 = self._pallas_ttl8(mirror, kt.shape[2])
-            mask = np.asarray(
-                _victim_batch_pallas(
-                    kt, rh31, rl31, t8, ttl8, mirror.n_valid_dev, s, e, unb,
-                    jnp.asarray(chi[0]), jnp.asarray(clo[0]),
-                    jnp.asarray(thi[0]), jnp.asarray(tlo[0]),
-                    with_ttl=ttl_cutoff > 0,
-                    interpret=(self._scan_kernel == "pallas_interpret"),
-                    mesh=self._kernel_mesh,
-                )
+            mask_dev = _victim_batch_pallas(
+                kt, rh31, rl31, t8, ttl8, mirror.n_valid_dev, s, e, unb,
+                jnp.asarray(chi[0]), jnp.asarray(clo[0]),
+                jnp.asarray(thi[0]), jnp.asarray(tlo[0]),
+                with_ttl=ttl_cutoff > 0,
+                interpret=(self._scan_kernel == "pallas_interpret"),
+                mesh=self._kernel_mesh,
             )  # padded cols are never victims (valid=False); mask[p][:nv] below
+        mask = self._pull_victim_mask(mask_dev, mirror)
 
         stats = CompactStats(scanned=mirror.rows)
         retry_min = self._retry_min_revision()

@@ -330,3 +330,55 @@ def test_delta_index_overlay_snapshot_semantics():
     assert res_new.kvs[0].value == b"v3" and res_new.kvs[0].revision == r3
     b.close()
     store.close()
+
+
+def test_pull_victim_mask_adaptive_branches(tb):
+    """Both sides of the two-phase transfer (pull victim indices vs pull
+    survivor indices) must rebuild the exact same host mask. A bulk compact
+    of long version chains has few survivors; an incremental compact has
+    few victims — force each branch and differential-check the results."""
+    from unittest import mock
+
+    # long chains: 6 keys x 30 revisions -> compacting makes most rows victims
+    revs = {}
+    for i in range(6):
+        k = b"/registry/pods/c%d" % i
+        r = tb.create(k, b"v0")
+        for j in range(29):
+            r = tb.update(k, b"v%d" % (j + 1), r)
+        revs[k] = r
+    last = max(revs.values())
+    assert wait_for_revision(tb, last)
+
+    sc = tb.scanner
+    sc._ensure_published(full=True)
+    pulled = []
+    orig = type(sc)._pull_victim_mask
+
+    def spy(self, mask_dev, mirror):
+        out = orig(self, mask_dev, mirror)
+        # the differential: the rebuilt host mask must equal the device mask
+        # pulled directly (identities, not just counts)
+        assert np.array_equal(out, np.asarray(mask_dev).astype(bool))
+        pulled.append(out)
+        return out
+
+    with mock.patch.object(type(sc), "_pull_victim_mask", spy):
+        tb.compact(last)
+    assert pulled, "compact did not route through the two-phase pull"
+    bulk_mask = pulled[-1]
+    # bulk compact of 30-rev chains: victims outnumber survivors
+    assert bulk_mask.sum() > (6 * 30) // 2
+
+    # incremental compact right after: almost no victims -> victim branch
+    r2 = tb.update(b"/registry/pods/c0", b"vz", revs[b"/registry/pods/c0"])
+    assert wait_for_revision(tb, r2)
+    pulled.clear()
+    with mock.patch.object(type(sc), "_pull_victim_mask", spy):
+        tb.compact(r2)
+    assert pulled and pulled[-1].sum() <= 2
+
+    # state still correct after both branches
+    res = tb.list_(b"/registry/", b"/registry0")
+    assert len(res.kvs) == 6
+    assert {kv.key: kv.value for kv in res.kvs}[b"/registry/pods/c0"] == b"vz"
