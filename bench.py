@@ -267,26 +267,25 @@ def bench_compact() -> None:
     # device, pull only the smaller index set. Over the axon tunnel this is
     # the difference between moving the 10MB mask and moving ~360KB of
     # survivor indices for this dataset (most rows are victims here).
-    @jax.jit
-    def victim_count(m):
-        return jnp.sum(m, dtype=jnp.int32)
+    # the SAME jitted helpers TpuScanner._pull_victim_mask dispatches (the
+    # engine helpers take [P, N] masks + per-partition n_valid; the bench's
+    # flat mask is one partition)
+    from kubebrain_tpu.storage.tpu.engine import (
+        _indices_of_mask, _pow2_bucket, _survivor_indices, _victim_counts,
+    )
 
-    @functools.partial(jax.jit, static_argnames=("size", "survivors"))
-    def mask_indices(m, size, survivors=False):
-        if survivors:
-            m = (jnp.arange(m.shape[0], dtype=jnp.int32) < jnp.int32(n)) & ~m
-        (idx,) = jnp.nonzero(m, size=size, fill_value=m.shape[0])
-        return idx
-
-    from kubebrain_tpu.storage.tpu.engine import _pow2_bucket
+    nv1 = jnp.asarray(np.array([n], dtype=np.int32))
 
     def compact_production():
-        m = device_mask()
-        vic = int(victim_count(m))
+        m = device_mask().reshape(1, -1)
+        vic, _valid = (int(x) for x in jax.device_get(_victim_counts(m, nv1)))
         survivors = (n - vic) < vic
         want = (n - vic) if survivors else vic
-        bucket = _pow2_bucket(want, int(m.shape[0]))
-        idx = np.asarray(mask_indices(m, size=bucket, survivors=survivors))[:want]
+        bucket = _pow2_bucket(want, int(m.shape[1]))
+        if survivors:
+            idx = np.asarray(_survivor_indices(m, nv1, size=bucket))[:want]
+        else:
+            idx = np.asarray(_indices_of_mask(m, size=bucket))[:want]
         if survivors:
             return (chunks.take(idx, axis=0), rh.take(idx), rl.take(idx),
                     tomb.take(idx))

@@ -35,10 +35,12 @@ from jax.experimental.pallas import tpu as pltpu
 import os as _os
 
 # Rows per grid step. Grid iteration overhead dominates at small tiles (a
-# 20M-row scan is ~20k steps at 1024) and VMEM per step is only ~66B * TILE,
-# so larger tiles should win on-chip — env-tunable (KB_PALLAS_TILE) for the
-# sweep; 1024 stays the default until a real-chip run validates bigger.
-LANE_TILE = int(_os.environ.get("KB_PALLAS_TILE", "1024"))
+# 20M-row scan is ~20k steps at 1024) and VMEM per step is only ~66B * TILE.
+# Real-chip sweep (tools/tile_sweep.py, v5e, 20M rows, 2026-07-29):
+#   512: 90.3ms  1024: 87.8ms  2048: 84.4ms  4096: 82.6ms  8192: 83.1ms
+#   16384: 84.5ms (p50; best-case runs hit 43ms — per-dispatch tunnel RTT
+# dominates the residual). 4096 is the measured optimum and the default.
+LANE_TILE = int(_os.environ.get("KB_PALLAS_TILE", "4096"))
 if LANE_TILE <= 0 or LANE_TILE % 128:
     raise ValueError(
         f"KB_PALLAS_TILE={LANE_TILE} must be a positive multiple of 128 lanes")

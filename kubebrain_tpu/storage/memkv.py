@@ -99,23 +99,7 @@ class MemKv(KvStorage):
         with self._lock:
             now = time.time()
             ts = self._ts if snapshot_ts is None else snapshot_ts
-            if reverse:
-                # (reverse contract: end <= k <= start, descending)
-                lo = bisect.bisect_left(self._keys, end)
-                hi = bisect.bisect_right(self._keys, start)
-                candidates = reversed(self._keys[lo:hi])
-            else:
-                lo = bisect.bisect_left(self._keys, start)
-                hi = bisect.bisect_left(self._keys, end) if end else len(self._keys)
-                candidates = iter(self._keys[lo:hi])
-            buf: list[tuple[bytes, bytes]] = []
-            for k in candidates:
-                val = self._live_value(k, ts, now)
-                if val is not None:
-                    buf.append((k, val))
-                    if limit and len(buf) >= limit:
-                        break
-        return _BufferedIter(buf)
+        return _LazyIter(self, start, end, ts, now, limit, reverse)
 
     # ------------------------------------------------------------------ writes
     def begin_batch_write(self) -> BatchWrite:
@@ -196,17 +180,59 @@ class MemKv(KvStorage):
             self._versions.clear()
 
 
-class _BufferedIter(Iter):
-    def __init__(self, buf: list[tuple[bytes, bytes]]):
-        self._buf = buf
-        self._pos = 0
+class _LazyIter(Iter):
+    """Streaming snapshot iterator: each ``next()`` advances a *key-based*
+    cursor under the store lock, so the engine never materializes the whole
+    range up front (the reference iterates the skiplist lazily, iter.go) and
+    iteration stays correct while concurrent commits insert keys or
+    ``prune_versions`` removes them — the snapshot timestamp pins what is
+    visible, the cursor pins where we are."""
+
+    def __init__(self, store: "MemKv", start: bytes, end: bytes, ts: int,
+                 now: float, limit: int, reverse: bool):
+        self._store = store
+        self._start = start
+        self._end = end
+        self._ts = ts
+        self._now = now
+        self._limit = limit
+        self._reverse = reverse
+        self._cursor: bytes | None = None  # last key returned or skipped
+        self._emitted = 0
+
+    def _next_pos(self, keys: list[bytes]) -> int | None:
+        if self._reverse:
+            # reverse contract: end <= k <= start, descending
+            if self._cursor is None:
+                pos = bisect.bisect_right(keys, self._start) - 1
+            else:
+                pos = bisect.bisect_left(keys, self._cursor) - 1
+            if pos < 0 or keys[pos] < self._end:
+                return None
+            return pos
+        if self._cursor is None:
+            pos = bisect.bisect_left(keys, self._start)
+        else:
+            pos = bisect.bisect_right(keys, self._cursor)
+        if pos >= len(keys) or (self._end and keys[pos] >= self._end):
+            return None
+        return pos
 
     def next(self) -> tuple[bytes, bytes]:
-        if self._pos >= len(self._buf):
+        if self._limit and self._emitted >= self._limit:
             raise StopIteration
-        item = self._buf[self._pos]
-        self._pos += 1
-        return item
+        store = self._store
+        with store._lock:
+            while True:
+                pos = self._next_pos(store._keys)
+                if pos is None:
+                    raise StopIteration
+                k = store._keys[pos]
+                self._cursor = k
+                val = store._live_value(k, self._ts, self._now)
+                if val is not None:
+                    self._emitted += 1
+                    return (k, val)
 
 
 class _MemBatch(BatchWrite):

@@ -98,8 +98,13 @@ def sweep() -> None:
     if not os.path.exists(f"{DATA}/chunks.npy"):
         subprocess.run([sys.executable, __file__, "build"], check=True)
     for tile in TILES:
-        r = subprocess.run([sys.executable, __file__, "run", str(tile)],
-                           capture_output=True, text=True, timeout=1200)
+        try:
+            r = subprocess.run([sys.executable, __file__, "run", str(tile)],
+                               capture_output=True, text=True, timeout=1200)
+        except subprocess.TimeoutExpired:
+            # a wedged tunnel must not lose the remaining tiles' results
+            print(f'{{"tile": {tile}, "error": "timeout (tunnel wedged?)"}}', flush=True)
+            continue
         out = r.stdout.strip()
         print(out if out else f'{{"tile": {tile}, "error": {json.dumps(r.stderr[-500:])}}}',
               flush=True)
