@@ -28,7 +28,8 @@ def build_parser() -> argparse.ArgumentParser:
                    help="storage engine (reference: build-tag selected TiKV/Badger; "
                         "'remote' = shared kbstored server, the TiKV role)")
     p.add_argument("--storage-address", default="127.0.0.1:2389",
-                   help="kbstored address for --storage=remote")
+                   help="kbstored address for --storage=remote; comma-"
+                        "separated primary,follower,... enables failover()")
     p.add_argument("--storage-pool", type=int, default=8,
                    help="connection pool size to kbstored (reference keeps "
                         "200 round-robin TiKV clients, tikv.go:36-82)")

@@ -101,6 +101,7 @@ class Server:
             "/election": self._election,
             "/debug/threads": self._threads,
             "/debug/jax-profile": self._jax_profile,
+            "/tier/failover": self._tier_failover,
         }
 
     def _health(self):
@@ -123,6 +124,31 @@ class Server:
             "identity": self.identity,
             "is_leader": self.peers.is_leader(),
         }).encode()
+
+    def _tier_failover(self):
+        """Operator-driven storage-tier failover: promote the first
+        reachable kbstored follower and repoint this node's pool
+        (RemoteKvStorage.failover). Deliberately a manual surface — the tier
+        has no raft quorum, so WHEN to flip is the operator's (or the
+        election layer's) call; see README 'Tier replication'."""
+        store = self.backend.store
+        # unwrap decorators (metrics wrapper, tpu mirror) to the remote tier
+        for _ in range(4):
+            if hasattr(store, "failover"):
+                break
+            nxt = getattr(store, "_inner", None)
+            if nxt is None:
+                break
+            store = nxt
+        if not hasattr(store, "failover"):
+            return "application/json", json.dumps(
+                {"error": "storage tier has no failover (not --storage=remote?)"}
+            ).encode()
+        try:
+            idx = store.failover()
+            return "application/json", json.dumps({"promoted_index": idx}).encode()
+        except Exception as exc:  # surfaced to the operator, not swallowed
+            return "application/json", json.dumps({"error": str(exc)}).encode()
 
     def _threads(self):
         """Poor man's pprof: live thread stacks (reference mounts Go pprof,

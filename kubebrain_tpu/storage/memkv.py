@@ -183,10 +183,12 @@ class MemKv(KvStorage):
 class _LazyIter(Iter):
     """Streaming snapshot iterator: each ``next()`` advances a *key-based*
     cursor under the store lock, so the engine never materializes the whole
-    range up front (the reference iterates the skiplist lazily, iter.go) and
-    iteration stays correct while concurrent commits insert keys or
-    ``prune_versions`` removes them — the snapshot timestamp pins what is
-    visible, the cursor pins where we are."""
+    range up front (the reference iterates the skiplist lazily, iter.go).
+    The snapshot timestamp pins visibility against concurrent COMMITS; like
+    the native engine, ``prune_versions(keep_after_ts)`` only preserves
+    history for snapshots >= its watermark — an iterator pinned BELOW a
+    later prune watermark may observe pruned keys vanish mid-scan (callers
+    hold the compaction fence for exactly this reason, backend/retry.py)."""
 
     def __init__(self, store: "MemKv", start: bytes, end: bytes, ts: int,
                  now: float, limit: int, reverse: bool):

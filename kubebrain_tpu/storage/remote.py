@@ -41,6 +41,7 @@ from .errors import (
 OP_GET, OP_TSO, OP_BATCH, OP_SCAN, OP_PARTITIONS = 1, 2, 3, 4, 5
 OP_MVCC_WRITE, OP_MVCC_DELETE, OP_CHECKPOINT, OP_INFO = 6, 7, 8, 9
 OP_EXPORT = 10
+OP_REPL_HELLO, OP_REPL_ACK, OP_PROMOTE, OP_ROLE = 11, 12, 13, 14
 ST_OK, ST_NOT_FOUND, ST_CONFLICT, ST_WAL, ST_DRIFT, ST_ERROR = 0, 1, 2, 3, 4, 5
 
 _REQ = struct.Struct("<IQB")
@@ -243,9 +244,17 @@ class RemoteKvStorage(KvStorage):
                  timeout: float = 30.0, partitions: int = 4):
         # 30s default: kbstored serves ops from one reactor thread, so a
         # checkpoint or big scan page briefly stalls other connections — a
-        # tight timeout would misclassify those stalls as uncertain writes
-        host, _, port = address.rpartition(":")
-        self._address = (host or "127.0.0.1", int(port))
+        # tight timeout would misclassify those stalls as uncertain writes.
+        # ``address`` may be a comma-separated list: the first entry is the
+        # primary, the rest are WAL-shipping followers (kbstored --follow) —
+        # see failover(). Mirrors the reference's PD endpoints list
+        # (tikv.go:38-82).
+        self._addresses = []
+        for a in address.split(","):
+            host, _, port = a.strip().rpartition(":")
+            self._addresses.append((host or "127.0.0.1", int(port)))
+        self._primary = 0
+        self._address = self._addresses[0]
         self._timeout = timeout
         self._n_partitions = max(1, partitions)
         self._pool = [_PooledConn(self._address, timeout) for _ in range(pool)]
@@ -348,6 +357,69 @@ class RemoteKvStorage(KvStorage):
         if status != ST_OK:
             raise StorageError(
                 f"checkpoint failed on kbstored (status {status}): {payload!r}")
+
+    # ---------------------------------------------------------- replication
+    def _call_addr(self, addr: tuple[str, int], op: int, body: bytes):
+        """One-off request to a specific tier member (control-plane ops)."""
+        conn = _PooledConn(addr, self._timeout)
+        try:
+            return conn.call(op, body)
+        finally:
+            conn.close()
+
+    def role(self, idx: int | None = None) -> tuple[bool, int, int]:
+        """(is_follower, clock, attached_replicas) of a tier member."""
+        addr = self._addresses[self._primary if idx is None else idx]
+        status, payload = self._call_addr(addr, OP_ROLE, b"")
+        if status != ST_OK:
+            raise StorageError(f"ROLE failed (status {status})")
+        r = _Reader(payload)
+        return bool(r.u8()), r.u64(), r.u32()
+
+    def promote(self, idx: int) -> None:
+        """Promote the follower at ``idx`` to primary (idempotent)."""
+        status, payload = self._call_addr(self._addresses[idx], OP_PROMOTE, b"")
+        if status != ST_OK:
+            raise StorageError(f"PROMOTE failed (status {status}): {payload!r}")
+
+    def failover(self) -> int:
+        """Promote the first reachable follower and repoint the pool at it.
+
+        Deliberately NOT automatic on transport blips: the CALLER decides
+        when the primary is dead (election layer / operator) — auto-flipping
+        here would risk split-brain, the problem raft solves for the
+        reference's TiKV (tikv.go:123-153). Returns the new primary index.
+        In-flight requests on old pool conns surface as
+        UncertainResultError and repair through the retry path as usual.
+        """
+        last_exc: Exception | None = None
+        for idx, addr in enumerate(self._addresses):
+            if idx == self._primary:
+                continue
+            try:
+                # only promote actual FOLLOWERS: a restarted old primary
+                # answers PROMOTE with an idempotent OK, and repointing at
+                # it would silently abandon every write acked since the
+                # first failover (stale-lineage guard)
+                is_follower, _, _ = self.role(idx)
+                if not is_follower:
+                    last_exc = StorageError(
+                        f"{addr} is a primary with its own lineage; refusing")
+                    continue
+                self.promote(idx)
+            except (OSError, EOFError, StorageError) as exc:
+                last_exc = exc
+                continue
+            with self._rr_lock:
+                self._primary = idx
+                self._address = addr
+                old, self._pool = self._pool, [
+                    _PooledConn(addr, self._timeout) for _ in range(len(self._pool))
+                ]
+            for c in old:
+                c.close()
+            return idx
+        raise StorageError(f"no promotable follower reachable: {last_exc}")
 
     def close(self) -> None:
         for c in self._pool:

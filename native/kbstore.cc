@@ -28,6 +28,13 @@
 #include <string>
 #include <vector>
 
+// Replication hook: invoked after every durable commit with the exact WAL
+// record bytes (kbstored ships them to followers — the WAL *is* the
+// replication stream, the role raft logs play for TiKV regions,
+// tikv.go:123-153).
+extern "C" typedef void (*kb_commit_cb)(void* ctx, const uint8_t* rec,
+                                        size_t len, uint64_t ts);
+
 namespace {
 
 struct Version {
@@ -46,6 +53,8 @@ struct Store {
   std::string dir;     // empty = in-memory only
   FILE* wal = nullptr;
   bool fsync_commits = false;
+  kb_commit_cb hook = nullptr;  // replication sink (see kb_set_commit_hook)
+  void* hook_ctx = nullptr;
 
   ~Store() {
     if (wal != nullptr) fclose(wal);
@@ -107,22 +116,69 @@ struct AppliedOp {
   double expire_at;
 };
 
-bool write_record(FILE* f, uint64_t ts, const std::vector<AppliedOp>& ops) {
+void serialize_record(std::string& out, uint64_t ts,
+                      const std::vector<AppliedOp>& ops) {
   uint32_t magic = kWalMagic;
   uint32_t nops = static_cast<uint32_t>(ops.size());
-  if (fwrite(&magic, 4, 1, f) != 1) return false;
-  if (fwrite(&ts, 8, 1, f) != 1) return false;
-  if (fwrite(&nops, 4, 1, f) != 1) return false;
+  out.append(reinterpret_cast<const char*>(&magic), 4);
+  out.append(reinterpret_cast<const char*>(&ts), 8);
+  out.append(reinterpret_cast<const char*>(&nops), 4);
   for (const auto& op : ops) {
     uint32_t klen = op.key.size(), vlen = op.value.size();
-    if (fwrite(&op.kind, 1, 1, f) != 1) return false;
-    if (fwrite(&klen, 4, 1, f) != 1) return false;
-    if (fwrite(&vlen, 4, 1, f) != 1) return false;
-    if (fwrite(&op.expire_at, 8, 1, f) != 1) return false;
-    if (klen && fwrite(op.key.data(), 1, klen, f) != klen) return false;
-    if (vlen && fwrite(op.value.data(), 1, vlen, f) != vlen) return false;
+    out.append(reinterpret_cast<const char*>(&op.kind), 1);
+    out.append(reinterpret_cast<const char*>(&klen), 4);
+    out.append(reinterpret_cast<const char*>(&vlen), 4);
+    out.append(reinterpret_cast<const char*>(&op.expire_at), 8);
+    out.append(op.key);
+    out.append(op.value);
   }
-  return true;
+}
+
+bool write_record(FILE* f, uint64_t ts, const std::vector<AppliedOp>& ops) {
+  std::string rec;
+  serialize_record(rec, ts, ops);
+  return fwrite(rec.data(), 1, rec.size(), f) == rec.size();
+}
+
+// Append pre-serialized record bytes to the WAL with the
+// rollback-on-failure contract every commit site shares: a failed append
+// truncates back to the record start so an acknowledged write is always
+// replayable. Returns false on failure (caller must fail the commit).
+bool append_wal_raw(Store* st, const std::string& rec) {
+  if (st->wal == nullptr) return true;
+  long rec_start = ftell(st->wal);
+  bool logged = fwrite(rec.data(), 1, rec.size(), st->wal) == rec.size();
+  if (logged) logged = fflush(st->wal) == 0;
+  if (logged && st->fsync_commits) {
+#ifdef __unix__
+    logged = fsync(fileno(st->wal)) == 0;
+#endif
+  }
+  if (!logged) {
+    fflush(st->wal);
+#ifdef __unix__
+    if (rec_start >= 0 && ftruncate(fileno(st->wal), rec_start) == 0) {
+      fseek(st->wal, rec_start, SEEK_SET);
+    }
+#endif
+  }
+  return logged;
+}
+
+// Serialize once, WAL-append; rec_out survives for the replication hook
+// (fire AFTER the memory mutation so followers never see a commit the
+// primary itself could still roll back).
+bool log_commit(Store* st, uint64_t ts, const std::vector<AppliedOp>& ops,
+                std::string* rec_out) {
+  serialize_record(*rec_out, ts, ops);
+  return append_wal_raw(st, *rec_out);
+}
+
+void fire_hook(Store* st, const std::string& rec, uint64_t ts) {
+  if (st->hook != nullptr) {
+    st->hook(st->hook_ctx, reinterpret_cast<const uint8_t*>(rec.data()),
+             rec.size(), ts);
+  }
 }
 
 // Replay records with ts > min_ts (records at or below min_ts are already
@@ -277,6 +333,114 @@ uint64_t kb_tso(void* s) {
   return st->ts;
 }
 
+// ------------------------------------------------------------- replication
+// (kbstored's WAL-shipping follower tier; the raft-replication role of the
+// reference's TiKV layer, tikv.go:123-153.)
+
+void kb_set_commit_hook(void* s, kb_commit_cb cb, void* ctx) {
+  Store* st = static_cast<Store*>(s);
+  std::unique_lock<std::shared_mutex> lock(st->mu);
+  st->hook = cb;
+  st->hook_ctx = ctx;
+}
+
+// Apply one serialized WAL record received from a replication stream.
+// reset=1 clears existing state first (full-dump bootstrap) and writes a
+// fresh snapshot so pre-dump keys can never resurface from this store's own
+// older snapshot on restart. Idempotent: records at or below the current
+// clock are skipped (rc 3). rc: 0 applied, 1 malformed, 2 wal/checkpoint
+// failure, 3 stale/duplicate. *applied_ts is the store clock after the call.
+int kb_apply_record(void* s, const uint8_t* rec, size_t len, int reset,
+                    uint64_t* applied_ts) {
+  Store* st = static_cast<Store*>(s);
+  // parse (bounds-checked) before taking the lock
+  if (len < 16) return 1;
+  uint32_t magic, nops;
+  uint64_t ts;
+  memcpy(&magic, rec, 4);
+  memcpy(&ts, rec + 4, 8);
+  memcpy(&nops, rec + 12, 4);
+  if (magic != kWalMagic) return 1;
+  if (nops > (len - 16) / 17) return 1;  // cheap bound before reserve
+  size_t off = 16;
+  std::vector<AppliedOp> ops;
+  ops.reserve(nops);
+  for (uint32_t i = 0; i < nops; ++i) {
+    if (off + 17 > len) return 1;
+    AppliedOp op;
+    uint32_t klen, vlen;
+    op.kind = rec[off];
+    memcpy(&klen, rec + off + 1, 4);
+    memcpy(&vlen, rec + off + 5, 4);
+    memcpy(&op.expire_at, rec + off + 9, 8);
+    off += 17;
+    if (off + static_cast<size_t>(klen) + vlen > len) return 1;
+    op.key.assign(reinterpret_cast<const char*>(rec + off), klen);
+    off += klen;
+    op.value.assign(reinterpret_cast<const char*>(rec + off), vlen);
+    off += vlen;
+    ops.push_back(std::move(op));
+  }
+
+  std::unique_lock<std::shared_mutex> lock(st->mu);
+  if (!reset && ts <= st->ts) {
+    if (applied_ts != nullptr) *applied_ts = st->ts;
+    return 3;
+  }
+  if (reset) {
+    st->data.clear();
+    st->ts = 0;
+  } else {
+    // stream records go through this store's own WAL first (same
+    // durability contract as a local commit)
+    std::string raw(reinterpret_cast<const char*>(rec), len);
+    if (!append_wal_raw(st, raw)) return 2;
+  }
+  for (const AppliedOp& a : ops) {
+    Version v;
+    v.ts = ts;
+    v.deleted = a.kind == 1;
+    v.expire_at = a.expire_at;
+    v.value = a.value;
+    st->data[a.key].push_back(std::move(v));
+  }
+  st->ts = ts;
+  if (reset && !st->dir.empty()) {
+    if (checkpoint_locked(st) != 0) return 2;
+  }
+  if (applied_ts != nullptr) *applied_ts = st->ts;
+  return 0;
+}
+
+// Serialize the latest-only live state as ONE wal record at the current
+// clock (the follower-bootstrap dump — same shape checkpoint_locked
+// persists). Caller frees *out with kb_free.
+int kb_dump_wire(void* s, uint8_t** out, size_t* out_len, uint64_t* ts_out) {
+  Store* st = static_cast<Store*>(s);
+  std::shared_lock<std::shared_mutex> lock(st->mu);
+  double now = wallclock();
+  std::vector<AppliedOp> ops;
+  ops.reserve(st->data.size());
+  for (const auto& entry : st->data) {
+    const std::string* v = st->live(entry.first, st->ts, now);
+    if (v == nullptr) continue;
+    AppliedOp op;
+    op.kind = 0;
+    op.key = entry.first;
+    op.value = *v;
+    op.expire_at = entry.second.back().expire_at;
+    ops.push_back(std::move(op));
+  }
+  std::string rec;
+  serialize_record(rec, st->ts, ops);
+  *out = static_cast<uint8_t*>(malloc(rec.size()));
+  if (*out == nullptr) return 1;
+  memcpy(*out, rec.data(), rec.size());
+  *out_len = rec.size();
+  *ts_out = st->ts;
+  return 0;
+}
+
 // Point get at a snapshot (snap = 0 means latest). Returns 0 and copies the
 // value into a malloc'd buffer on hit; 1 on miss.
 int kb_get(void* s, const uint8_t* key, size_t klen, uint64_t snap,
@@ -393,27 +557,10 @@ int kb_batch_commit(void* b, int64_t* conflict_idx, uint8_t** conflict_val,
   // write-ahead: the record hits the log before memory state mutates; a
   // failed append rolls the log back to the record start and FAILS the
   // commit (rc 2) — an acknowledged write must be replayable
-  if (st->wal != nullptr) {
-    long rec_start = ftell(st->wal);
-    bool logged = write_record(st->wal, ts, applied);
-    if (logged) logged = fflush(st->wal) == 0;
-    if (logged && st->fsync_commits) {
-#ifdef __unix__
-      logged = fsync(fileno(st->wal)) == 0;
-#endif
-    }
-    if (!logged) {
-      fflush(st->wal);
-#ifdef __unix__
-      if (rec_start >= 0) {
-        if (ftruncate(fileno(st->wal), rec_start) == 0) {
-          fseek(st->wal, rec_start, SEEK_SET);
-        }
-      }
-#endif
-      --st->ts;  // the failed commit's timestamp was never observable
-      return 2;
-    }
+  std::string rec;
+  if (!log_commit(st, ts, applied, &rec)) {
+    --st->ts;  // the failed commit's timestamp was never observable
+    return 2;
   }
   for (const AppliedOp& a : applied) {
     Version v;
@@ -423,6 +570,7 @@ int kb_batch_commit(void* b, int64_t* conflict_idx, uint8_t** conflict_val,
     v.value = a.value;
     st->data[a.key].push_back(std::move(v));
   }
+  fire_hook(st, rec, ts);
   return 0;
 }
 
@@ -486,27 +634,10 @@ uint64_t kb_bulk_gc(void* s,
   }
   if (applied.empty()) return 0;
   uint64_t ts = ++st->ts;
-  if (st->wal != nullptr) {
-    long rec_start = ftell(st->wal);
-    bool logged = write_record(st->wal, ts, applied);
-    if (logged) logged = fflush(st->wal) == 0;
-    if (logged && st->fsync_commits) {
-#ifdef __unix__
-      logged = fsync(fileno(st->wal)) == 0;
-#endif
-    }
-    if (!logged) {
-      fflush(st->wal);
-#ifdef __unix__
-      if (rec_start >= 0) {
-        if (ftruncate(fileno(st->wal), rec_start) == 0) {
-          fseek(st->wal, rec_start, SEEK_SET);
-        }
-      }
-#endif
-      --st->ts;
-      return UINT64_MAX;
-    }
+  std::string rec;
+  if (!log_commit(st, ts, applied, &rec)) {
+    --st->ts;
+    return UINT64_MAX;
   }
   for (const AppliedOp& a : applied) {
     Version v;
@@ -515,6 +646,7 @@ uint64_t kb_bulk_gc(void* s,
     v.expire_at = 0;
     st->data[a.key].push_back(std::move(v));
   }
+  fire_hook(st, rec, ts);
   return rec_deleted;
 }
 
@@ -707,25 +839,10 @@ int kb_mvcc_write(void* s,
   applied[2].key.assign(reinterpret_cast<const char*>(last_key), lkl);
   applied[2].value.assign(reinterpret_cast<const char*>(last_val), lvl);
   applied[2].expire_at = 0;
-  if (st->wal != nullptr) {
-    long rec_start = ftell(st->wal);
-    bool logged = write_record(st->wal, ts, applied);
-    if (logged) logged = fflush(st->wal) == 0;
-    if (logged && st->fsync_commits) {
-#ifdef __unix__
-      logged = fsync(fileno(st->wal)) == 0;
-#endif
-    }
-    if (!logged) {
-      fflush(st->wal);
-#ifdef __unix__
-      if (rec_start >= 0 && ftruncate(fileno(st->wal), rec_start) == 0) {
-        fseek(st->wal, rec_start, SEEK_SET);
-      }
-#endif
-      --st->ts;
-      return 2;
-    }
+  std::string rec;
+  if (!log_commit(st, ts, applied, &rec)) {
+    --st->ts;
+    return 2;
   }
   for (AppliedOp& a : applied) {
     Version v;
@@ -735,6 +852,7 @@ int kb_mvcc_write(void* s,
     v.value = std::move(a.value);
     st->data[a.key].push_back(std::move(v));
   }
+  fire_hook(st, rec, ts);
   return 0;
 }
 
@@ -804,24 +922,10 @@ int kb_mvcc_delete(void* s,
   applied[2].key.assign(reinterpret_cast<const char*>(last_key), lkl);
   applied[2].value.assign(reinterpret_cast<const char*>(last_val), lvl);
   applied[2].expire_at = 0;
-  if (st->wal != nullptr) {
-    long rec_start = ftell(st->wal);
-    bool logged = write_record(st->wal, ts, applied);
-    if (logged) logged = fflush(st->wal) == 0;
-    if (logged && st->fsync_commits) {
-#ifdef __unix__
-      logged = fsync(fileno(st->wal)) == 0;
-#endif
-    }
-    if (!logged) {
-#ifdef __unix__
-      if (rec_start >= 0 && ftruncate(fileno(st->wal), rec_start) == 0) {
-        fseek(st->wal, rec_start, SEEK_SET);
-      }
-#endif
-      --st->ts;
-      return 3;
-    }
+  std::string rec;
+  if (!log_commit(st, ts, applied, &rec)) {
+    --st->ts;
+    return 3;
   }
   for (AppliedOp& a : applied) {
     Version v;
@@ -831,6 +935,7 @@ int kb_mvcc_delete(void* s,
     v.value = std::move(a.value);
     st->data[a.key].push_back(std::move(v));
   }
+  fire_hook(st, rec, ts);
   return 0;
 }
 

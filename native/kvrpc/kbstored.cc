@@ -41,6 +41,14 @@
 //                keys u8[n*kw] | lens i32[n] | revs u64[n] | tomb u8[n] |
 //                u64 alen | arena | offsets u64[n+1]. Paged by rows AND by
 //                a 32 MB arena cap; resume with start = next_start.
+//  11 REPL_HELLO u64 follower_ts -> u8 need_dump [| dump record]; marks the
+//                conn as a replica stream: committed WAL records are pushed
+//                to it as frames with req_id=0 (semi-sync: client write
+//                ACKs are held until every replica acks the record or the
+//                KB_REPL_TIMEOUT_MS deadline detaches stalled replicas)
+//  12 REPL_ACK   u64 ts (fire-and-forget, replica -> primary)
+//  13 PROMOTE    -   follower becomes primary (idempotent on a primary)
+//  14 ROLE       -   -> u8 is_follower | u64 ts | u32 n_replicas
 //
 // Scan paging is client-driven (stateless server): 'more' set when the page
 // cap truncated a forward scan; the client re-issues from last_key+\0.
@@ -56,10 +64,13 @@
 #include <sys/socket.h>
 #include <unistd.h>
 
+#include <time.h>
+
 #include <cstdint>
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
+#include <deque>
 #include <string>
 #include <unordered_map>
 #include <vector>
@@ -116,13 +127,21 @@ int kb_mvcc_export_wire(void *s, const uint8_t *start, size_t slen,
                         const uint8_t *tombstone, size_t tomb_len,
                         uint64_t key_width, uint64_t max_rows,
                         uint64_t arena_cap, uint8_t **out, size_t *out_len);
+typedef void (*kb_commit_cb)(void *ctx, const uint8_t *rec, size_t len,
+                             uint64_t ts);
+void kb_set_commit_hook(void *s, kb_commit_cb cb, void *ctx);
+int kb_apply_record(void *s, const uint8_t *rec, size_t len, int reset,
+                    uint64_t *applied_ts);
+int kb_dump_wire(void *s, uint8_t **out, size_t *out_len, uint64_t *ts_out);
 }
 
 namespace {
 
 constexpr uint8_t OP_GET = 1, OP_TSO = 2, OP_BATCH = 3, OP_SCAN = 4,
                   OP_PARTITIONS = 5, OP_MVCC_WRITE = 6, OP_MVCC_DELETE = 7,
-                  OP_CHECKPOINT = 8, OP_INFO = 9, OP_EXPORT = 10;
+                  OP_CHECKPOINT = 8, OP_INFO = 9, OP_EXPORT = 10,
+                  OP_REPL_HELLO = 11, OP_REPL_ACK = 12, OP_PROMOTE = 13,
+                  OP_ROLE = 14;
 constexpr uint64_t EXPORT_ARENA_CAP = 32u << 20;
 constexpr uint8_t ST_OK = 0, ST_NOT_FOUND = 1, ST_CONFLICT = 2, ST_WAL = 3,
                   ST_DRIFT = 4, ST_ERROR = 5;
@@ -428,15 +447,123 @@ struct SConn {
   int fd;
   std::string in;
   std::string out;
+  // 0 = client, 1 = downstream replica (a follower's stream, primary side),
+  // 2 = upstream link (this process IS a follower; conn to its primary)
+  uint8_t kind = 0;
+  bool zombie = false;  // doomed; freed after the current events batch
+  uint64_t acked = 0;   // kind 1: highest record ts the replica acked
 };
 
 int g_epfd = -1;
+
+// ---- replication state (see README/storage docs: semi-sync WAL shipping;
+// the reference's TiKV is raft-replicated, tikv.go:123-153 — this tier
+// replicates the kbstore WAL to followers and defers write ACKs until the
+// attached follower has durably applied the record, MySQL-semi-sync style;
+// with no follower attached it degrades to standalone acking).
+bool g_follower = false;          // this process serves read-only + applies
+std::string g_up_host;            // follower: primary address
+int g_up_port = 0;
+SConn *g_upstream = nullptr;      // follower: live link to primary
+uint64_t g_up_retry_ms = 0;       // follower: next reconnect time
+std::vector<SConn *> g_replicas;  // primary: attached follower streams
+
+struct Pending {  // a client write response held until the replica acks
+  SConn *conn;    // nulled if the client disconnects first
+  uint64_t req_id;
+  uint8_t status;
+  std::string body;
+  uint64_t ts;      // commit ts the replica must ack
+  uint64_t t_ms;    // enqueue time (ack-timeout accounting)
+};
+std::deque<Pending> g_pending;
+int g_ack_timeout_ms = 2000;  // KB_REPL_TIMEOUT_MS
+
+std::string g_commit_rec;  // set by the commit hook during handle_op
+uint64_t g_commit_ts = 0;
+
+uint64_t now_ms() {
+  timespec tsp{};
+  clock_gettime(CLOCK_MONOTONIC, &tsp);
+  return static_cast<uint64_t>(tsp.tv_sec) * 1000 +
+         static_cast<uint64_t>(tsp.tv_nsec) / 1000000;
+}
+
+void commit_hook(void *, const uint8_t *rec, size_t len, uint64_t ts) {
+  if (!g_replicas.empty()) {
+    g_commit_rec.assign(reinterpret_cast<const char *>(rec), len);
+    g_commit_ts = ts;
+  }
+}
 
 void conn_update(SConn *c) {
   epoll_event ev{};
   ev.events = EPOLLIN | (c->out.empty() ? 0u : static_cast<uint32_t>(EPOLLOUT));
   ev.data.ptr = c;
   epoll_ctl(g_epfd, EPOLL_CTL_MOD, c->fd, &ev);
+}
+
+void append_response(SConn *c, uint64_t req_id, uint8_t status,
+                     const std::string &body) {
+  uint32_t rlen = static_cast<uint32_t>(body.size());
+  c->out.append(reinterpret_cast<char *>(&rlen), 4);
+  c->out.append(reinterpret_cast<char *>(&req_id), 8);
+  c->out.push_back(static_cast<char>(status));
+  c->out.append(body);
+}
+
+// Release pending client responses covered by every replica's ack floor
+// (or all of them when the last replica detached — degraded mode).
+void release_pending() {
+  uint64_t floor = UINT64_MAX;
+  for (SConn *r : g_replicas) floor = r->acked < floor ? r->acked : floor;
+  while (!g_pending.empty() &&
+         (g_replicas.empty() || g_pending.front().ts <= floor)) {
+    Pending &p = g_pending.front();
+    if (p.conn != nullptr) {
+      append_response(p.conn, p.req_id, p.status, p.body);
+      conn_update(p.conn);
+    }
+    g_pending.pop_front();
+  }
+}
+
+// Ship a committed record to every attached replica (push framing:
+// req_id 0, status OK, body = the WAL record bytes).
+void broadcast_record(const std::string &rec) {
+  for (SConn *r : g_replicas) {
+    append_response(r, 0, ST_OK, rec);
+    conn_update(r);
+  }
+}
+
+void drop_replica(SConn *c) {
+  for (size_t i = 0; i < g_replicas.size(); ++i) {
+    if (g_replicas[i] == c) {
+      g_replicas.erase(g_replicas.begin() + static_cast<long>(i));
+      break;
+    }
+  }
+  release_pending();  // no replicas left -> flush everything
+}
+
+// Deferred teardown: a conn referenced by the epoll events batch currently
+// being processed must NOT be freed mid-batch (use-after-free) — doom it,
+// the main loop skips zombies and reaps the graveyard after the batch.
+std::vector<SConn *> g_graveyard;
+
+void doom_conn(SConn *c) {
+  if (c->zombie) return;
+  c->zombie = true;
+  epoll_ctl(g_epfd, EPOLL_CTL_DEL, c->fd, nullptr);
+  if (c->kind == 1) drop_replica(c);
+  if (c == g_upstream) g_upstream = nullptr;
+  if (c->kind == 0) {
+    for (Pending &p : g_pending) {
+      if (p.conn == c) p.conn = nullptr;
+    }
+  }
+  g_graveyard.push_back(c);
 }
 
 bool conn_flush(SConn *c) {
@@ -456,6 +583,82 @@ bool conn_flush(SConn *c) {
 
 constexpr uint32_t MAX_FRAME = 64u << 20;  // one conn cannot OOM the daemon
 
+bool is_write_op(uint8_t op) {
+  return op == OP_BATCH || op == OP_MVCC_WRITE || op == OP_MVCC_DELETE;
+}
+
+// Replication control ops need the connection identity, so they are
+// dispatched here rather than in handle_op. Returns true when a response
+// frame was (or will be) produced by this function.
+void handle_repl_op(SConn *c, uint8_t op, Reader &r, uint64_t req_id) {
+  if (op == OP_REPL_ACK) {  // fire-and-forget from a replica
+    uint64_t ts = r.num<uint64_t>();
+    if (r.ok && c->kind == 1 && ts > c->acked) {
+      c->acked = ts;
+      release_pending();
+    }
+    return;
+  }
+  std::string body;
+  uint8_t status = ST_OK;
+  if (op == OP_ROLE) {
+    put_u8(body, g_follower ? 1 : 0);
+    put_num<uint64_t>(body, kb_tso(g_store));
+    put_num<uint32_t>(body, static_cast<uint32_t>(g_replicas.size()));
+  } else if (op == OP_PROMOTE) {
+    if (g_follower) {
+      g_follower = false;
+      if (g_upstream != nullptr) {
+        doom_conn(g_upstream);  // reaped after the current events batch
+      }
+      fprintf(stderr, "[kbstored] PROMOTED to primary at ts=%llu\n",
+              static_cast<unsigned long long>(kb_tso(g_store)));
+    }
+  } else if (op == OP_REPL_HELLO) {
+    uint64_t fts = r.num<uint64_t>();
+    uint64_t myts = kb_tso(g_store);
+    if (!r.ok) {
+      status = ST_ERROR;
+      body = "malformed hello";
+    } else if (g_follower) {
+      status = ST_ERROR;
+      body = "not a primary (follower cannot feed replicas)";
+    } else if (fts > myts) {
+      // divergent lineage — refusing is the safe answer (raft would have
+      // made this impossible; this tier documents it loudly instead).
+      // ST_DRIFT marks it FATAL for the follower; other rejections (not a
+      // primary yet, dump failure) are transient and retried.
+      status = ST_DRIFT;
+      body = "follower ahead of primary";
+    } else {
+      c->kind = 1;
+      c->acked = fts;
+      g_replicas.push_back(c);
+      if (fts < myts) {
+        uint8_t *dump = nullptr;
+        size_t dlen = 0;
+        uint64_t dts = 0;
+        if (kb_dump_wire(g_store, &dump, &dlen, &dts) == 0) {
+          put_u8(body, 1);
+          body.append(reinterpret_cast<char *>(dump), dlen);
+          kb_free(dump);
+        } else {
+          drop_replica(c);
+          c->kind = 0;
+          status = ST_ERROR;
+          body = "dump failed";
+        }
+      } else {
+        put_u8(body, 0);
+      }
+      fprintf(stderr, "[kbstored] replica attached (follower_ts=%llu my_ts=%llu)\n",
+              static_cast<unsigned long long>(fts),
+              static_cast<unsigned long long>(myts));
+    }
+  }
+  append_response(c, req_id, status, body);
+}
+
 // returns false when the connection must be dropped (oversized frame)
 bool conn_ingest(SConn *c) {
   size_t off = 0;
@@ -468,17 +671,139 @@ bool conn_ingest(SConn *c) {
     uint8_t op = static_cast<uint8_t>(c->in[off + 12]);
     if (c->in.size() - off - 13 < blen) break;
     Reader r{c->in.data() + off + 13, blen};
+    if (op >= OP_REPL_HELLO && op <= OP_ROLE) {
+      handle_repl_op(c, op, r, req_id);
+      off += 13 + blen;
+      continue;
+    }
     std::string body;
-    uint8_t status = handle_op(op, r, body);
-    uint32_t rlen = static_cast<uint32_t>(body.size());
-    c->out.append(reinterpret_cast<char *>(&rlen), 4);
-    c->out.append(reinterpret_cast<char *>(&req_id), 8);
-    c->out.push_back(static_cast<char>(status));
-    c->out.append(body);
+    uint8_t status;
+    if (g_follower && is_write_op(op)) {
+      body = "read-only follower (promote or write to the primary)";
+      status = ST_ERROR;
+    } else {
+      status = handle_op(op, r, body);
+    }
     off += 13 + blen;
+    // semi-sync: a commit happened and replicas are attached — hold the
+    // client's response until every replica acks the record
+    if (!g_commit_rec.empty()) {
+      broadcast_record(g_commit_rec);
+      g_pending.push_back(
+          {c, req_id, status, std::move(body), g_commit_ts, now_ms()});
+      g_commit_rec.clear();
+      continue;
+    }
+    append_response(c, req_id, status, body);
   }
   c->in.erase(0, off);
   return c->in.size() <= MAX_FRAME + 13;
+}
+
+// --------------------------------------------------- follower upstream link
+// The follower's connection to its primary lives in the same epoll loop.
+// It speaks the client side of the protocol: one HELLO request, then an
+// endless stream of pushed records (response frames with req_id 0), each
+// answered with an OP_REPL_ACK request frame.
+
+void upstream_send_ack(SConn *c, uint64_t ts) {
+  uint32_t blen = 8;
+  uint64_t req_id = 0;
+  c->out.append(reinterpret_cast<char *>(&blen), 4);
+  c->out.append(reinterpret_cast<char *>(&req_id), 8);
+  c->out.push_back(static_cast<char>(OP_REPL_ACK));
+  c->out.append(reinterpret_cast<char *>(&ts), 8);
+}
+
+// Parse pushed frames from the primary; false = drop the link and retry.
+bool upstream_ingest(SConn *c) {
+  size_t off = 0;
+  bool ok = true;
+  while (ok && c->in.size() - off >= 13) {
+    uint32_t blen;
+    uint64_t req_id;
+    memcpy(&blen, c->in.data() + off, 4);
+    memcpy(&req_id, c->in.data() + off + 4, 8);
+    uint8_t status = static_cast<uint8_t>(c->in[off + 12]);
+    if (c->in.size() - off - 13 < blen) break;
+    const uint8_t *body =
+        reinterpret_cast<const uint8_t *>(c->in.data() + off + 13);
+    if (req_id == 1) {  // HELLO response
+      if (status != ST_OK || blen < 1) {
+        fprintf(stderr, "[kbstored] upstream rejected hello (status %u): %.*s\n",
+                status, static_cast<int>(blen), body);
+        if (status == ST_DRIFT) {
+          // divergent lineage is unrecoverable without operator action
+          exit(3);
+        }
+        ok = false;  // transient (target not yet primary?) — retry later
+        break;
+      }
+      if (body[0] == 1) {  // bootstrap dump
+        uint64_t ats = 0;
+        int rc = kb_apply_record(g_store, body + 1, blen - 1, 1, &ats);
+        if (rc != 0) {
+          fprintf(stderr, "[kbstored] dump apply failed rc=%d\n", rc);
+          ok = false;
+        } else {
+          upstream_send_ack(c, ats);
+          fprintf(stderr, "[kbstored] bootstrapped from primary at ts=%llu\n",
+                  static_cast<unsigned long long>(ats));
+        }
+      }
+    } else if (req_id == 0 && status == ST_OK) {  // replication record
+      uint64_t ats = 0;
+      int rc = kb_apply_record(g_store, body, blen, 0, &ats);
+      if (rc == 0 || rc == 3) {
+        upstream_send_ack(c, ats);
+      } else {
+        fprintf(stderr, "[kbstored] record apply failed rc=%d; resyncing\n", rc);
+        ok = false;  // reconnect -> HELLO -> dump resync
+      }
+    }
+    off += 13 + blen;
+  }
+  c->in.erase(0, off);
+  return ok;
+}
+
+void upstream_connect() {
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(static_cast<uint16_t>(g_up_port));
+  // non-blocking BEFORE connect: a partitioned primary (SYNs dropped) must
+  // not freeze the whole single-threaded reactor for the kernel's connect
+  // timeout on every retry tick. EINPROGRESS resolves through epoll: the
+  // queued HELLO flushes on EPOLLOUT, failure surfaces as EPOLLERR/HUP.
+  fcntl(fd, F_SETFL, fcntl(fd, F_GETFL, 0) | O_NONBLOCK);
+  if (inet_pton(AF_INET, g_up_host.c_str(), &addr.sin_addr) != 1) {
+    close(fd);
+    return;
+  }
+  if (connect(fd, reinterpret_cast<sockaddr *>(&addr), sizeof addr) != 0 &&
+      errno != EINPROGRESS) {
+    close(fd);
+    return;  // retried on the next timeout tick
+  }
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
+  SConn *c = new SConn();
+  c->fd = fd;
+  c->kind = 2;
+  // HELLO (req_id 1): my clock; primary dumps if it is ahead
+  uint64_t myts = kb_tso(g_store);
+  uint32_t blen = 8;
+  uint64_t req_id = 1;
+  c->out.append(reinterpret_cast<char *>(&blen), 4);
+  c->out.append(reinterpret_cast<char *>(&req_id), 8);
+  c->out.push_back(static_cast<char>(OP_REPL_HELLO));
+  c->out.append(reinterpret_cast<char *>(&myts), 8);
+  epoll_event ev{};
+  ev.events = EPOLLIN | EPOLLOUT;
+  ev.data.ptr = c;
+  epoll_ctl(g_epfd, EPOLL_CTL_ADD, fd, &ev);
+  g_upstream = c;
 }
 
 }  // namespace
@@ -486,8 +811,9 @@ bool conn_ingest(SConn *c) {
 int main(int argc, char **argv) {
   if (argc < 2) {
     fprintf(stderr,
-            "usage: kbstored <port> [data-dir] [--fsync] [host]\n"
-            "  data-dir '' or '-' = in-memory\n");
+            "usage: kbstored <port> [data-dir] [--fsync] [--follow host:port] "
+            "[host]\n  data-dir '' or '-' = in-memory\n"
+            "  --follow: start as a read-only replica of the given primary\n");
     return 1;
   }
   signal(SIGPIPE, SIG_IGN);
@@ -496,17 +822,30 @@ int main(int argc, char **argv) {
   bool fsync_commits = false;
   const char *host = "127.0.0.1";
   for (int i = 3; i < argc; i++) {
-    if (strcmp(argv[i], "--fsync") == 0)
+    if (strcmp(argv[i], "--fsync") == 0) {
       fsync_commits = true;
-    else
+    } else if (strcmp(argv[i], "--follow") == 0 && i + 1 < argc) {
+      const char *colon = strrchr(argv[++i], ':');
+      if (colon == nullptr) {
+        fprintf(stderr, "[kbstored] --follow needs host:port\n");
+        return 1;
+      }
+      g_up_host.assign(argv[i], static_cast<size_t>(colon - argv[i]));
+      g_up_port = atoi(colon + 1);
+      g_follower = true;
+    } else {
       host = argv[i];
+    }
   }
+  const char *to_env = getenv("KB_REPL_TIMEOUT_MS");
+  if (to_env != nullptr && atoi(to_env) > 0) g_ack_timeout_ms = atoi(to_env);
   if (dir[0] == '-' && dir[1] == '\0') dir = "";
   g_store = dir[0] ? kb_open_at(dir, fsync_commits ? 1 : 0) : kb_open();
   if (g_store == nullptr) {
     fprintf(stderr, "[kbstored] failed to open store at %s\n", dir);
     return 1;
   }
+  kb_set_commit_hook(g_store, commit_hook, nullptr);
 
   int lfd = socket(AF_INET, SOCK_STREAM, 0);
   int one = 1;
@@ -531,19 +870,46 @@ int main(int argc, char **argv) {
   ev.data.ptr = nullptr;  // listener marker
   epoll_ctl(g_epfd, EPOLL_CTL_ADD, lfd, &ev);
 
-  fprintf(stderr, "[kbstored] serving %s:%d (dir=%s fsync=%d)\n", host, port,
-          dir[0] ? dir : "<memory>", fsync_commits ? 1 : 0);
+  fprintf(stderr, "[kbstored] serving %s:%d (dir=%s fsync=%d role=%s)\n", host,
+          port, dir[0] ? dir : "<memory>", fsync_commits ? 1 : 0,
+          g_follower ? "follower" : "primary");
   printf("READY\n");
   fflush(stdout);
 
   std::vector<char> buf(1 << 18);
   epoll_event events[128];
   while (true) {
-    int n = epoll_wait(g_epfd, events, 128, -1);
+    int timeout = -1;
+    if (!g_pending.empty())
+      timeout = 50;
+    else if (g_follower && g_upstream == nullptr)
+      timeout = 200;
+    int n = epoll_wait(g_epfd, events, 128, timeout);
     if (n < 0) {
       if (errno == EINTR) continue;
       perror("epoll_wait");
       return 1;
+    }
+    // timeout-driven maintenance: follower reconnect + replica ack timeout
+    uint64_t now = now_ms();
+    if (g_follower && g_upstream == nullptr && now >= g_up_retry_ms) {
+      upstream_connect();
+      g_up_retry_ms = now + 500;
+    }
+    if (!g_pending.empty() &&
+        now - g_pending.front().t_ms > static_cast<uint64_t>(g_ack_timeout_ms)) {
+      // detach only the replicas actually holding the ack floor back;
+      // healthy replicas keep the semi-sync guarantee alive
+      uint64_t want = g_pending.front().ts;
+      std::vector<SConn *> stalled;
+      for (SConn *rc : g_replicas) {
+        if (rc->acked < want) stalled.push_back(rc);
+      }
+      fprintf(stderr,
+              "[kbstored] replica ack timeout (%dms): detaching %zu of %zu "
+              "replica(s)\n",
+              g_ack_timeout_ms, stalled.size(), g_replicas.size());
+      for (SConn *rc : stalled) doom_conn(rc);  // drop_replica + release
     }
     for (int i = 0; i < n; i++) {
       if (events[i].data.ptr == nullptr) {
@@ -562,6 +928,7 @@ int main(int argc, char **argv) {
         continue;
       }
       SConn *c = static_cast<SConn *>(events[i].data.ptr);
+      if (c->zombie) continue;  // doomed earlier in this batch
       bool dead = false;
       if (events[i].events & (EPOLLHUP | EPOLLERR)) dead = true;
       if (!dead && (events[i].events & EPOLLIN)) {
@@ -577,18 +944,22 @@ int main(int argc, char **argv) {
           }
         }
         if (!dead) {
-          if (!conn_ingest(c)) dead = true;
+          bool ok = c->kind == 2 ? upstream_ingest(c) : conn_ingest(c);
+          if (c->zombie) continue;  // doomed by its own op (e.g. PROMOTE)
+          if (!ok) dead = true;
           else if (!conn_flush(c)) dead = true;
         }
       }
-      if (!dead && (events[i].events & EPOLLOUT)) {
+      if (!dead && !c->zombie && (events[i].events & EPOLLOUT)) {
         if (!conn_flush(c)) dead = true;
       }
-      if (dead) {
-        epoll_ctl(g_epfd, EPOLL_CTL_DEL, c->fd, nullptr);
-        close(c->fd);
-        delete c;
-      }
+      if (dead) doom_conn(c);
     }
+    // reap the graveyard now that no events[] entry can reference them
+    for (SConn *z : g_graveyard) {
+      close(z->fd);
+      delete z;
+    }
+    g_graveyard.clear();
   }
 }
