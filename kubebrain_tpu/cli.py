@@ -30,6 +30,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--storage-address", default="127.0.0.1:2389",
                    help="kbstored address for --storage=remote; comma-"
                         "separated primary,follower,... enables failover()")
+    p.add_argument("--storage-read-followers", action="store_true",
+                   help="route snapshot-pinned reads to kbstored followers "
+                        "(tier-level read scaling; falls back to the "
+                        "primary on replica lag)")
     p.add_argument("--storage-pool", type=int, default=8,
                    help="connection pool size to kbstored (reference keeps "
                         "200 round-robin TiKV clients, tikv.go:36-82)")
@@ -147,7 +151,8 @@ def build_endpoint(args):
         elif args.inner_storage == "remote":
             # the composed production topology: TPU data plane over the
             # shared kbstored tier (reference: scanner over TiKV partitions)
-            inner_kw = {"address": args.storage_address, "pool": args.storage_pool}
+            inner_kw = {"address": args.storage_address, "pool": args.storage_pool,
+                        "read_followers": args.storage_read_followers}
         else:
             inner_kw = {}
         if args.use_pallas:
@@ -159,6 +164,7 @@ def build_endpoint(args):
         store = new_storage(
             "remote", address=args.storage_address, pool=args.storage_pool,
             partitions=args.native_partitions,
+            read_followers=args.storage_read_followers,
         )
     else:
         store = new_storage(args.storage)

@@ -133,14 +133,15 @@ class Server:
         election layer's) call; see README 'Tier replication'."""
         store = self.backend.store
         # unwrap decorators (metrics wrapper, tpu mirror) to the remote tier
-        for _ in range(4):
+        # — cycle-safe walk, same shape as the Defragment unwrap
+        # (server/etcd/misc.py)
+        seen: set[int] = set()
+        while store is not None and id(store) not in seen:
+            seen.add(id(store))
             if hasattr(store, "failover"):
                 break
-            nxt = getattr(store, "_inner", None)
-            if nxt is None:
-                break
-            store = nxt
-        if not hasattr(store, "failover"):
+            store = getattr(store, "_inner", None)
+        if store is None or not hasattr(store, "failover"):
             return "application/json", json.dumps(
                 {"error": "storage tier has no failover (not --storage=remote?)"}
             ).encode()

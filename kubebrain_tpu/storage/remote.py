@@ -200,7 +200,7 @@ class _PagedIter(Iter):
         body += struct.pack("<I", want)
         _bytes_field(body, self._start)
         _bytes_field(body, self._end)
-        status, payload = self._store._call(OP_SCAN, bytes(body))
+        status, payload = self._store._read_call(OP_SCAN, bytes(body), self._snap)
         if status != ST_OK:
             raise StorageError(f"scan failed (status {status}): {payload!r}")
         r = _Reader(payload)
@@ -241,7 +241,8 @@ class RemoteKvStorage(KvStorage):
     """KvStorage over a kbstored server (reference tikv.NewKvStorage)."""
 
     def __init__(self, address: str = "127.0.0.1:2389", pool: int = 8,
-                 timeout: float = 30.0, partitions: int = 4):
+                 timeout: float = 30.0, partitions: int = 4,
+                 read_followers: bool = False):
         # 30s default: kbstored serves ops from one reactor thread, so a
         # checkpoint or big scan page briefly stalls other connections — a
         # tight timeout would misclassify those stalls as uncertain writes.
@@ -260,6 +261,18 @@ class RemoteKvStorage(KvStorage):
         self._pool = [_PooledConn(self._address, timeout) for _ in range(pool)]
         self._rr = 0
         self._rr_lock = threading.Lock()
+        # follower read routing (tier-level read scaling, the storage-side
+        # analogue of the `wat` mesh axis): snapshot-PINNED reads can go to
+        # any replica that has applied the snapshot — the follower answers
+        # ST_DRIFT when asked for a snap beyond its clock and the read falls
+        # back to the primary. Lazy one-conn-per-follower pools.
+        self._read_followers = read_followers and len(self._addresses) > 1
+        # per-follower conn lists sized like the primary pool so routed
+        # reads keep the same in-flight parallelism (each _PooledConn
+        # serializes one request/response at a time)
+        self._fpool_size = max(1, pool)
+        self._fpools: dict[int, list[_PooledConn]] = {}
+        self._frr = 0
         # probe + cache engine facts
         status, payload = self._call(OP_INFO, b"")
         if status != ST_OK:
@@ -301,6 +314,51 @@ class RemoteKvStorage(KvStorage):
             new = self._heal(slot, conn)
             return new.call(op, body)
 
+    def _read_call(self, op: int, body: bytes, snapshot_ts: int) -> tuple[int, bytes]:
+        """Snapshot-pinned read: try a follower first (when enabled), fall
+        back to the primary on drift/any transport trouble. Reads without a
+        pinned snapshot go straight to the primary (read-your-writes)."""
+        if self._read_followers and snapshot_ts:
+            with self._rr_lock:
+                self._frr += 1
+                rr = self._frr
+                candidates = [i for i in range(len(self._addresses))
+                              if i != self._primary]
+                idx = candidates[rr % len(candidates)] if candidates else None
+            if idx is not None:
+                conn = None
+                try:
+                    conn = self._follower_conn(idx, rr)
+                    status, payload = conn.call(op, body)
+                    if status != ST_DRIFT:
+                        return status, payload
+                except (OSError, EOFError, StorageError):
+                    if conn is not None:
+                        with self._rr_lock:
+                            conns = self._fpools.get(idx)
+                            if conns and conn in conns:
+                                conns.remove(conn)
+                        conn.close()
+        return self._call(op, body)
+
+    def _follower_conn(self, idx: int, rr: int) -> _PooledConn:
+        """Pick (or lazily grow, up to the primary pool's size) a follower
+        connection; all list mutations happen under the lock so racing
+        growers never leak a socket."""
+        with self._rr_lock:
+            conns = self._fpools.setdefault(idx, [])
+            if len(conns) >= self._fpool_size:
+                return conns[rr % len(conns)]
+        new = _PooledConn(self._addresses[idx], self._timeout)
+        with self._rr_lock:
+            conns = self._fpools.setdefault(idx, [])
+            if len(conns) < self._fpool_size:
+                conns.append(new)
+                return new
+            keep = conns[rr % len(conns)]
+        new.close()
+        return keep
+
     def _write_call(self, op: int, body: bytes) -> tuple[int, bytes]:
         """Write-path transport: on failure the outcome is unknowable, but
         the dead socket must still be healed or a single server restart
@@ -334,8 +392,8 @@ class RemoteKvStorage(KvStorage):
         return [Partition(edges[i], edges[i + 1]) for i in range(len(edges) - 1)]
 
     def get(self, key: bytes, snapshot_ts: int | None = None) -> bytes:
-        status, payload = self._call(
-            OP_GET, struct.pack("<Q", snapshot_ts or 0) + key)
+        status, payload = self._read_call(
+            OP_GET, struct.pack("<Q", snapshot_ts or 0) + key, snapshot_ts or 0)
         if status == ST_NOT_FOUND:
             raise KeyNotFoundError(key)
         if status != ST_OK:
@@ -416,14 +474,22 @@ class RemoteKvStorage(KvStorage):
                 old, self._pool = self._pool, [
                     _PooledConn(addr, self._timeout) for _ in range(len(self._pool))
                 ]
+                old_f, self._fpools = self._fpools, {}
             for c in old:
                 c.close()
+            for conns in old_f.values():
+                for c in conns:
+                    c.close()
             return idx
         raise StorageError(f"no promotable follower reachable: {last_exc}")
 
     def close(self) -> None:
         for c in self._pool:
             c.close()
+        for conns in self._fpools.values():
+            for c in conns:
+                c.close()
+        self._fpools.clear()
 
     def export_mvcc(self, start: bytes, end: bytes, snapshot_ts: int,
                     key_width: int, magic: bytes, tombstone: bytes):

@@ -406,7 +406,16 @@ int kb_apply_record(void* s, const uint8_t* rec, size_t len, int reset,
   }
   st->ts = ts;
   if (reset && !st->dir.empty()) {
-    if (checkpoint_locked(st) != 0) return 2;
+    // the dump is durable only through this checkpoint (the reset path
+    // skips the WAL). On failure, roll the store back to empty/ts=0 so a
+    // reconnect HELLO carries fts=0 and the primary re-ships the dump —
+    // otherwise the follower would ack a lineage it can lose on restart.
+    if (checkpoint_locked(st) != 0) {
+      st->data.clear();
+      st->ts = 0;
+      if (applied_ts != nullptr) *applied_ts = 0;
+      return 2;
+    }
   }
   if (applied_ts != nullptr) *applied_ts = st->ts;
   return 0;

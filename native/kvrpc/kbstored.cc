@@ -57,6 +57,7 @@
 #include <arpa/inet.h>
 #include <errno.h>
 #include <fcntl.h>
+#include <netdb.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
 #include <signal.h>
@@ -189,9 +190,17 @@ void put_bytes(std::string &o, const void *p, size_t len) {
 
 // ------------------------------------------------------------ op handlers
 // Each returns (status, body).
+// A follower cannot serve a snapshot it has not applied yet: answering
+// "latest" for a future snap would silently time-travel the read. ST_DRIFT
+// (+ our clock) tells the client to retry on the primary. (Primaries never
+// see future snaps — the TSO lives there.) Defined with the replication
+// state below.
+bool follower_behind(uint64_t snap, std::string &body);
+
 uint8_t op_get(Reader &r, std::string &body) {
   uint64_t snap = r.num<uint64_t>();
   if (!r.ok) return ST_ERROR;
+  if (follower_behind(snap, body)) return ST_DRIFT;
   const char *key = r.p + r.off;
   size_t klen = r.n - r.off;
   uint8_t *out;
@@ -262,6 +271,7 @@ uint8_t op_scan(Reader &r, std::string &body) {
   std::string start = r.bytes();
   std::string end = r.bytes();
   if (!r.ok) return ST_ERROR;
+  if (follower_behind(snap, body)) return ST_DRIFT;
   uint32_t cap = limit && limit < SCAN_PAGE_CAP ? limit : SCAN_PAGE_CAP;
   // +1 row beyond the cap detects 'more'
   void *it = kb_iter_open(
@@ -384,6 +394,7 @@ uint8_t op_mvcc_delete(Reader &r, std::string &body) {
 
 uint8_t op_export(Reader &r, std::string &body) {
   uint64_t snap = r.num<uint64_t>();
+  if (follower_behind(snap, body)) return ST_DRIFT;
   uint64_t key_width = r.num<uint64_t>();
   uint32_t page_rows = r.num<uint32_t>();
   std::string magic = r.bytes();
@@ -496,6 +507,14 @@ void commit_hook(void *, const uint8_t *rec, size_t len, uint64_t ts) {
   }
 }
 
+bool follower_behind(uint64_t snap, std::string &body) {
+  if (!g_follower || snap == 0) return false;  // snap 0 = explicit "latest"
+  uint64_t ts = kb_tso(g_store);
+  if (snap <= ts) return false;
+  put_num<uint64_t>(body, ts);
+  return true;
+}
+
 void conn_update(SConn *c) {
   epoll_event ev{};
   ev.events = EPOLLIN | (c->out.empty() ? 0u : static_cast<uint32_t>(EPOLLOUT));
@@ -558,10 +577,10 @@ void doom_conn(SConn *c) {
   epoll_ctl(g_epfd, EPOLL_CTL_DEL, c->fd, nullptr);
   if (c->kind == 1) drop_replica(c);
   if (c == g_upstream) g_upstream = nullptr;
-  if (c->kind == 0) {
-    for (Pending &p : g_pending) {
-      if (p.conn == c) p.conn = nullptr;
-    }
+  // null back-pointers UNCONDITIONALLY: a conn can hold pending entries
+  // from before a REPL_HELLO upgraded its kind (pipelined write + hello)
+  for (Pending &p : g_pending) {
+    if (p.conn == c) p.conn = nullptr;
   }
   g_graveyard.push_back(c);
 }
@@ -768,19 +787,36 @@ bool upstream_ingest(SConn *c) {
 }
 
 void upstream_connect() {
-  int fd = socket(AF_INET, SOCK_STREAM, 0);
   sockaddr_in addr{};
   addr.sin_family = AF_INET;
   addr.sin_port = htons(static_cast<uint16_t>(g_up_port));
+  if (inet_pton(AF_INET, g_up_host.c_str(), &addr.sin_addr) != 1) {
+    // --follow with a HOSTNAME (the documented deployment shape): resolve
+    // it. getaddrinfo can block briefly, but only on the reconnect tick of
+    // a follower with no upstream — nothing else is stalled.
+    addrinfo hints{}, *res = nullptr;
+    hints.ai_family = AF_INET;
+    hints.ai_socktype = SOCK_STREAM;
+    int rc = getaddrinfo(g_up_host.c_str(), nullptr, &hints, &res);
+    if (rc != 0 || res == nullptr) {
+      static uint64_t last_log = 0;
+      if (now_ms() - last_log > 10000) {
+        last_log = now_ms();
+        fprintf(stderr, "[kbstored] cannot resolve --follow host %s: %s\n",
+                g_up_host.c_str(), gai_strerror(rc));
+      }
+      if (res != nullptr) freeaddrinfo(res);
+      return;
+    }
+    addr.sin_addr = reinterpret_cast<sockaddr_in *>(res->ai_addr)->sin_addr;
+    freeaddrinfo(res);
+  }
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
   // non-blocking BEFORE connect: a partitioned primary (SYNs dropped) must
   // not freeze the whole single-threaded reactor for the kernel's connect
   // timeout on every retry tick. EINPROGRESS resolves through epoll: the
   // queued HELLO flushes on EPOLLOUT, failure surfaces as EPOLLERR/HUP.
   fcntl(fd, F_SETFL, fcntl(fd, F_GETFL, 0) | O_NONBLOCK);
-  if (inet_pton(AF_INET, g_up_host.c_str(), &addr.sin_addr) != 1) {
-    close(fd);
-    return;
-  }
   if (connect(fd, reinterpret_cast<sockaddr *>(&addr), sizeof addr) != 0 &&
       errno != EINPROGRESS) {
     close(fd);
