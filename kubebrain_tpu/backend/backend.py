@@ -129,6 +129,31 @@ class Backend:
         except (KeyNotFoundError, coder.CodecError):
             return 0
 
+    def _await_revealed(self, revision: int) -> None:
+        """Fence a definite write failure behind the sequencer floor.
+
+        A conflict/notfound reveals storage state that can be AHEAD of the
+        contiguous committed floor: the conflicting write is already
+        storage-committed but its event not yet sequenced, so the caller's
+        NEXT read (served at the floor) would travel back in time — a real
+        stale-read anomaly our linearizability soak caught (a create
+        conflicted against rev 18, then the same client's get served rev
+        15; tests/test_linearizability.py). Wait (bounded) until the floor
+        passes the revealed revision before surfacing the failure.
+        ``revision < 0`` means "something newer exists but its revision is
+        unknown" (a delete that found a fresh tombstone): sync to the
+        storage watermark instead. MUST be called only after this op's own
+        event was notified — the floor cannot pass our own dealt revision
+        until then (self-deadlock).
+        """
+        if revision < 0:
+            try:
+                revision = self.recover_revision()
+            except Exception:
+                return  # best-effort fence: never mask the original error
+        if revision > self.tso.committed():
+            self.tso.wait_committed(revision, timeout=5.0)
+
     # =================================================================== writes
     def _commit_write(
         self,
@@ -166,10 +191,17 @@ class Backend:
         ``ttl`` overrides the key-pattern TTL (etcd lease attachment)."""
         rev = self.tso.deal()
         event = WatchEvent(revision=rev, verb=Verb.CREATE, key=user_key, value=value, valid=False)
+        revealed = 0
         try:
             creator.create(self._commit_write, user_key, value, rev, ttl=ttl)
             event.valid = True
             return rev
+        except KeyExistsError as e:
+            revealed = e.revision or -1  # rev-0 conflicts still fence
+            raise
+        except FutureRevisionError as e:
+            revealed = e.current
+            raise
         except UncertainResultError as e:
             event.err = e
             raise
@@ -177,6 +209,8 @@ class Backend:
             txn_log("create", user_key, rev, event.err or sys.exc_info()[1])
             self._notify(event)
             self.tso.wait_committed(rev, timeout=5.0)
+            if revealed:
+                self._await_revealed(revealed)
 
     def update(
         self, user_key: bytes, value: bytes, expected_revision: int, ttl: int | None = None
@@ -191,6 +225,7 @@ class Backend:
             prev_revision=expected_revision, valid=False,
         )
         ttl = creator.ttl_for_key(user_key) if ttl is None else ttl
+        revealed = 0
         try:
             if rev <= expected_revision:
                 # drift-back anomaly (reference txn.go:171-175): the dealt
@@ -214,6 +249,7 @@ class Backend:
                         latest_val = self._read_object(user_key, latest_rev)
                 except coder.CodecError:
                     pass
+            revealed = latest_rev or -1
             raise CASRevisionMismatchError(user_key, latest_rev, latest_val) from e
         except UncertainResultError as e:
             event.err = e
@@ -222,6 +258,8 @@ class Backend:
             txn_log("update", user_key, rev, event.err or sys.exc_info()[1])
             self._notify(event)
             self.tso.wait_committed(rev, timeout=5.0)
+            if revealed:
+                self._await_revealed(revealed)
 
     def delete(self, user_key: bytes, expected_revision: int = 0) -> tuple[int, KeyValue]:
         """Tombstone write. The reference pays three engine round-trips here
@@ -233,10 +271,16 @@ class Backend:
             return self._delete_fast(user_key, expected_revision)
         record = self._read_rev_record(user_key)
         if record is None or record[1]:
+            # nothing dealt yet — fence directly when the miss reveals a
+            # possibly-not-yet-sequenced tombstone (a truly absent record
+            # reveals nothing newer; see _await_revealed)
+            if record is not None:
+                self._await_revealed(record[0])
             raise KeyNotFoundError(user_key)
         latest_rev, _ = record
         if expected_revision and latest_rev != expected_revision:
             val = self._read_object(user_key, latest_rev)
+            self._await_revealed(latest_rev)
             raise CASRevisionMismatchError(user_key, latest_rev, val)
         prev_value = self._read_object(user_key, latest_rev)
         rev = self.tso.deal()
@@ -244,11 +288,13 @@ class Backend:
             revision=rev, verb=Verb.DELETE, key=user_key,
             prev_revision=latest_rev, prev_value=prev_value, valid=False,
         )
+        revealed = 0
         try:
             if rev <= latest_rev:
                 # drift-back anomaly (txn.go:171-175) — raised inside the
                 # notify-protected region so the dealt revision is still
                 # sequenced and the pipeline never stalls
+                revealed = latest_rev
                 raise FutureRevisionError(rev, latest_rev)
             self._commit_write(
                 user_key, rev,
@@ -267,6 +313,7 @@ class Backend:
                     lv = None if deleted else self._read_object(user_key, lr)
                 except coder.CodecError:
                     pass
+            revealed = lr or -1
             raise CASRevisionMismatchError(user_key, lr, lv) from e
         except UncertainResultError as e:
             event.err = e
@@ -275,6 +322,8 @@ class Backend:
             txn_log("delete", user_key, rev, event.err or sys.exc_info()[1])
             self._notify(event)
             self.tso.wait_committed(rev, timeout=5.0)
+            if revealed:
+                self._await_revealed(revealed)
 
     def _delete_fast(self, user_key: bytes, expected_revision: int) -> tuple[int, KeyValue]:
         """Single-call delete via the engine (read+validate+tombstone under
@@ -282,6 +331,7 @@ class Backend:
         etcd semantics allow revision gaps."""
         rev = self.tso.deal()
         event = WatchEvent(revision=rev, verb=Verb.DELETE, key=user_key, valid=False)
+        revealed = 0
         try:
             outcome, prev, latest = self._mvcc_delete(
                 coder.encode_revision_key(user_key),
@@ -290,8 +340,11 @@ class Backend:
                 TOMBSTONE, LAST_REV_KEY, coder.encode_rev_value(rev),
             )
             if outcome == "not_found":
+                # latest = tombstone revision; 0 = truly absent (no fence)
+                revealed = latest
                 raise KeyNotFoundError(user_key)
             if outcome == "mismatch":
+                revealed = latest or -1
                 raise CASRevisionMismatchError(
                     user_key, latest, None if prev == TOMBSTONE else prev
                 )
@@ -306,6 +359,8 @@ class Backend:
             txn_log("delete", user_key, rev, event.err or sys.exc_info()[1])
             self._notify(event)
             self.tso.wait_committed(rev, timeout=5.0)
+            if revealed:
+                self._await_revealed(revealed)
 
     # ==================================================================== reads
     def current_revision(self) -> int:

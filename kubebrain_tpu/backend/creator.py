@@ -24,7 +24,7 @@ from __future__ import annotations
 
 from .. import coder
 from ..storage import CASFailedError
-from .errors import KeyExistsError
+from .errors import FutureRevisionError, KeyExistsError
 
 EVENTS_TTL_PREFIX = b"/events/"
 EVENTS_TTL_SECONDS = 3600
@@ -54,9 +54,33 @@ def create(commit_write, user_key: bytes, value: bytes, revision: int, ttl: int 
                 old_rev, deleted = coder.decode_rev_value(observed)
             except coder.CodecError:
                 raise KeyExistsError(user_key, 0) from e
-            if deleted and old_rev < revision:
-                # deleted key: create becomes an update over the tombstone
-                commit_write(user_key, revision, new_record, observed, value, ttl)
-                return
+            if deleted:
+                if old_rev < revision:
+                    # deleted key: create becomes an update over the tombstone
+                    try:
+                        commit_write(user_key, revision, new_record, observed,
+                                     value, ttl)
+                        return
+                    except CASFailedError as e2:
+                        # two creates raced over the same tombstone and we
+                        # lost: surface the winner, not a raw storage error
+                        observed2 = e2.conflict.value if e2.conflict else None
+                        if observed2 is not None:
+                            try:
+                                rev2, del2 = coder.decode_rev_value(observed2)
+                            except coder.CodecError:
+                                raise KeyExistsError(user_key, 0) from e2
+                            if not del2:
+                                raise KeyExistsError(user_key, rev2) from e2
+                        raise FutureRevisionError(revision, old_rev) from e2
+                # Tombstone from a delete that RACED us and drew a HIGHER
+                # revision than ours: the key does not exist, so KeyExists
+                # would claim a state that never was (caught by the
+                # linearizability soak, tests/test_linearizability.py), and
+                # committing at our stale revision would break per-key
+                # revision monotonicity. Same drift-back anomaly as
+                # update/delete (reference txn.go:171-175): definite,
+                # retryable failure — the caller re-deals a fresh revision.
+                raise FutureRevisionError(revision, old_rev) from e
             raise KeyExistsError(user_key, old_rev) from e
     raise KeyExistsError(user_key, 0)

@@ -131,17 +131,10 @@ class Server:
         (RemoteKvStorage.failover). Deliberately a manual surface — the tier
         has no raft quorum, so WHEN to flip is the operator's (or the
         election layer's) call; see README 'Tier replication'."""
-        store = self.backend.store
-        # unwrap decorators (metrics wrapper, tpu mirror) to the remote tier
-        # — cycle-safe walk, same shape as the Defragment unwrap
-        # (server/etcd/misc.py)
-        seen: set[int] = set()
-        while store is not None and id(store) not in seen:
-            seen.add(id(store))
-            if hasattr(store, "failover"):
-                break
-            store = getattr(store, "_inner", None)
-        if store is None or not hasattr(store, "failover"):
+        from ..storage import unwrap_store
+
+        store = unwrap_store(self.backend.store, "failover")
+        if store is None:
             return "application/json", json.dumps(
                 {"error": "storage tier has no failover (not --storage=remote?)"}
             ).encode()

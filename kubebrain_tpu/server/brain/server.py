@@ -140,6 +140,11 @@ class BrainServer:
             return brain_pb2.CreateResponse(succeeded=True, revision=rev)
         except KeyExistsError as e:
             return brain_pb2.CreateResponse(succeeded=False, revision=e.revision)
+        except FutureRevisionError:
+            # drift-back race (concurrent delete drew a higher revision):
+            # definite failure, retry deals a fresh revision (write.go analog
+            # of the etcd shim's mapping, server/etcd/kv.py)
+            context.abort(grpc.StatusCode.UNAVAILABLE, "revision drift, retry")
 
     def Update(self, request, context) -> brain_pb2.UpdateResponse:
         self._check_leader_write(context)

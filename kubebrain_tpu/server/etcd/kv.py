@@ -197,6 +197,14 @@ class KVService:
             return self._txn_failed(request, e.revision)
         except KeyNotFoundError:
             return self._txn_failed(request, 0)
+        except FutureRevisionError:
+            # drift-back race (a concurrent op drew a higher revision than
+            # this txn's dealt one): definite failure, safe to retry —
+            # UNAVAILABLE makes clients (apiserver) re-issue the txn, which
+            # deals a fresh revision (reference ErrRevisionDriftBack,
+            # txn.go:171-175)
+            context.abort(grpc.StatusCode.UNAVAILABLE,
+                          "etcdserver: revision drift, retry txn")
 
     def _match(self, request, context):
         """Classify the txn (reference kv.go:160-230). Returns

@@ -75,19 +75,12 @@ class MaintenanceService:
     def Defragment(self, request, context) -> rpc_pb2.DefragmentResponse:
         """etcd defrag ≈ our checkpoint: rewrite a latest-only snapshot and
         truncate the WAL (no-op for engines without durability)."""
-        store = self.backend.store
-        # engines hide behind decorator stacks (metrics → tpu → native):
-        # walk down until something offers a checkpoint
-        checkpoint = None
-        seen = set()
-        while store is not None and id(store) not in seen:
-            seen.add(id(store))
-            checkpoint = getattr(store, "checkpoint", None)
-            if checkpoint is not None:
-                break
-            store = getattr(store, "_inner", None)
-        if checkpoint is not None:
-            checkpoint()
+        from ...storage import unwrap_store
+
+        # engines hide behind decorator stacks (metrics → tpu → native)
+        store = unwrap_store(self.backend.store, "checkpoint")
+        if store is not None:
+            store.checkpoint()
         return rpc_pb2.DefragmentResponse(
             header=shim.header(self.backend.current_revision())
         )

@@ -189,6 +189,20 @@ class KvStorage(abc.ABC):
 _FACTORIES: dict[str, Callable[..., KvStorage]] = {}
 
 
+def unwrap_store(store, attr: str):
+    """Walk a decorator stack (metrics → tpu mirror → …) down ``_inner``
+    links until a layer offering ``attr`` appears; cycle-safe. Returns None
+    when no layer has it. Shared by the admin surfaces (Defragment,
+    /tier/failover) so the unwrap rule cannot diverge."""
+    seen: set = set()
+    while store is not None and id(store) not in seen:
+        seen.add(id(store))
+        if hasattr(store, attr):
+            return store
+        store = getattr(store, "_inner", None)
+    return None
+
+
 def register_engine(name: str, factory: Callable[..., KvStorage]) -> None:
     _FACTORIES[name] = factory
 

@@ -339,7 +339,9 @@ class NativeKv(KvStorage):
         if rc == 0:
             return "ok", prev, int(latest.value)
         if rc == 1:
-            return "not_found", None, 0
+            # latest = the tombstone's revision (0 when truly absent) — the
+            # backend fences its read floor on it (_await_revealed)
+            return "not_found", None, int(latest.value)
         if rc == 2:
             return "mismatch", prev, int(latest.value)
         if rc == 3:

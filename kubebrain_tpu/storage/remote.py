@@ -28,6 +28,7 @@ from __future__ import annotations
 import socket
 import struct
 import threading
+import time
 
 from . import BatchWrite, Iter, KvStorage, Partition, register_engine
 from .errors import (
@@ -272,6 +273,8 @@ class RemoteKvStorage(KvStorage):
         # serializes one request/response at a time)
         self._fpool_size = max(1, pool)
         self._fpools: dict[int, list[_PooledConn]] = {}
+        self._frole: dict[int, tuple[float, bool]] = {}  # idx -> (probed_at, is_follower)
+        self._fdown: dict[int, float] = {}               # idx -> cooldown deadline
         self._frr = 0
         # probe + cache engine facts
         status, payload = self._call(OP_INFO, b"")
@@ -314,6 +317,30 @@ class RemoteKvStorage(KvStorage):
             new = self._heal(slot, conn)
             return new.call(op, body)
 
+    def _candidate_is_follower(self, idx: int) -> bool:
+        """Role-gate a read candidate (cached, ~5s TTL; unreachable nodes
+        sit out a 5s cooldown). A non-follower candidate is NOT a routing
+        target: a restarted old primary answers reads from an ABANDONED
+        lineage and — being a primary — bypasses the server-side drift
+        check, so routing to it would serve silently-stale data."""
+        now = time.monotonic()
+        with self._rr_lock:
+            down_until = self._fdown.get(idx, 0.0)
+            probed_at, is_f = self._frole.get(idx, (0.0, False))
+        if now < down_until:
+            return False
+        if now - probed_at < 5.0:
+            return is_f
+        try:
+            is_f, _, _ = self.role(idx)
+        except Exception:
+            with self._rr_lock:
+                self._fdown[idx] = now + 5.0
+            return False
+        with self._rr_lock:
+            self._frole[idx] = (now, is_f)
+        return is_f
+
     def _read_call(self, op: int, body: bytes, snapshot_ts: int) -> tuple[int, bytes]:
         """Snapshot-pinned read: try a follower first (when enabled), fall
         back to the primary on drift/any transport trouble. Reads without a
@@ -325,6 +352,8 @@ class RemoteKvStorage(KvStorage):
                 candidates = [i for i in range(len(self._addresses))
                               if i != self._primary]
                 idx = candidates[rr % len(candidates)] if candidates else None
+            if idx is not None and not self._candidate_is_follower(idx):
+                idx = None
             if idx is not None:
                 conn = None
                 try:
@@ -338,6 +367,7 @@ class RemoteKvStorage(KvStorage):
                             conns = self._fpools.get(idx)
                             if conns and conn in conns:
                                 conns.remove(conn)
+                            self._fdown[idx] = time.monotonic() + 5.0
                         conn.close()
         return self._call(op, body)
 
@@ -475,6 +505,8 @@ class RemoteKvStorage(KvStorage):
                     _PooledConn(addr, self._timeout) for _ in range(len(self._pool))
                 ]
                 old_f, self._fpools = self._fpools, {}
+                self._frole.clear()
+                self._fdown.clear()
             for c in old:
                 c.close()
             for conns in old_f.values():
@@ -588,7 +620,8 @@ class RemoteKvStorage(KvStorage):
         except (OSError, EOFError) as exc:
             raise UncertainResultError(f"mvcc delete outcome unknown: {exc}") from exc
         if status == ST_NOT_FOUND:
-            return "not_found", None, 0
+            latest = struct.unpack("<Q", payload)[0] if len(payload) >= 8 else 0
+            return "not_found", None, latest
         if status in (ST_OK, ST_CONFLICT):
             r = _Reader(payload)
             has = r.u8()

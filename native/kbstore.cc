@@ -887,8 +887,20 @@ int kb_mvcc_delete(void* s,
   double now = wallclock();
   std::string rk(reinterpret_cast<const char*>(rev_key), rkl);
   std::unique_lock<std::shared_mutex> lock(st->mu);
+  *latest_rev_out = 0;
   const std::string* record = st->live(rk, st->ts, now);
-  if (record == nullptr || record->size() == 9) return 1;  // absent or deleted
+  if (record == nullptr) return 1;  // truly absent: latest stays 0
+  if (record->size() == 9) {
+    // deleted: report the tombstone's revision so the caller can fence its
+    // read floor precisely (backend _await_revealed) instead of syncing to
+    // the global watermark
+    uint64_t latest = 0;
+    for (int i = 0; i < 8; ++i) {
+      latest = (latest << 8) | static_cast<uint8_t>((*record)[i]);
+    }
+    *latest_rev_out = latest;
+    return 1;
+  }
   if (record->size() != 8) return 1;
   uint64_t latest = 0;
   for (int i = 0; i < 8; ++i) {

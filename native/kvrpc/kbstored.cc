@@ -383,7 +383,10 @@ uint8_t op_mvcc_delete(Reader &r, std::string &body) {
     return rc == 0 ? ST_OK : ST_CONFLICT;
   }
   if (plen) kb_free(prev);
-  if (rc == 1) return ST_NOT_FOUND;
+  if (rc == 1) {
+    put_num<uint64_t>(body, latest);  // tombstone rev, 0 = truly absent
+    return ST_NOT_FOUND;
+  }
   if (rc == 3) {
     body = "wal append failed";
     return ST_WAL;

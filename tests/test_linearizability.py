@@ -16,7 +16,11 @@ import random
 import pytest
 
 from kubebrain_tpu.backend import Backend, BackendConfig
-from kubebrain_tpu.backend.errors import CASRevisionMismatchError, KeyExistsError
+from kubebrain_tpu.backend.errors import (
+    CASRevisionMismatchError,
+    FutureRevisionError,
+    KeyExistsError,
+)
 from kubebrain_tpu.lincheck import History, Op, _apply, _check_key
 from kubebrain_tpu.storage import new_storage
 from kubebrain_tpu.storage.errors import KeyNotFoundError
@@ -171,6 +175,10 @@ class _Recorder:
                       ret=time.monotonic(), value=value, ok=False,
                       err="conflict", conflict_rev=e.revision)
             return None
+        except FutureRevisionError:
+            # drift-back: definite no-op failure (the caller's retry would
+            # deal a fresh revision); no linearization obligation
+            return None
 
     def update(self, client, key, value, prev_rev):
         t0 = time.monotonic()
@@ -288,3 +296,133 @@ def test_seeded_stale_read_bug_is_caught():
     finally:
         b.close()
         store.close()
+
+
+# ----------------------- live soak vs the REPLICATED tier, with a nemesis
+def test_replicated_tier_failover_soak_is_linearizable(tmp_path):
+    """Concurrent clients against a Backend over the semi-sync replicated
+    kbstored tier; mid-soak the primary is SIGKILLed and the follower
+    promoted (storage failover). The recorded history — including the
+    uncertain ops from the failover window — must check linearizable."""
+    import os
+    import signal
+    import subprocess
+
+    from kubebrain_tpu.storage.errors import StorageError, UncertainResultError
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    stored_bin = os.path.join(repo, "native", "kvrpc", "kbstored")
+    if not os.path.exists(stored_bin):
+        pytest.skip("kbstored not built")
+
+    import socket as _socket
+
+    def free_port():
+        s = _socket.socket()
+        s.bind(("127.0.0.1", 0))
+        p = s.getsockname()[1]
+        s.close()
+        return p
+
+    def start(args):
+        os.makedirs(args[1], exist_ok=True)
+        proc = subprocess.Popen([stored_bin] + args, stdout=subprocess.PIPE,
+                                stderr=subprocess.DEVNULL)
+        assert b"READY" in proc.stdout.readline()
+        return proc
+
+    pp, fp = free_port(), free_port()
+    prim = start([str(pp), str(tmp_path / "p")])
+    fol = start([str(fp), str(tmp_path / "f"), "--follow", f"127.0.0.1:{pp}"])
+    store = new_storage("remote", address=f"127.0.0.1:{pp},127.0.0.1:{fp}",
+                        pool=4, timeout=3.0, read_followers=True)
+    # wait for the replica stream (pre-attach acks are standalone-durable)
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        try:
+            if store.role(0)[2] >= 1:
+                break
+        except Exception:
+            pass
+        time.sleep(0.05)
+    b = Backend(store, BackendConfig(event_ring_capacity=65536))
+
+    class _TierRecorder(_Recorder):
+        """Transport death => outcome unknown (ok=None, open return window);
+        definite server-side rejections carry no state change and drop."""
+
+        def _guard(self, fn, client, kind, key, **kw):
+            t0 = time.monotonic()
+            try:
+                return fn()
+            except UncertainResultError:
+                self._rec(client=client, kind=kind, key=key, call=t0,
+                          ret=math.inf, ok=None, **kw)
+            except (StorageError, OSError, TimeoutError):
+                pass  # definite failure or failed read: no obligation
+            return None
+
+        def create(self, client, key, value):
+            return self._guard(lambda: _Recorder.create(self, client, key, value),
+                               client, "create", key, value=value)
+
+        def update(self, client, key, value, prev_rev):
+            return self._guard(
+                lambda: _Recorder.update(self, client, key, value, prev_rev),
+                client, "update", key, value=value, prev_rev=prev_rev)
+
+        def delete(self, client, key, prev_rev=0):
+            return self._guard(
+                lambda: _Recorder.delete(self, client, key, prev_rev),
+                client, "delete", key, prev_rev=prev_rev)
+
+        def get(self, client, key):
+            try:
+                return _Recorder.get(self, client, key)
+            except Exception:
+                return None
+
+    rec = _TierRecorder(b)
+    stop_nemesis = threading.Event()
+
+    def nemesis():
+        # progress-triggered: kill once the soak is ~1/3 through, so the
+        # failover window always lands inside the recorded history
+        deadline = time.time() + 30
+        while time.time() < deadline and len(rec.h.ops) < 1200:
+            time.sleep(0.01)
+        prim.send_signal(signal.SIGKILL)
+        prim.wait()
+        time.sleep(0.3)
+        deadline = time.time() + 15
+        while time.time() < deadline and not stop_nemesis.is_set():
+            try:
+                store.failover()
+                return
+            except Exception:
+                time.sleep(0.3)
+
+    nt = threading.Thread(target=nemesis, daemon=True)
+    nt.start()
+    try:
+        _soak(rec, n_clients=6, n_ops=600, n_keys=4, seed=7)
+    finally:
+        stop_nemesis.set()
+        nt.join(timeout=20)
+
+    try:
+        res = rec.h.check()
+        assert res["ok"], res["violation"]
+        assert res["ops"] > 300, res
+        # the nemesis window must actually have produced uncertainty
+        unknown = sum(1 for op in rec.h.ops if op.ok is None)
+        assert unknown >= 1, "failover produced no uncertain ops — nemesis misfired?"
+    finally:
+        b.close()
+        store.close()
+        for p in (prim, fol):
+            try:
+                p.kill()
+                p.wait()
+            except Exception:
+                pass
