@@ -28,7 +28,7 @@ from typing import Iterator
 
 from .. import coder
 from ..storage import CASFailedError, KvStorage, Partition, UncertainResultError
-from ..storage.errors import KeyNotFoundError
+from ..storage.errors import KeyNotFoundError, RevisionDriftBackError
 from ..util.env import txn_log
 from . import creator
 from .common import (
@@ -352,6 +352,11 @@ class Backend:
             event.prev_value = prev
             event.valid = True
             return rev, KeyValue(user_key, prev or b"", latest)
+        except RevisionDriftBackError as e:
+            # engine-level drift (a concurrent write drew >= our revision):
+            # same fenced, retryable contract as the slow path
+            revealed = e.latest or -1
+            raise FutureRevisionError(rev, e.latest) from e
         except UncertainResultError as e:
             event.err = e
             raise

@@ -63,7 +63,11 @@ def create(commit_write, user_key: bytes, value: bytes, revision: int, ttl: int 
                         return
                     except CASFailedError as e2:
                         # two creates raced over the same tombstone and we
-                        # lost: surface the winner, not a raw storage error
+                        # lost: surface the WINNER's revision (the caller
+                        # fences its read floor on it — the stale old_rev
+                        # would make the fence a no-op and reopen the
+                        # ahead-of-floor stale read); -1 = revealed state
+                        # of unknown revision, fence to the watermark
                         observed2 = e2.conflict.value if e2.conflict else None
                         if observed2 is not None:
                             try:
@@ -72,7 +76,8 @@ def create(commit_write, user_key: bytes, value: bytes, revision: int, ttl: int 
                                 raise KeyExistsError(user_key, 0) from e2
                             if not del2:
                                 raise KeyExistsError(user_key, rev2) from e2
-                        raise FutureRevisionError(revision, old_rev) from e2
+                            raise FutureRevisionError(revision, rev2) from e2
+                        raise FutureRevisionError(revision, -1) from e2
                 # Tombstone from a delete that RACED us and drew a HIGHER
                 # revision than ours: the key does not exist, so KeyExists
                 # would claim a state that never was (caught by the

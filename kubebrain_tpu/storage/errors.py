@@ -59,10 +59,15 @@ class UncertainResultError(StorageError):
 
 
 class RevisionDriftBackError(StorageError):
-    """The revision sequencer observed time going backwards.
+    """The revision sequencer observed time going backwards: the engine saw
+    a record at ``latest`` >= the op's dealt revision (0 = unreported).
 
     Reference: pkg/backend/backend.go:188-199 (ErrRevisionDriftBack).
     """
+
+    def __init__(self, message: str = "revision drift", latest: int = 0):
+        super().__init__(message)
+        self.latest = latest
 
 
 class InvalidArgumentError(StorageError):

@@ -346,7 +346,11 @@ class NativeKv(KvStorage):
             return "mismatch", prev, int(latest.value)
         if rc == 3:
             raise StorageError("WAL append failed; delete aborted")
-        raise StorageError(f"revision drift on delete (latest {latest.value})")
+        from .errors import RevisionDriftBackError
+
+        raise RevisionDriftBackError(
+            f"revision drift on delete (latest {latest.value})",
+            latest=int(latest.value))
 
     def export_mvcc(
         self,

@@ -275,6 +275,7 @@ class RemoteKvStorage(KvStorage):
         self._fpools: dict[int, list[_PooledConn]] = {}
         self._frole: dict[int, tuple[float, bool]] = {}  # idx -> (probed_at, is_follower)
         self._fdown: dict[int, float] = {}               # idx -> cooldown deadline
+        self._fprobing: set[int] = set()                 # single-flight role probes
         self._frr = 0
         # probe + cache engine facts
         status, payload = self._call(OP_INFO, b"")
@@ -327,18 +328,27 @@ class RemoteKvStorage(KvStorage):
         with self._rr_lock:
             down_until = self._fdown.get(idx, 0.0)
             probed_at, is_f = self._frole.get(idx, (0.0, False))
-        if now < down_until:
-            return False
-        if now - probed_at < 5.0:
-            return is_f
+            if now < down_until:
+                return False
+            if now - probed_at < 5.0:
+                return is_f
+            if idx in self._fprobing:
+                # single-flight: someone else is probing — don't pile more
+                # blocked readers on a possibly-wedged candidate; fall back
+                return False
+            self._fprobing.add(idx)
         try:
-            is_f, _, _ = self.role(idx)
+            # short dedicated probe timeout: a wedged candidate must not
+            # stall the read for the full transport timeout
+            is_f, _, _ = self.role(idx, timeout=min(self._timeout, 1.0))
         except Exception:
             with self._rr_lock:
                 self._fdown[idx] = now + 5.0
+                self._fprobing.discard(idx)
             return False
         with self._rr_lock:
             self._frole[idx] = (now, is_f)
+            self._fprobing.discard(idx)
         return is_f
 
     def _read_call(self, op: int, body: bytes, snapshot_ts: int) -> tuple[int, bytes]:
@@ -447,18 +457,20 @@ class RemoteKvStorage(KvStorage):
                 f"checkpoint failed on kbstored (status {status}): {payload!r}")
 
     # ---------------------------------------------------------- replication
-    def _call_addr(self, addr: tuple[str, int], op: int, body: bytes):
+    def _call_addr(self, addr: tuple[str, int], op: int, body: bytes,
+                   timeout: float | None = None):
         """One-off request to a specific tier member (control-plane ops)."""
-        conn = _PooledConn(addr, self._timeout)
+        conn = _PooledConn(addr, timeout if timeout is not None else self._timeout)
         try:
             return conn.call(op, body)
         finally:
             conn.close()
 
-    def role(self, idx: int | None = None) -> tuple[bool, int, int]:
+    def role(self, idx: int | None = None,
+             timeout: float | None = None) -> tuple[bool, int, int]:
         """(is_follower, clock, attached_replicas) of a tier member."""
         addr = self._addresses[self._primary if idx is None else idx]
-        status, payload = self._call_addr(addr, OP_ROLE, b"")
+        status, payload = self._call_addr(addr, OP_ROLE, b"", timeout=timeout)
         if status != ST_OK:
             raise StorageError(f"ROLE failed (status {status})")
         r = _Reader(payload)
@@ -633,7 +645,10 @@ class RemoteKvStorage(KvStorage):
             raise StorageError("WAL append failed; delete aborted")
         if status == ST_DRIFT:
             latest = struct.unpack("<Q", payload)[0]
-            raise StorageError(f"revision drift on delete (latest {latest})")
+            from .errors import RevisionDriftBackError
+
+            raise RevisionDriftBackError(
+                f"revision drift on delete (latest {latest})", latest=latest)
         raise StorageError(f"mvcc delete failed (status {status}): {payload!r}")
 
 
