@@ -30,6 +30,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--storage-address", default="127.0.0.1:2389",
                    help="kbstored address for --storage=remote; comma-"
                         "separated primary,follower,... enables failover()")
+    p.add_argument("--tier-auto-failover", action="store_true",
+                   help="probe the kbstored tier primary and auto-promote a "
+                        "follower after 3 missed probes (split-brain-guarded "
+                        "by the follower's stream-liveness check)")
     p.add_argument("--storage-read-followers", action="store_true",
                    help="route snapshot-pinned reads to kbstored followers "
                         "(tier-level read scaling; falls back to the "
@@ -283,6 +287,13 @@ def main(argv=None) -> int:
         gc.set_threshold(*parts[:3])
 
     endpoint, backend, store = build_endpoint(args)
+    if args.tier_auto_failover:
+        if not endpoint.server.start_tier_watchdog():
+            # an explicitly requested HA feature that cannot arm must not
+            # be silently dropped (validate_args style)
+            raise SystemExit(
+                "--tier-auto-failover requires --storage=remote (or "
+                "tpu-over-remote) with --storage-address primary,follower,...")
     stop = threading.Event()
     watchdog: list[threading.Timer] = []
 

@@ -183,6 +183,56 @@ class Server:
         finally:
             self._profile_lock.release()
 
+    def start_tier_watchdog(self, interval: float = 1.0, failures: int = 3) -> bool:
+        """Auto-failover for the replicated kbstored tier: probe the tier
+        primary every ``interval``; after ``failures`` consecutive misses,
+        attempt ``failover()``. Split-brain safety does NOT rest on this
+        node's view: the FOLLOWER refuses promotion while its replication
+        stream from the primary is alive (heartbeat-armed, kbstored
+        OP_PROMOTE guard), so a node merely partitioned from a healthy
+        primary cannot fork the tier. Returns False when the storage stack
+        has no failover surface (not a replicated remote tier)."""
+        from ..storage import unwrap_store
+
+        store = unwrap_store(self.backend.store, "failover")
+        if store is None or len(getattr(store, "_addresses", [])) < 2:
+            return False
+
+        import logging
+
+        log = logging.getLogger("kubebrain.tier")
+
+        def loop():
+            misses = 0
+            while not self._watchdog_stop.wait(interval):
+                try:
+                    store.role(timeout=min(2.0, interval))
+                    misses = 0
+                    continue
+                except Exception:
+                    misses += 1
+                if misses < failures:
+                    continue
+                try:
+                    idx = store.failover()
+                    log.warning("tier primary unreachable %d probes; "
+                                "promoted follower %d", misses, idx)
+                    misses = 0
+                except Exception as exc:
+                    # follower refused (primary alive from ITS view — we are
+                    # the partitioned side) or nothing promotable yet
+                    log.warning("tier failover attempt failed: %s", exc)
+
+        from ..util.env import crash_guard
+
+        self._watchdog_stop = threading.Event()
+        self._watchdog = threading.Thread(
+            target=crash_guard(loop), name="kb-tier-watchdog", daemon=True)
+        self._watchdog.start()
+        return True
+
     def close(self) -> None:
+        if getattr(self, "_watchdog_stop", None) is not None:
+            self._watchdog_stop.set()
         self.brain.close()
         self.peers.close()

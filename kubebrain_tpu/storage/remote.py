@@ -158,6 +158,10 @@ class RemoteBatchWrite(BatchWrite):
             # the outcome is unknowable (reference batch.go:125-146)
             raise UncertainResultError(f"batch commit outcome unknown: {exc}") from exc
         if status == ST_OK:
+            if len(payload) >= 8:  # commit clock: feeds lineage adoption
+                ts = struct.unpack_from("<Q", payload)[0]
+                if ts > self._store._max_seen_ts:
+                    self._store._max_seen_ts = ts
             return
         if status == ST_CONFLICT:
             r = _Reader(payload)
@@ -276,6 +280,7 @@ class RemoteKvStorage(KvStorage):
         self._frole: dict[int, tuple[float, bool]] = {}  # idx -> (probed_at, is_follower)
         self._fdown: dict[int, float] = {}               # idx -> cooldown deadline
         self._fprobing: set[int] = set()                 # single-flight role probes
+        self._max_seen_ts = 0  # highest tier clock observed (lineage adoption)
         self._frr = 0
         # probe + cache engine facts
         status, payload = self._call(OP_INFO, b"")
@@ -418,7 +423,10 @@ class RemoteKvStorage(KvStorage):
         status, payload = self._call(OP_TSO, b"")
         if status != ST_OK:
             raise StorageError("TSO failed")
-        return struct.unpack("<Q", payload)[0]
+        ts = struct.unpack("<Q", payload)[0]
+        if ts > self._max_seen_ts:
+            self._max_seen_ts = ts
+        return ts
 
     def get_partitions(self, start: bytes, end: bytes) -> list[Partition]:
         status, payload = self._call(
@@ -474,15 +482,30 @@ class RemoteKvStorage(KvStorage):
         if status != ST_OK:
             raise StorageError(f"ROLE failed (status {status})")
         r = _Reader(payload)
-        return bool(r.u8()), r.u64(), r.u32()
+        is_f, ts, n_rep = bool(r.u8()), r.u64(), r.u32()
+        if not is_f and ts > self._max_seen_ts:
+            self._max_seen_ts = ts
+        return is_f, ts, n_rep
 
-    def promote(self, idx: int) -> None:
-        """Promote the follower at ``idx`` to primary (idempotent)."""
-        status, payload = self._call_addr(self._addresses[idx], OP_PROMOTE, b"")
+    def upstream_alive(self, idx: int, timeout: float | None = None) -> bool:
+        """Does the follower at ``idx`` still receive its primary's stream
+        (heartbeats included)? The client side of the split-brain guard."""
+        addr = self._addresses[idx]
+        status, payload = self._call_addr(addr, OP_ROLE, b"", timeout=timeout)
+        if status != ST_OK or len(payload) < 14:
+            return False
+        return bool(payload[13])
+
+    def promote(self, idx: int, force: bool = False) -> None:
+        """Promote the follower at ``idx`` to primary (idempotent). The
+        follower REFUSES while its replication stream from the primary is
+        alive unless ``force`` — the tier's split-brain guard."""
+        body = struct.pack("<B", 1) if force else b""
+        status, payload = self._call_addr(self._addresses[idx], OP_PROMOTE, body)
         if status != ST_OK:
             raise StorageError(f"PROMOTE failed (status {status}): {payload!r}")
 
-    def failover(self) -> int:
+    def failover(self, force: bool = False) -> int:
         """Promote the first reachable follower and repoint the pool at it.
 
         Deliberately NOT automatic on transport blips: the CALLER decides
@@ -501,31 +524,46 @@ class RemoteKvStorage(KvStorage):
                 # answers PROMOTE with an idempotent OK, and repointing at
                 # it would silently abandon every write acked since the
                 # first failover (stale-lineage guard)
-                is_follower, _, _ = self.role(idx)
+                is_follower, cand_ts, _ = self.role(idx)
                 if not is_follower:
+                    # already a primary. Adopt it ONLY when its clock is at
+                    # least everything this client ever observed — true for
+                    # a follower some other actor just promoted (semi-sync:
+                    # follower clock >= every acked write we saw), false
+                    # for a restarted OLD primary that missed post-failover
+                    # writes (stale lineage -> refuse).
+                    if cand_ts >= self._max_seen_ts:
+                        self._repoint(idx, addr)
+                        return idx
                     last_exc = StorageError(
-                        f"{addr} is a primary with its own lineage; refusing")
+                        f"{addr} is a primary of a stale lineage "
+                        f"(ts {cand_ts} < observed {self._max_seen_ts}); refusing")
                     continue
-                self.promote(idx)
+                self.promote(idx, force=force)
             except (OSError, EOFError, StorageError) as exc:
                 last_exc = exc
                 continue
-            with self._rr_lock:
-                self._primary = idx
-                self._address = addr
-                old, self._pool = self._pool, [
-                    _PooledConn(addr, self._timeout) for _ in range(len(self._pool))
-                ]
-                old_f, self._fpools = self._fpools, {}
-                self._frole.clear()
-                self._fdown.clear()
-            for c in old:
-                c.close()
-            for conns in old_f.values():
-                for c in conns:
-                    c.close()
+            self._repoint(idx, addr)
             return idx
         raise StorageError(f"no promotable follower reachable: {last_exc}")
+
+    def _repoint(self, idx: int, addr: tuple[str, int]) -> None:
+        """Swing the pool to a new primary; old conns surface as
+        UncertainResultError to in-flight callers and repair as usual."""
+        with self._rr_lock:
+            self._primary = idx
+            self._address = addr
+            old, self._pool = self._pool, [
+                _PooledConn(addr, self._timeout) for _ in range(len(self._pool))
+            ]
+            old_f, self._fpools = self._fpools, {}
+            self._frole.clear()
+            self._fdown.clear()
+        for c in old:
+            c.close()
+        for conns in old_f.values():
+            for c in conns:
+                c.close()
 
     def close(self) -> None:
         for c in self._pool:

@@ -41,14 +41,21 @@
 //                keys u8[n*kw] | lens i32[n] | revs u64[n] | tomb u8[n] |
 //                u64 alen | arena | offsets u64[n+1]. Paged by rows AND by
 //                a 32 MB arena cap; resume with start = next_start.
-//  11 REPL_HELLO u64 follower_ts -> u8 need_dump [| dump record]; marks the
+//  11 REPL_HELLO u64 follower_ts [| u8 caps] -> u8 need_dump [| dump
+//                record]; caps bit 0 = understands empty heartbeat pushes
+//                (only capable replicas receive them); marks the
 //                conn as a replica stream: committed WAL records are pushed
 //                to it as frames with req_id=0 (semi-sync: client write
 //                ACKs are held until every replica acks the record or the
 //                KB_REPL_TIMEOUT_MS deadline detaches stalled replicas)
 //  12 REPL_ACK   u64 ts (fire-and-forget, replica -> primary)
-//  13 PROMOTE    -   follower becomes primary (idempotent on a primary)
-//  14 ROLE       -   -> u8 is_follower | u64 ts | u32 n_replicas
+//  13 PROMOTE    [u8 force] follower becomes primary (idempotent on a
+//                primary). Refused while the follower's replication stream
+//                is alive (<1s since last upstream traffic) unless force=1
+//                - the split-brain guard: a healthy primary means the
+//                promoter is the partitioned one.
+//  14 ROLE       -   -> u8 is_follower | u64 ts | u32 n_replicas |
+//                u8 upstream_alive
 //
 // Scan paging is client-driven (stateless server): 'more' set when the page
 // cap truncated a forward scan; the client re-issues from last_key+\0.
@@ -464,6 +471,7 @@ struct SConn {
   // 0 = client, 1 = downstream replica (a follower's stream, primary side),
   // 2 = upstream link (this process IS a follower; conn to its primary)
   uint8_t kind = 0;
+  uint8_t caps = 0;     // kind 1: replica capability bits (1 = heartbeats)
   bool zombie = false;  // doomed; freed after the current events batch
   uint64_t acked = 0;   // kind 1: highest record ts the replica acked
 };
@@ -480,6 +488,7 @@ std::string g_up_host;            // follower: primary address
 int g_up_port = 0;
 SConn *g_upstream = nullptr;      // follower: live link to primary
 uint64_t g_up_retry_ms = 0;       // follower: next reconnect time
+uint64_t g_up_last_ms = 0;        // follower: last traffic from the primary
 std::vector<SConn *> g_replicas;  // primary: attached follower streams
 
 struct Pending {  // a client write response held until the replica acks
@@ -627,17 +636,30 @@ void handle_repl_op(SConn *c, uint8_t op, Reader &r, uint64_t req_id) {
     put_u8(body, g_follower ? 1 : 0);
     put_num<uint64_t>(body, kb_tso(g_store));
     put_num<uint32_t>(body, static_cast<uint32_t>(g_replicas.size()));
+    put_u8(body, (g_follower && g_upstream != nullptr &&
+                  now_ms() - g_up_last_ms < 1000) ? 1 : 0);
   } else if (op == OP_PROMOTE) {
-    if (g_follower) {
+    uint8_t force = r.n > r.off ? r.num<uint8_t>() : 0;
+    if (g_follower && !force && g_upstream != nullptr &&
+        now_ms() - g_up_last_ms < 1000) {
+      // split-brain guard: our replication stream from the primary is
+      // demonstrably alive, so whoever asked to promote us is partitioned
+      // from a healthy primary — refuse (raft would refuse via terms; this
+      // tier refuses via stream liveness; operators can pass force=1)
+      status = ST_ERROR;
+      body = "primary still alive (replication stream active); force to override";
+    } else if (g_follower) {
       g_follower = false;
       if (g_upstream != nullptr) {
         doom_conn(g_upstream);  // reaped after the current events batch
       }
-      fprintf(stderr, "[kbstored] PROMOTED to primary at ts=%llu\n",
-              static_cast<unsigned long long>(kb_tso(g_store)));
+      fprintf(stderr, "[kbstored] PROMOTED to primary at ts=%llu%s\n",
+              static_cast<unsigned long long>(kb_tso(g_store)),
+              force ? " (forced)" : "");
     }
   } else if (op == OP_REPL_HELLO) {
     uint64_t fts = r.num<uint64_t>();
+    uint8_t caps = r.n > r.off ? r.num<uint8_t>() : 0;
     uint64_t myts = kb_tso(g_store);
     if (!r.ok) {
       status = ST_ERROR;
@@ -654,6 +676,7 @@ void handle_repl_op(SConn *c, uint8_t op, Reader &r, uint64_t req_id) {
       body = "follower ahead of primary";
     } else {
       c->kind = 1;
+      c->caps = caps;
       c->acked = fts;
       g_replicas.push_back(c);
       if (fts < myts) {
@@ -773,6 +796,8 @@ bool upstream_ingest(SConn *c) {
                   static_cast<unsigned long long>(ats));
         }
       }
+    } else if (req_id == 0 && status == ST_OK && blen == 0) {
+      // heartbeat: keeps the split-brain guard armed on idle primaries
     } else if (req_id == 0 && status == ST_OK) {  // replication record
       uint64_t ats = 0;
       int rc = kb_apply_record(g_store, body, blen, 0, &ats);
@@ -832,12 +857,13 @@ void upstream_connect() {
   c->kind = 2;
   // HELLO (req_id 1): my clock; primary dumps if it is ahead
   uint64_t myts = kb_tso(g_store);
-  uint32_t blen = 8;
+  uint32_t blen = 9;
   uint64_t req_id = 1;
   c->out.append(reinterpret_cast<char *>(&blen), 4);
   c->out.append(reinterpret_cast<char *>(&req_id), 8);
   c->out.push_back(static_cast<char>(OP_REPL_HELLO));
   c->out.append(reinterpret_cast<char *>(&myts), 8);
+  c->out.push_back(1);  // caps: heartbeats understood
   epoll_event ev{};
   ev.events = EPOLLIN | EPOLLOUT;
   ev.data.ptr = c;
@@ -923,6 +949,8 @@ int main(int argc, char **argv) {
       timeout = 50;
     else if (g_follower && g_upstream == nullptr)
       timeout = 200;
+    else if (!g_replicas.empty())
+      timeout = 250;  // heartbeat cadence
     int n = epoll_wait(g_epfd, events, 128, timeout);
     if (n < 0) {
       if (errno == EINTR) continue;
@@ -931,6 +959,15 @@ int main(int argc, char **argv) {
     }
     // timeout-driven maintenance: follower reconnect + replica ack timeout
     uint64_t now = now_ms();
+    static uint64_t last_hb = 0;
+    if (!g_replicas.empty() && now - last_hb >= 500) {
+      last_hb = now;
+      for (SConn *rc : g_replicas) {
+        if ((rc->caps & 1) == 0) continue;  // pre-heartbeat binary
+        append_response(rc, 0, ST_OK, "");  // heartbeat keeps the guard armed
+        conn_update(rc);
+      }
+    }
     if (g_follower && g_upstream == nullptr && now >= g_up_retry_ms) {
       upstream_connect();
       g_up_retry_ms = now + 500;
@@ -983,6 +1020,7 @@ int main(int argc, char **argv) {
           }
         }
         if (!dead) {
+          if (c->kind == 2) g_up_last_ms = now_ms();
           bool ok = c->kind == 2 ? upstream_ingest(c) : conn_ingest(c);
           if (c->zombie) continue;  // doomed by its own op (e.g. PROMOTE)
           if (!ok) dead = true;

@@ -698,3 +698,152 @@ def test_follower_read_routing(tmp_path):
         fol.kill()
         prim.wait()
         fol.wait()
+
+
+def test_promote_refused_while_primary_alive(tmp_path):
+    """Split-brain guard: a follower whose replication stream (heartbeats
+    included — the primary may be idle) is alive refuses PROMOTE; force=1
+    overrides; a dead primary disarms the guard within ~1s."""
+    pp, fp = free_port(), free_port()
+    prim = _start_stored([str(pp), "-"])
+    fol = _start_stored([str(fp), "-", "--follow", f"127.0.0.1:{pp}"])
+    s = new_storage("remote", address=f"127.0.0.1:{pp},127.0.0.1:{fp}",
+                    pool=1, timeout=3.0)
+    try:
+        _wait_replicas(s, 1)
+        put(s, b"/sb/a", b"1")
+        time.sleep(1.2)  # idle: only heartbeats keep the guard armed
+        assert s.upstream_alive(1)
+        from kubebrain_tpu.storage.errors import StorageError
+
+        with pytest.raises(StorageError, match="still alive"):
+            s.promote(1)
+        is_f, _, _ = s.role(1)
+        assert is_f, "refused promote must leave the follower a follower"
+        # kill the primary: guard disarms once heartbeats stop
+        prim.kill()
+        prim.wait()
+        deadline = time.time() + 10
+        while time.time() < deadline and s.upstream_alive(1):
+            time.sleep(0.1)
+        s.promote(1)  # no force needed now
+        is_f, _, _ = s.role(1)
+        assert not is_f
+    finally:
+        s.close()
+        for p in (prim, fol):
+            try:
+                p.kill()
+                p.wait()
+            except Exception:
+                pass
+
+
+def test_tier_auto_failover_watchdog(tmp_path):
+    """kill -9 the tier primary under a live server running
+    --tier-auto-failover: writes recover WITHOUT any operator action."""
+    import subprocess as sp
+
+    pp, fp = free_port(), free_port()
+    prim = _start_stored([str(pp), str(tmp_path / "p")])
+    fol = _start_stored([str(fp), str(tmp_path / "f"),
+                         "--follow", f"127.0.0.1:{pp}"])
+    cport, peer, info = free_port(), free_port(), free_port()
+    srv = sp.Popen(
+        [sys.executable, "-m", "kubebrain_tpu.cli", "--storage=remote",
+         "--storage-address", f"127.0.0.1:{pp},127.0.0.1:{fp}",
+         "--tier-auto-failover", "--single-node",
+         "--client-port", str(cport), "--peer-port", str(peer),
+         "--info-port", str(info), "--jax-platform", "cpu"],
+        stdout=sp.DEVNULL, stderr=sp.DEVNULL)
+    try:
+        from kubebrain_tpu.client import EtcdCompatClient
+
+        c = EtcdCompatClient(f"127.0.0.1:{cport}")
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            try:
+                ok, _ = c.create(b"/af/boot", b"1")
+                assert ok
+                break
+            except Exception:
+                time.sleep(0.3)
+        else:
+            raise AssertionError("server never served")
+        # make sure the replica is attached before trusting the guard
+        probe = new_storage("remote", address=f"127.0.0.1:{pp}", pool=1)
+        deadline = time.time() + 10
+        while time.time() < deadline and probe.role(0)[2] < 1:
+            time.sleep(0.1)
+        probe.close()
+        prim.kill()
+        prim.wait()
+        # watchdog: 3 misses x 1s probe + failover; writes recover unaided
+        deadline = time.time() + 30
+        recovered = False
+        i = 0
+        while time.time() < deadline:
+            try:
+                ok, _ = c.create(b"/af/k%04d" % i, b"v")
+                if ok:
+                    recovered = True
+                    break
+            except Exception:
+                pass
+            i += 1
+            time.sleep(0.5)
+        assert recovered, "writes never recovered after tier primary death"
+        kvs, _ = c.list(b"/af/", b"/af0")
+        assert {kv.key for kv in kvs} >= {b"/af/boot"}
+    finally:
+        srv.terminate()
+        try:
+            srv.wait(10)
+        except sp.TimeoutExpired:
+            srv.kill()
+        for p in (prim, fol):
+            try:
+                p.kill()
+                p.wait()
+            except Exception:
+                pass
+
+
+def test_failover_adopts_externally_promoted_follower(tmp_path):
+    """Two clients (= two kubebrain servers) over one tier: A fails over
+    first; B's later failover() must ADOPT the freshly-promoted primary —
+    its clock covers everything B observed — instead of refusing it as a
+    stale lineage (which would leave B down against a healthy tier)."""
+    pp, fp = free_port(), free_port()
+    prim = _start_stored([str(pp), str(tmp_path / "p")])
+    fol = _start_stored([str(fp), str(tmp_path / "f"),
+                         "--follow", f"127.0.0.1:{pp}"])
+    a = new_storage("remote", address=f"127.0.0.1:{pp},127.0.0.1:{fp}",
+                    pool=2, timeout=3.0)
+    b = new_storage("remote", address=f"127.0.0.1:{pp},127.0.0.1:{fp}",
+                    pool=2, timeout=3.0)
+    try:
+        _wait_replicas(a, 1)
+        for i in range(10):
+            put(a, b"/ad/a%02d" % i, b"v")
+        for i in range(10):
+            put(b, b"/ad/b%02d" % i, b"v")
+        prim.kill()
+        prim.wait()
+        deadline = time.time() + 10
+        while time.time() < deadline and a.upstream_alive(1):
+            time.sleep(0.1)
+        assert a.failover() == 1   # A promotes
+        assert b.failover() == 1   # B adopts (no second promotion needed)
+        put(b, b"/ad/after", b"x")
+        assert a.get(b"/ad/after") == b"x"
+        assert b.get(b"/ad/a05") == b"v"
+    finally:
+        a.close()
+        b.close()
+        for p in (prim, fol):
+            try:
+                p.kill()
+                p.wait()
+            except Exception:
+                pass
