@@ -160,8 +160,9 @@ class RemoteBatchWrite(BatchWrite):
         if status == ST_OK:
             if len(payload) >= 8:  # commit clock: feeds lineage adoption
                 ts = struct.unpack_from("<Q", payload)[0]
-                if ts > self._store._max_seen_ts:
-                    self._store._max_seen_ts = ts
+                st = self._store
+                if (st._cur_epoch, ts) > st._max_seen:
+                    st._max_seen = (st._cur_epoch, ts)
             return
         if status == ST_CONFLICT:
             r = _Reader(payload)
@@ -280,7 +281,13 @@ class RemoteKvStorage(KvStorage):
         self._frole: dict[int, tuple[float, bool]] = {}  # idx -> (probed_at, is_follower)
         self._fdown: dict[int, float] = {}               # idx -> cooldown deadline
         self._fprobing: set[int] = set()                 # single-flight role probes
-        self._max_seen_ts = 0  # highest tier clock observed (lineage adoption)
+        # highest (epoch, clock) observed anywhere in the tier — epochs are
+        # bumped on promotion and inherited by followers, so lexicographic
+        # comparison distinguishes lineages where raw clocks cannot (a
+        # detached primary's standalone acks can push its clock PAST the
+        # promoted follower's)
+        self._max_seen = (0, 0)
+        self._cur_epoch = 0  # epoch of the member the pool points at
         self._frr = 0
         # probe + cache engine facts
         status, payload = self._call(OP_INFO, b"")
@@ -424,8 +431,8 @@ class RemoteKvStorage(KvStorage):
         if status != ST_OK:
             raise StorageError("TSO failed")
         ts = struct.unpack("<Q", payload)[0]
-        if ts > self._max_seen_ts:
-            self._max_seen_ts = ts
+        if (self._cur_epoch, ts) > self._max_seen:
+            self._max_seen = (self._cur_epoch, ts)
         return ts
 
     def get_partitions(self, start: bytes, end: bytes) -> list[Partition]:
@@ -474,27 +481,39 @@ class RemoteKvStorage(KvStorage):
         finally:
             conn.close()
 
-    def role(self, idx: int | None = None,
-             timeout: float | None = None) -> tuple[bool, int, int]:
-        """(is_follower, clock, attached_replicas) of a tier member."""
+    def member_info(self, idx: int | None = None,
+                    timeout: float | None = None):
+        """(is_follower, clock, attached_replicas, upstream_alive, epoch) of
+        a tier member — the ONE decoder of the ROLE payload. Every
+        observation feeds the (epoch, ts) lineage tracker; pre-epoch
+        daemons report epoch 0."""
         addr = self._addresses[self._primary if idx is None else idx]
         status, payload = self._call_addr(addr, OP_ROLE, b"", timeout=timeout)
         if status != ST_OK:
             raise StorageError(f"ROLE failed (status {status})")
         r = _Reader(payload)
         is_f, ts, n_rep = bool(r.u8()), r.u64(), r.u32()
-        if not is_f and ts > self._max_seen_ts:
-            self._max_seen_ts = ts
+        alive = bool(r.u8()) if len(payload) >= 14 else False
+        epoch = r.u64() if len(payload) >= 22 else 0
+        if (epoch, ts) > self._max_seen:
+            self._max_seen = (epoch, ts)
+        if idx is None or idx == self._primary:
+            self._cur_epoch = max(self._cur_epoch, epoch)
+        return is_f, ts, n_rep, alive, epoch
+
+    def role(self, idx: int | None = None,
+             timeout: float | None = None) -> tuple[bool, int, int]:
+        """(is_follower, clock, attached_replicas) of a tier member."""
+        is_f, ts, n_rep, _, _ = self.member_info(idx, timeout=timeout)
         return is_f, ts, n_rep
 
     def upstream_alive(self, idx: int, timeout: float | None = None) -> bool:
         """Does the follower at ``idx`` still receive its primary's stream
         (heartbeats included)? The client side of the split-brain guard."""
-        addr = self._addresses[idx]
-        status, payload = self._call_addr(addr, OP_ROLE, b"", timeout=timeout)
-        if status != ST_OK or len(payload) < 14:
+        try:
+            return self.member_info(idx, timeout=timeout)[3]
+        except (OSError, EOFError, StorageError):
             return False
-        return bool(payload[13])
 
     def promote(self, idx: int, force: bool = False) -> None:
         """Promote the follower at ``idx`` to primary (idempotent). The
@@ -524,26 +543,32 @@ class RemoteKvStorage(KvStorage):
                 # answers PROMOTE with an idempotent OK, and repointing at
                 # it would silently abandon every write acked since the
                 # first failover (stale-lineage guard)
-                is_follower, cand_ts, _ = self.role(idx)
+                is_follower, cand_ts, _, _, cand_epoch = self.member_info(idx)
                 if not is_follower:
-                    # already a primary. Adopt it ONLY when its clock is at
-                    # least everything this client ever observed — true for
-                    # a follower some other actor just promoted (semi-sync:
-                    # follower clock >= every acked write we saw), false
-                    # for a restarted OLD primary that missed post-failover
-                    # writes (stale lineage -> refuse).
-                    if cand_ts >= self._max_seen_ts:
+                    # already a primary. Adopt it ONLY when its lineage is
+                    # at least everything this client ever observed —
+                    # lexicographic (epoch, ts): a freshly-promoted
+                    # follower carries a HIGHER epoch; a restarted old
+                    # primary carries an older epoch no matter how far its
+                    # standalone-acked clock ran ahead.
+                    if (cand_epoch, cand_ts) >= self._max_seen:
+                        self._cur_epoch = cand_epoch
                         self._repoint(idx, addr)
                         return idx
                     last_exc = StorageError(
                         f"{addr} is a primary of a stale lineage "
-                        f"(ts {cand_ts} < observed {self._max_seen_ts}); refusing")
+                        f"((epoch, ts) ({cand_epoch}, {cand_ts}) < observed "
+                        f"{self._max_seen}); refusing")
                     continue
                 self.promote(idx, force=force)
             except (OSError, EOFError, StorageError) as exc:
                 last_exc = exc
                 continue
             self._repoint(idx, addr)
+            try:
+                self.member_info(idx)  # learn the bumped epoch
+            except Exception:
+                pass
             return idx
         raise StorageError(f"no promotable follower reachable: {last_exc}")
 

@@ -55,7 +55,10 @@
 //                - the split-brain guard: a healthy primary means the
 //                promoter is the partitioned one.
 //  14 ROLE       -   -> u8 is_follower | u64 ts | u32 n_replicas |
-//                u8 upstream_alive
+//                u8 upstream_alive | u64 epoch (lineage counter, bumped on
+//                every promotion, inherited by followers — adoption
+//                decisions compare (epoch, ts) lexicographically because
+//                clocks alone cannot distinguish lineages)
 //
 // Scan paging is client-driven (stateless server): 'more' set when the page
 // cap truncated a forward scan; the client re-issues from last_key+\0.
@@ -156,6 +159,32 @@ constexpr uint8_t ST_OK = 0, ST_NOT_FOUND = 1, ST_CONFLICT = 2, ST_WAL = 3,
 constexpr uint32_t SCAN_PAGE_CAP = 2048;
 
 void *g_store = nullptr;
+// Lineage epoch: bumped on every promotion, inherited by followers from
+// their primary's HELLO response, persisted next to the data. Clock values
+// cannot distinguish lineages (a detached primary keeps acking standalone
+// and its clock can exceed the promoted follower's); the epoch can.
+uint64_t g_epoch = 0;
+std::string g_epoch_path;  // empty = in-memory only
+bool g_primary_sends_hb = false;  // follower: primary heartbeat capability
+
+void persist_epoch() {
+  if (g_epoch_path.empty()) return;
+  FILE *f = fopen((g_epoch_path + ".tmp").c_str(), "wb");
+  if (f == nullptr) return;
+  fprintf(f, "%llu", static_cast<unsigned long long>(g_epoch));
+  fflush(f);
+  fclose(f);
+  rename((g_epoch_path + ".tmp").c_str(), g_epoch_path.c_str());
+}
+
+void load_epoch() {
+  if (g_epoch_path.empty()) return;
+  FILE *f = fopen(g_epoch_path.c_str(), "rb");
+  if (f == nullptr) return;
+  unsigned long long e = 0;
+  if (fscanf(f, "%llu", &e) == 1) g_epoch = e;
+  fclose(f);
+}
 
 // ---------------------------------------------------------- little helpers
 struct Reader {
@@ -637,11 +666,17 @@ void handle_repl_op(SConn *c, uint8_t op, Reader &r, uint64_t req_id) {
     put_num<uint64_t>(body, kb_tso(g_store));
     put_num<uint32_t>(body, static_cast<uint32_t>(g_replicas.size()));
     put_u8(body, (g_follower && g_upstream != nullptr &&
-                  now_ms() - g_up_last_ms < 1000) ? 1 : 0);
+                  (!g_primary_sends_hb || now_ms() - g_up_last_ms < 1000))
+                     ? 1
+                     : 0);
+    put_num<uint64_t>(body, g_epoch);
   } else if (op == OP_PROMOTE) {
     uint8_t force = r.n > r.off ? r.num<uint8_t>() : 0;
+    // guard: with a heartbeat-capable primary, "alive" = traffic within 1s;
+    // with a pre-heartbeat primary the only safe signal is the connected
+    // stream itself (an idle-but-healthy old primary sends nothing)
     if (g_follower && !force && g_upstream != nullptr &&
-        now_ms() - g_up_last_ms < 1000) {
+        (!g_primary_sends_hb || now_ms() - g_up_last_ms < 1000)) {
       // split-brain guard: our replication stream from the primary is
       // demonstrably alive, so whoever asked to promote us is partitioned
       // from a healthy primary — refuse (raft would refuse via terms; this
@@ -653,8 +688,11 @@ void handle_repl_op(SConn *c, uint8_t op, Reader &r, uint64_t req_id) {
       if (g_upstream != nullptr) {
         doom_conn(g_upstream);  // reaped after the current events batch
       }
-      fprintf(stderr, "[kbstored] PROMOTED to primary at ts=%llu%s\n",
+      ++g_epoch;  // new lineage
+      persist_epoch();
+      fprintf(stderr, "[kbstored] PROMOTED to primary at ts=%llu epoch=%llu%s\n",
               static_cast<unsigned long long>(kb_tso(g_store)),
+              static_cast<unsigned long long>(g_epoch),
               force ? " (forced)" : "");
     }
   } else if (op == OP_REPL_HELLO) {
@@ -679,12 +717,22 @@ void handle_repl_op(SConn *c, uint8_t op, Reader &r, uint64_t req_id) {
       c->caps = caps;
       c->acked = fts;
       g_replicas.push_back(c);
+      // flags byte: bit0 dump follows, bit1 primary sends heartbeats, bit2
+      // epoch u64 follows (bits 1-2 only for caps-advertising followers —
+      // pre-caps binaries would misread extra bytes as dump content)
+      uint8_t flags = 0;
+      std::string extra;
+      if (caps & 1) {
+        flags |= 2 | 4;
+        put_num<uint64_t>(extra, g_epoch);
+      }
       if (fts < myts) {
         uint8_t *dump = nullptr;
         size_t dlen = 0;
         uint64_t dts = 0;
         if (kb_dump_wire(g_store, &dump, &dlen, &dts) == 0) {
-          put_u8(body, 1);
+          put_u8(body, flags | 1);
+          body.append(extra);
           body.append(reinterpret_cast<char *>(dump), dlen);
           kb_free(dump);
         } else {
@@ -694,7 +742,8 @@ void handle_repl_op(SConn *c, uint8_t op, Reader &r, uint64_t req_id) {
           body = "dump failed";
         }
       } else {
-        put_u8(body, 0);
+        put_u8(body, flags);
+        body.append(extra);
       }
       fprintf(stderr, "[kbstored] replica attached (follower_ts=%llu my_ts=%llu)\n",
               static_cast<unsigned long long>(fts),
@@ -784,9 +833,26 @@ bool upstream_ingest(SConn *c) {
         ok = false;  // transient (target not yet primary?) — retry later
         break;
       }
-      if (body[0] == 1) {  // bootstrap dump
+      uint8_t flags = body[0];
+      size_t off2 = 1;
+      g_primary_sends_hb = (flags & 2) != 0;
+      if (flags & 4) {
+        if (blen < off2 + 8) {
+          ok = false;
+          off += 13 + blen;
+          continue;
+        }
+        uint64_t pe;
+        memcpy(&pe, body + off2, 8);
+        off2 += 8;
+        if (pe != g_epoch) {
+          g_epoch = pe;  // inherit the primary's lineage
+          persist_epoch();
+        }
+      }
+      if (flags & 1) {  // bootstrap dump
         uint64_t ats = 0;
-        int rc = kb_apply_record(g_store, body + 1, blen - 1, 1, &ats);
+        int rc = kb_apply_record(g_store, body + off2, blen - off2, 1, &ats);
         if (rc != 0) {
           fprintf(stderr, "[kbstored] dump apply failed rc=%d\n", rc);
           ok = false;
@@ -909,6 +975,10 @@ int main(int argc, char **argv) {
   if (g_store == nullptr) {
     fprintf(stderr, "[kbstored] failed to open store at %s\n", dir);
     return 1;
+  }
+  if (dir[0]) {
+    g_epoch_path = std::string(dir) + "/epoch";
+    load_epoch();
   }
   kb_set_commit_hook(g_store, commit_hook, nullptr);
 
