@@ -139,16 +139,28 @@ def _apply(op: Op, state):
     raise ValueError(f"unknown op kind {op.kind!r}")
 
 
+class BudgetExhausted(Exception):
+    """The Wing-Gong search was truncated before reaching a verdict.
+
+    A truncated search proves nothing — in particular it must NOT count as a
+    pass (the histories hard enough to exhaust the budget are exactly the
+    ones most likely to hide an anomaly). check() surfaces this as a failed
+    result unless the caller explicitly opts into permissive mode."""
+
+
 def _check_key(ops: list[Op], node_budget: int = 2_000_000):
     """Wing-Gong search with memoization over (remaining-set, state).
 
     An op may be linearized first among the remaining ops iff no other
     remaining op returned before it was called. Unknown-outcome ops may also
-    be dropped entirely (they never took effect)."""
+    be dropped entirely (they never took effect).
+
+    Returns (ok, why, nodes_searched). Raises BudgetExhausted when the
+    node budget runs out before a verdict."""
     ops = sorted(ops, key=lambda o: (o.call, o.ret))
     n = len(ops)
     if n == 0:
-        return True, None
+        return True, None, 0
     calls = [o.call for o in ops]
     rets = [o.ret for o in ops]
     full = (1 << n) - 1
@@ -160,14 +172,17 @@ def _check_key(ops: list[Op], node_budget: int = 2_000_000):
     while stack:
         mask, state = stack.pop()
         if mask == 0:
-            return True, None
+            return True, None, nodes
         key = (mask, state)
         if key in seen:
             continue
         seen.add(key)
         nodes += 1
         if nodes > node_budget:
-            return True, "search budget exhausted (treated as pass)"
+            raise BudgetExhausted(
+                f"key {ops[0].key!r}: search budget ({node_budget} nodes) "
+                f"exhausted over {n} ops — no verdict"
+            )
         min_ret = math.inf
         m = mask
         while m:
@@ -191,7 +206,7 @@ def _check_key(ops: list[Op], node_budget: int = 2_000_000):
     return False, (
         f"key {first.key!r}: no legal linearization of {n} ops "
         f"(first op {first.kind} @ {first.call:.6f})"
-    )
+    ), nodes
 
 
 class History:
@@ -238,24 +253,54 @@ class History:
                 )
         return None
 
-    def check(self, node_budget: int = 2_000_000) -> dict:
+    def check(self, node_budget: int = 2_000_000, strict: bool = True) -> dict:
+        """Check the whole history. Strict by default: a key whose search
+        exhausts the node budget FAILS the check (no verdict is not a pass).
+        Pass strict=False only for exploratory runs; the result then carries
+        truncated_keys so the caller can still see what was unproven.
+
+        The result always records nodes_searched (total) and max_key_nodes so
+        soaks can size their histories to fit the budget with headroom."""
         v = self._check_global_revisions()
         if v is not None:
             return {"ok": False, "violation": v, "ops": len(self.ops)}
         per_key: dict[bytes, list[Op]] = {}
         for o in self.ops:
             per_key.setdefault(o.key, []).append(o)
-        budget_note = None
+        total_nodes = 0
+        max_key_nodes = 0
+        truncated: list[bytes] = []
         for key, ops in per_key.items():
-            ok, why = _check_key(ops, node_budget=node_budget)
+            try:
+                ok, why, nodes = _check_key(ops, node_budget=node_budget)
+            except BudgetExhausted as e:
+                if strict:
+                    return {
+                        "ok": False,
+                        "violation": str(e),
+                        "truncated": True,
+                        "ops": len(self.ops),
+                        "nodes_searched": total_nodes + node_budget,
+                    }
+                truncated.append(key)
+                total_nodes += node_budget
+                max_key_nodes = max(max_key_nodes, node_budget)
+                continue
+            total_nodes += nodes
+            max_key_nodes = max(max_key_nodes, nodes)
             if not ok:
-                return {"ok": False, "violation": why, "ops": len(self.ops)}
-            if why:
-                budget_note = why
+                return {
+                    "ok": False,
+                    "violation": why,
+                    "ops": len(self.ops),
+                    "nodes_searched": total_nodes,
+                }
         return {
             "ok": True,
             "violation": None,
             "ops": len(self.ops),
             "keys": len(per_key),
-            "note": budget_note,
+            "nodes_searched": total_nodes,
+            "max_key_nodes": max_key_nodes,
+            "truncated_keys": truncated,
         }

@@ -31,3 +31,109 @@ jax.config.update("jax_platforms", "cpu")
 import faulthandler  # noqa: E402
 
 faulthandler.dump_traceback_later(480, repeat=True)
+
+# ---------------------------------------------------------------------------
+# Per-test hard deadline (VERDICT r3 weak #4 / next #8): a wedged test —
+# typically a multi-process one blocked on a dead kbstored/kbfront handoff —
+# must become a RED test with a stack trace, not a silent multi-minute CI
+# hang. SIGALRM fires in the main thread (where pytest runs the test), dumps
+# every thread's stack straight to the unbuffered real stderr (pytest's
+# captured stderr is block-buffered and loses the dump on kill), reaps any
+# child processes the test left wedged, and raises into the test.
+# Override per test with @pytest.mark.deadline(seconds); 0 disables.
+
+import signal  # noqa: E402
+import sys  # noqa: E402
+
+import pytest  # noqa: E402
+
+_DEADLINE_DEFAULT = 240.0
+
+
+class TestDeadlineError(Exception):
+    """The test exceeded its hard deadline (see conftest watchdog)."""
+
+
+def _descendants(pid):
+    """All descendant PIDs of `pid` via /proc (no psutil in this image)."""
+    children = {}
+    try:
+        for entry in os.listdir("/proc"):
+            if not entry.isdigit():
+                continue
+            try:
+                with open(f"/proc/{entry}/stat", "rb") as f:
+                    parts = f.read().split(b")")[-1].split()
+                children.setdefault(int(parts[1]), []).append(int(entry))
+            except OSError:
+                continue
+    except OSError:
+        return []
+    out, queue = [], [pid]
+    while queue:
+        for c in children.get(queue.pop(), ()):
+            out.append(c)
+            queue.append(c)
+    return out
+
+
+def _deadline_for(item):
+    m = item.get_closest_marker("deadline")
+    if m is not None and m.args:
+        return float(m.args[0])
+    return _DEADLINE_DEFAULT
+
+
+def _phase_guard(item, phase):
+    deadline = _deadline_for(item)
+    if deadline <= 0:
+        yield
+        return
+    # Only processes spawned DURING the wedged phase are reaped: killing all
+    # descendants would take down module/session-scoped fixture servers
+    # (kbstored/kbfront) shared by the rest of the module and bury the real
+    # failure under cascading connection errors.
+    preexisting = set(_descendants(os.getpid()))
+
+    def on_alarm(signum, frame):
+        sys.__stderr__.write(
+            f"\n[deadline] test {item.nodeid} exceeded {deadline:.0f}s "
+            f"in {phase}; dumping stacks and killing children\n"
+        )
+        faulthandler.dump_traceback(file=sys.__stderr__)
+        kids = [k for k in _descendants(os.getpid()) if k not in preexisting]
+        for k in kids:
+            try:
+                os.kill(k, signal.SIGKILL)
+            except OSError:
+                pass
+        if kids:
+            sys.__stderr__.write(f"[deadline] SIGKILLed children: {kids}\n")
+        sys.__stderr__.flush()
+        raise TestDeadlineError(
+            f"{item.nodeid}: exceeded {deadline:.0f}s deadline during {phase} "
+            f"(stacks on stderr; {len(kids)} child process(es) reaped)"
+        )
+
+    old_handler = signal.signal(signal.SIGALRM, on_alarm)
+    signal.setitimer(signal.ITIMER_REAL, deadline)
+    try:
+        yield
+    finally:
+        signal.setitimer(signal.ITIMER_REAL, 0)
+        signal.signal(signal.SIGALRM, old_handler)
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_setup(item):
+    yield from _phase_guard(item, "setup")
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_call(item):
+    yield from _phase_guard(item, "call")
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_teardown(item):
+    yield from _phase_guard(item, "teardown")

@@ -150,6 +150,63 @@ def test_unknown_op_both_branches():
     assert not bad["ok"]
 
 
+def _hard_history(n_ops=20):
+    """A single-key history that forces near-exhaustive search: n overlapping
+    unknown-outcome creates (any subset may have landed, in any order) plus a
+    completed read of a value nobody wrote. Proving it non-linearizable means
+    visiting O(2^n) (mask, state) nodes — exactly the shape that exhausts a
+    node budget before reaching a verdict."""
+    ops = [
+        Op(i, "create", b"k", 0.0, math.inf, value=b"v%d" % i, ok=None)
+        for i in range(n_ops)
+    ]
+    ops.append(Op(99, "get", b"k", 5.0, 6.0, value=b"nope", ok=True, rev=999))
+    h = History()
+    h.ops = ops
+    return h
+
+
+def test_budget_exhaustion_fails_strict():
+    """VERDICT r3 weak #5: a truncated search must NOT count as a pass.
+
+    This history previously returned ok=True with a "budget exhausted" note;
+    strict mode (the default) now fails it loudly with truncated=True."""
+    h = _hard_history()
+    res = h.check(node_budget=50)
+    assert not res["ok"]
+    assert res.get("truncated") is True
+    assert "budget" in res["violation"]
+    # permissive mode still completes, but names the unproven keys
+    loose = h.check(node_budget=50, strict=False)
+    assert loose["ok"] and loose["truncated_keys"] == [b"k"]
+
+
+def test_budget_exhaustion_cannot_mask_seeded_bug():
+    """A real violation buried in a budget-busting history must never come
+    back as a pass: either the search reaches a verdict (big budget, real
+    violation reported) or strict mode fails on truncation (small budget).
+    Both are red — green is impossible."""
+    h = _hard_history(n_ops=12)  # small enough to finish under the big budget
+    # seeded lost-acked-write bug: acked create then completed not-found read
+    h.ops.append(Op(90, "create", b"bug", 0.0, 1.0, value=b"a", ok=True, rev=1))
+    h.ops.append(Op(91, "get", b"bug", 2.0, 3.0, ok=False))
+    small = h.check(node_budget=50)
+    assert not small["ok"] and small.get("truncated")  # truncation -> red
+    big = h.check(node_budget=5_000_000)
+    assert not big["ok"] and not big.get("truncated")  # full verdict -> red
+
+def test_check_reports_nodes_searched():
+    h = History()
+    h.ops = [
+        Op(0, "create", b"k", 0.0, 1.0, value=b"a", ok=True, rev=1),
+        Op(0, "get", b"k", 2.0, 3.0, value=b"a", ok=True, rev=1),
+    ]
+    res = h.check()
+    assert res["ok"] and res["nodes_searched"] > 0
+    assert res["max_key_nodes"] <= res["nodes_searched"]
+    assert res["truncated_keys"] == []
+
+
 # ------------------------------------------------- live soak vs real backend
 class _Recorder:
     """Wraps a Backend; records every op into a History."""

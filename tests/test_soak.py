@@ -130,9 +130,11 @@ def test_failover_under_load_no_acked_writes_lost():
                 # key absent from the final state: a completed not-found read
                 history.record(99, "get", op.key, t_end, t_end + 0.001, ok=False)
                 seen_keys.add(op.key)
-        res = history.check()
+        res = history.check()  # strict: budget exhaustion fails, not passes
         assert res["ok"], f"soak history not linearizable: {res['violation']}"
         assert res["ops"] > 100
+        print(f"[soak-lincheck] ops={res['ops']} keys={res['keys']} "
+              f"nodes={res['nodes_searched']} max_key={res['max_key_nodes']}")
     finally:
         for n in nodes:
             try:
