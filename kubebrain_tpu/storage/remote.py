@@ -151,6 +151,12 @@ class RemoteBatchWrite(BatchWrite):
             _bytes_field(body, old)
         ops = self._ops
         self._ops = []
+        # capture the epoch BEFORE the call: a failover completing while this
+        # response is in flight must not tag the OLD primary's (possibly
+        # far-ahead, standalone-acked) clock with the NEW epoch — that would
+        # poison _max_seen above anything the new lineage produces and make
+        # later failovers refuse healthy primaries
+        epoch_at_send = self._store._epoch_snapshot()
         try:
             status, payload = self._store._write_call(OP_BATCH, bytes(body))
         except (OSError, EOFError) as exc:
@@ -160,9 +166,7 @@ class RemoteBatchWrite(BatchWrite):
         if status == ST_OK:
             if len(payload) >= 8:  # commit clock: feeds lineage adoption
                 ts = struct.unpack_from("<Q", payload)[0]
-                st = self._store
-                if (st._cur_epoch, ts) > st._max_seen:
-                    st._max_seen = (st._cur_epoch, ts)
+                self._store._observe(ts, epoch_at_send)
             return
         if status == ST_CONFLICT:
             r = _Reader(payload)
@@ -294,8 +298,33 @@ class RemoteKvStorage(KvStorage):
         if status != ST_OK:
             raise StorageError("kbstored INFO failed")
         self._support_ttl = bool(payload[0])
+        # Probe ROLE up front so _cur_epoch/_max_seen are epoch-tagged BEFORE
+        # any adoption decision: without this, commit/TSO observations are
+        # tagged (0, ts) and the very first failover() could adopt a
+        # restarted stale primary whose persisted epoch >= 1 (r3 advisor,
+        # medium). Best-effort: pre-epoch daemons simply report epoch 0.
+        try:
+            self.member_info()
+        except (OSError, EOFError, StorageError):
+            pass
 
     # ------------------------------------------------------------- plumbing
+    def _observe(self, ts: int, epoch: int) -> None:
+        """Fold a lineage observation into the (epoch, ts) watermark under
+        the lock: these are read-modify-writes from many threads (commit,
+        TSO, role probes) and a lost update would lower the watermark the
+        split-brain adoption guard depends on (r3 advisor, low). Callers on
+        the commit/TSO paths must pass the epoch snapshotted BEFORE the
+        request went out (_epoch_snapshot), never the live _cur_epoch — see
+        RemoteBatchWrite.commit."""
+        with self._rr_lock:
+            if (epoch, ts) > self._max_seen:
+                self._max_seen = (epoch, ts)
+
+    def _epoch_snapshot(self) -> int:
+        with self._rr_lock:
+            return self._cur_epoch
+
     def _conn(self) -> tuple[int, _PooledConn]:
         with self._rr_lock:
             self._rr = (self._rr + 1) % len(self._pool)
@@ -427,12 +456,12 @@ class RemoteKvStorage(KvStorage):
 
     # ------------------------------------------------------------- contract
     def get_timestamp_oracle(self) -> int:
+        epoch_at_send = self._epoch_snapshot()  # see _observe docstring
         status, payload = self._call(OP_TSO, b"")
         if status != ST_OK:
             raise StorageError("TSO failed")
         ts = struct.unpack("<Q", payload)[0]
-        if (self._cur_epoch, ts) > self._max_seen:
-            self._max_seen = (self._cur_epoch, ts)
+        self._observe(ts, epoch_at_send)
         return ts
 
     def get_partitions(self, start: bytes, end: bytes) -> list[Partition]:
@@ -495,10 +524,10 @@ class RemoteKvStorage(KvStorage):
         is_f, ts, n_rep = bool(r.u8()), r.u64(), r.u32()
         alive = bool(r.u8()) if len(payload) >= 14 else False
         epoch = r.u64() if len(payload) >= 22 else 0
-        if (epoch, ts) > self._max_seen:
-            self._max_seen = (epoch, ts)
-        if idx is None or idx == self._primary:
-            self._cur_epoch = max(self._cur_epoch, epoch)
+        self._observe(ts, epoch)
+        with self._rr_lock:
+            if idx is None or idx == self._primary:
+                self._cur_epoch = max(self._cur_epoch, epoch)
         return is_f, ts, n_rep, alive, epoch
 
     def role(self, idx: int | None = None,
@@ -551,8 +580,11 @@ class RemoteKvStorage(KvStorage):
                     # follower carries a HIGHER epoch; a restarted old
                     # primary carries an older epoch no matter how far its
                     # standalone-acked clock ran ahead.
-                    if (cand_epoch, cand_ts) >= self._max_seen:
-                        self._cur_epoch = cand_epoch
+                    with self._rr_lock:
+                        adoptable = (cand_epoch, cand_ts) >= self._max_seen
+                        if adoptable:
+                            self._cur_epoch = cand_epoch
+                    if adoptable:
                         self._repoint(idx, addr)
                         return idx
                     last_exc = StorageError(

@@ -165,26 +165,56 @@ void *g_store = nullptr;
 // and its clock can exceed the promoted follower's); the epoch can.
 uint64_t g_epoch = 0;
 std::string g_epoch_path;  // empty = in-memory only
+// Visibility floor: a bootstrap dump flattens each key's MVCC history to a
+// single record at the dump ts (kb_dump_wire), so snapshots OLDER than the
+// last dump this node applied are unservable — a pinned read below the
+// floor would see keys as silently absent. Tracked per node, persisted so a
+// restarted follower keeps refusing what it genuinely does not have.
+uint64_t g_vis_floor = 0;
+std::string g_floor_path;  // empty = in-memory only
 bool g_primary_sends_hb = false;  // follower: primary heartbeat capability
 
-void persist_epoch() {
-  if (g_epoch_path.empty()) return;
-  FILE *f = fopen((g_epoch_path + ".tmp").c_str(), "wb");
-  if (f == nullptr) return;
-  fprintf(f, "%llu", static_cast<unsigned long long>(g_epoch));
-  fflush(f);
-  fclose(f);
-  rename((g_epoch_path + ".tmp").c_str(), g_epoch_path.c_str());
+// Durable tmp+rename+fsync write: the epoch is exactly the datum that must
+// survive the crash window around a promotion (a freshly promoted primary
+// restarting with its pre-promotion epoch would look stale to the client's
+// lineage guard), so fsync the tmp file before the rename and the directory
+// after it.
+void persist_u64(const std::string &path, uint64_t v) {
+  if (path.empty()) return;
+  std::string tmp = path + ".tmp";
+  int fd = open(tmp.c_str(), O_WRONLY | O_CREAT | O_TRUNC, 0644);
+  if (fd < 0) return;
+  char buf[32];
+  int n = snprintf(buf, sizeof buf, "%llu", static_cast<unsigned long long>(v));
+  ssize_t w = write(fd, buf, static_cast<size_t>(n));
+  if (w != n || fsync(fd) != 0) {
+    close(fd);
+    unlink(tmp.c_str());
+    return;
+  }
+  close(fd);
+  if (rename(tmp.c_str(), path.c_str()) != 0) return;
+  size_t slash = path.find_last_of('/');
+  std::string dir = slash == std::string::npos ? "." : path.substr(0, slash);
+  int dfd = open(dir.c_str(), O_RDONLY | O_DIRECTORY);
+  if (dfd >= 0) {
+    fsync(dfd);
+    close(dfd);
+  }
 }
 
-void load_epoch() {
-  if (g_epoch_path.empty()) return;
-  FILE *f = fopen(g_epoch_path.c_str(), "rb");
-  if (f == nullptr) return;
-  unsigned long long e = 0;
-  if (fscanf(f, "%llu", &e) == 1) g_epoch = e;
+uint64_t load_u64(const std::string &path, uint64_t fallback) {
+  if (path.empty()) return fallback;
+  FILE *f = fopen(path.c_str(), "rb");
+  if (f == nullptr) return fallback;
+  unsigned long long e = fallback;
+  if (fscanf(f, "%llu", &e) != 1) e = fallback;
   fclose(f);
+  return e;
 }
+
+void persist_epoch() { persist_u64(g_epoch_path, g_epoch); }
+void persist_floor() { persist_u64(g_floor_path, g_vis_floor); }
 
 // ---------------------------------------------------------- little helpers
 struct Reader {
@@ -549,11 +579,25 @@ void commit_hook(void *, const uint8_t *rec, size_t len, uint64_t ts) {
 }
 
 bool follower_behind(uint64_t snap, std::string &body) {
-  if (!g_follower || snap == 0) return false;  // snap 0 = explicit "latest"
+  if (snap == 0) return false;  // snap 0 = explicit "latest"
+  // fast path: a primary with no dump history serves every snapshot —
+  // don't pay kb_tso's shared lock on the hot read path for nothing
+  if (!g_follower && g_vis_floor == 0) return false;
   uint64_t ts = kb_tso(g_store);
-  if (snap <= ts) return false;
-  put_num<uint64_t>(body, ts);
-  return true;
+  // Behind: a follower cannot serve a snapshot it has not applied yet.
+  if (g_follower && snap > ts) {
+    put_num<uint64_t>(body, ts);
+    return true;
+  }
+  // Below the visibility floor: a bootstrap dump flattened history at the
+  // floor ts, so older snapshots would see keys as silently absent (the
+  // r3 advisor's follower-read hole). Applies on primaries too — a
+  // promoted follower does not grow the history back.
+  if (snap < g_vis_floor) {
+    put_num<uint64_t>(body, ts);
+    return true;
+  }
+  return false;
 }
 
 void conn_update(SConn *c) {
@@ -857,9 +901,18 @@ bool upstream_ingest(SConn *c) {
           fprintf(stderr, "[kbstored] dump apply failed rc=%d\n", rc);
           ok = false;
         } else {
+          if (ats > g_vis_floor) {
+            // the dump flattened history at ats: older snaps are now
+            // unservable from this node, forever (even after promotion)
+            g_vis_floor = ats;
+            persist_floor();
+          }
           upstream_send_ack(c, ats);
-          fprintf(stderr, "[kbstored] bootstrapped from primary at ts=%llu\n",
-                  static_cast<unsigned long long>(ats));
+          fprintf(stderr,
+                  "[kbstored] bootstrapped from primary at ts=%llu "
+                  "(visibility floor %llu)\n",
+                  static_cast<unsigned long long>(ats),
+                  static_cast<unsigned long long>(g_vis_floor));
         }
       }
     } else if (req_id == 0 && status == ST_OK && blen == 0) {
@@ -978,7 +1031,9 @@ int main(int argc, char **argv) {
   }
   if (dir[0]) {
     g_epoch_path = std::string(dir) + "/epoch";
-    load_epoch();
+    g_epoch = load_u64(g_epoch_path, 0);
+    g_floor_path = std::string(dir) + "/visfloor";
+    g_vis_floor = load_u64(g_floor_path, 0);
   }
   kb_set_commit_hook(g_store, commit_hook, nullptr);
 

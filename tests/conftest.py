@@ -92,23 +92,30 @@ def _phase_guard(item, phase):
     # Only processes spawned DURING the wedged phase are reaped: killing all
     # descendants would take down module/session-scoped fixture servers
     # (kbstored/kbfront) shared by the rest of the module and bury the real
-    # failure under cascading connection errors.
-    preexisting = set(_descendants(os.getpid()))
+    # failure under cascading connection errors. Setup is exempt entirely —
+    # a module-scoped server fixture can start INSIDE this test's setup
+    # phase and must survive for the rest of the module, so a setup timeout
+    # only dumps stacks and raises (any child the wedged fixture spawned is
+    # left to session teardown).
+    reap = phase != "setup"
+    preexisting = set(_descendants(os.getpid())) if reap else set()
 
     def on_alarm(signum, frame):
         sys.__stderr__.write(
             f"\n[deadline] test {item.nodeid} exceeded {deadline:.0f}s "
-            f"in {phase}; dumping stacks and killing children\n"
+            f"in {phase}; dumping stacks\n"
         )
         faulthandler.dump_traceback(file=sys.__stderr__)
-        kids = [k for k in _descendants(os.getpid()) if k not in preexisting]
-        for k in kids:
-            try:
-                os.kill(k, signal.SIGKILL)
-            except OSError:
-                pass
-        if kids:
-            sys.__stderr__.write(f"[deadline] SIGKILLed children: {kids}\n")
+        kids = []
+        if reap:
+            kids = [k for k in _descendants(os.getpid()) if k not in preexisting]
+            for k in kids:
+                try:
+                    os.kill(k, signal.SIGKILL)
+                except OSError:
+                    pass
+            if kids:
+                sys.__stderr__.write(f"[deadline] SIGKILLed children: {kids}\n")
         sys.__stderr__.flush()
         raise TestDeadlineError(
             f"{item.nodeid}: exceeded {deadline:.0f}s deadline during {phase} "

@@ -700,6 +700,69 @@ def test_follower_read_routing(tmp_path):
         fol.wait()
 
 
+def test_follower_visibility_floor(tmp_path):
+    """A follower bootstrapped by a dump flattens history at the dump ts, so
+    snapshots OLDER than that are unservable from it (r3 advisor, high): it
+    must answer ST_DRIFT — routing then falls back to the primary, which
+    still has the full history — instead of silently returning not-found /
+    empty scans. The floor survives promotion: a promoted ex-follower keeps
+    refusing pre-dump snapshots loudly."""
+    from kubebrain_tpu.storage.errors import StorageError
+
+    pp, fp = free_port(), free_port()
+    prim = _start_stored([str(pp), str(tmp_path / "p")])
+    s = new_storage("remote", address=f"127.0.0.1:{pp},127.0.0.1:{fp}",
+                    pool=2, read_followers=True, timeout=3.0)
+    try:
+        for i in range(20):
+            put(s, b"/vf/k%02d" % i, b"v%02d" % i)
+        old_snap = s.get_timestamp_oracle()
+        for i in range(20, 40):  # advance the clock past old_snap
+            put(s, b"/vf/k%02d" % i, b"v%02d" % i)
+        # follower attaches NOW -> bootstrap dump at a ts > old_snap
+        fol = _start_stored([str(fp), str(tmp_path / "f"),
+                             "--follow", f"127.0.0.1:{pp}"])
+        try:
+            _wait_replicas(s, 1)
+            _wait_follower_ts(s, 1, s.get_timestamp_oracle())
+            # routed read pinned BELOW the follower's floor: the follower
+            # drifts, the client falls back to the primary — full data
+            assert s.get(b"/vf/k05", snapshot_ts=old_snap) == b"v05"
+            rows = list(s.iter(b"/vf/", b"/vf0", snapshot_ts=old_snap))
+            assert len(rows) == 20, f"paged LIST lost rows: {len(rows)}"
+            # a direct read off the follower refuses loudly (no silent miss)
+            f_store = new_storage("remote", address=f"127.0.0.1:{fp}", pool=1,
+                                  timeout=3.0)
+            try:
+                with pytest.raises(StorageError):
+                    f_store.get(b"/vf/k05", snapshot_ts=old_snap)
+                # at/above the floor the follower serves normally
+                assert f_store.get(b"/vf/k05") == b"v05"
+            finally:
+                f_store.close()
+            # floor survives promotion: kill the primary, promote, and the
+            # pre-dump snapshot stays loudly unservable (NOT not-found)
+            prim.kill()
+            prim.wait()
+            deadline = time.time() + 10
+            while time.time() < deadline and s.upstream_alive(1):
+                time.sleep(0.1)
+            s.failover()
+            assert s.get(b"/vf/k05") == b"v05"  # latest still fine
+            with pytest.raises(StorageError):
+                s.get(b"/vf/k05", snapshot_ts=old_snap)
+        finally:
+            fol.kill()
+            fol.wait()
+    finally:
+        s.close()
+        try:
+            prim.kill()
+            prim.wait()
+        except Exception:
+            pass
+
+
 def test_promote_refused_while_primary_alive(tmp_path):
     """Split-brain guard: a follower whose replication stream (heartbeats
     included — the primary may be idle) is alive refuses PROMOTE; force=1
