@@ -213,6 +213,17 @@ class Server:
                     misses += 1
                 if misses < failures:
                     continue
+                # Quorum tier (kbstored --peers): leadership moved by
+                # internal election — just find it. Legacy tier: no one
+                # self-elects, so promote a follower via failover().
+                try:
+                    idx = store.find_leader()
+                    log.warning("tier primary unreachable %d probes; "
+                                "repointed at elected leader %d", misses, idx)
+                    misses = 0
+                    continue
+                except Exception:
+                    pass
                 try:
                     idx = store.failover()
                     log.warning("tier primary unreachable %d probes; "

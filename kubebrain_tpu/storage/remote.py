@@ -42,8 +42,13 @@ from .errors import (
 OP_GET, OP_TSO, OP_BATCH, OP_SCAN, OP_PARTITIONS = 1, 2, 3, 4, 5
 OP_MVCC_WRITE, OP_MVCC_DELETE, OP_CHECKPOINT, OP_INFO = 6, 7, 8, 9
 OP_EXPORT = 10
-OP_REPL_HELLO, OP_REPL_ACK, OP_PROMOTE, OP_ROLE = 11, 12, 13, 14
+OP_REPL_HELLO, OP_REPL_ACK, OP_PROMOTE, OP_ROLE, OP_VOTE = 11, 12, 13, 14, 15
 ST_OK, ST_NOT_FOUND, ST_CONFLICT, ST_WAL, ST_DRIFT, ST_ERROR = 0, 1, 2, 3, 4, 5
+# quorum-mode tier: the write was applied on the (now deposed or
+# quorum-less) leader but never reached a majority — outcome unknown
+ST_UNCERTAIN = 6
+# definite pre-apply refusals that are safe to retry on the real leader
+_REDIRECTABLE = (b"read-only follower", b"no quorum")
 
 _REQ = struct.Struct("<IQB")
 SCAN_PAGE_CAP = 2048
@@ -157,12 +162,10 @@ class RemoteBatchWrite(BatchWrite):
         # poison _max_seen above anything the new lineage produces and make
         # later failovers refuse healthy primaries
         epoch_at_send = self._store._epoch_snapshot()
-        try:
-            status, payload = self._store._write_call(OP_BATCH, bytes(body))
-        except (OSError, EOFError) as exc:
-            # the request may have been applied before the transport died —
-            # the outcome is unknowable (reference batch.go:125-146)
-            raise UncertainResultError(f"batch commit outcome unknown: {exc}") from exc
+        # transport death / quorum loss -> UncertainResultError inside
+        # (reference batch.go:125-146); leader moved -> transparent retry
+        status, payload = self._store._write_frame(
+            OP_BATCH, bytes(body), "batch commit")
         if status == ST_OK:
             if len(payload) >= 8:  # commit clock: feeds lineage adoption
                 ts = struct.unpack_from("<Q", payload)[0]
@@ -303,8 +306,16 @@ class RemoteKvStorage(KvStorage):
         # tagged (0, ts) and the very first failover() could adopt a
         # restarted stale primary whose persisted epoch >= 1 (r3 advisor,
         # medium). Best-effort: pre-epoch daemons simply report epoch 0.
+        # On a quorum tier the configured first address may well be a
+        # follower (leadership lands wherever the election put it) — chase
+        # the leader once; write paths re-resolve on demand after that.
         try:
-            self.member_info()
+            is_f, *_ = self.member_info()
+            if is_f and len(self._addresses) > 1:
+                try:
+                    self.find_leader()
+                except StorageError:
+                    pass  # tier still electing; resolved at first write
         except (OSError, EOFError, StorageError):
             pass
 
@@ -356,8 +367,27 @@ class RemoteKvStorage(KvStorage):
             # reads are idempotent: heal the slot and retry once. Writes
             # (BATCH / MVCC_*) never come through here — their callers
             # classify transport death as UncertainResultError instead.
-            new = self._heal(slot, conn)
-            return new.call(op, body)
+            try:
+                new = self._heal(slot, conn)
+                return new.call(op, body)
+            except (OSError, EOFError):
+                # the member itself is gone — leadership may have moved
+                # (quorum election / external failover); chase it once
+                if not self._maybe_repoint():
+                    raise
+                _, conn2 = self._conn()
+                return conn2.call(op, body)
+
+    def _maybe_repoint(self) -> bool:
+        """Best-effort leader chase after a dead-member transport failure;
+        True when the pool now points at a different member."""
+        if len(self._addresses) < 2:
+            return False
+        old = self._primary
+        try:
+            return self.find_leader(probe_timeout=0.5) != old
+        except (OSError, EOFError, StorageError):
+            return False
 
     def _candidate_is_follower(self, idx: int) -> bool:
         """Role-gate a read candidate (cached, ~5s TTL; unreachable nodes
@@ -451,8 +481,46 @@ class RemoteKvStorage(KvStorage):
             try:
                 self._heal(slot, conn)
             except OSError:
-                pass  # server still down; next call retries the heal
+                # server still down; chase a moved leadership so the
+                # CALLER'S retry (after its UncertainResultError repair)
+                # lands on the new leader instead of this corpse
+                self._maybe_repoint()
             raise
+
+    def _write_frame(self, op: int, body: bytes, what: str) -> tuple[int, bytes]:
+        """One write round trip with the tier's failure classification:
+
+        - transport death  -> UncertainResultError (maybe applied);
+        - ST_UNCERTAIN     -> UncertainResultError (quorum tier: applied on
+          a leader that lost quorum/stepped down before majority ack);
+        - definite pre-apply refusals ("read-only follower", "no quorum")
+          -> find the real leader and retry ONCE — nothing was applied, so
+          the retry cannot double-apply."""
+        deadline = None
+        while True:
+            try:
+                status, payload = self._write_call(op, body)
+            except (OSError, EOFError) as exc:
+                raise UncertainResultError(
+                    f"{what} outcome unknown: {exc}") from exc
+            if status != ST_ERROR or not any(m in payload
+                                             for m in _REDIRECTABLE):
+                break
+            # wait out an in-flight election / follower attachment window
+            # (bounded): leadership is usually seconds away, and nothing
+            # was applied, so re-issuing cannot double-apply
+            if deadline is None:
+                deadline = time.monotonic() + 5.0
+            elif time.monotonic() >= deadline:
+                raise StorageError(f"{what} refused: {payload!r}")
+            try:
+                self.find_leader()
+            except StorageError:
+                pass  # nobody claims leadership yet; retry until deadline
+            time.sleep(0.25)
+        if status == ST_UNCERTAIN:
+            raise UncertainResultError(f"{what}: {payload!r}")
+        return status, payload
 
     # ------------------------------------------------------------- contract
     def get_timestamp_oracle(self) -> int:
@@ -604,6 +672,42 @@ class RemoteKvStorage(KvStorage):
             return idx
         raise StorageError(f"no promotable follower reachable: {last_exc}")
 
+    def find_leader(self, probe_timeout: float = 1.0) -> int:
+        """Quorum-tier leader discovery: probe every member's ROLE, pick the
+        reachable non-follower with the highest (epoch, ts) lineage, and
+        repoint the pool at it. Unlike failover() this never PROMOTEs —
+        quorum tiers elect internally (kbstored --peers); the client only
+        has to find where leadership landed. The stale-lineage watermark
+        guard still applies: a leader below everything this client has
+        observed is a split-brain artifact, not a target."""
+        best = None  # (epoch, ts, idx, addr)
+        for idx, addr in enumerate(self._addresses):
+            try:
+                is_f, ts, _, _, epoch = self.member_info(
+                    idx, timeout=probe_timeout)
+            except (OSError, EOFError, StorageError):
+                continue
+            if is_f:
+                continue
+            if best is None or (epoch, ts) > (best[0], best[1]):
+                best = (epoch, ts, idx, addr)
+        if best is None:
+            raise StorageError("no leader reachable in the tier")
+        epoch, ts, idx, addr = best
+        with self._rr_lock:
+            if (epoch, ts) < self._max_seen:
+                stale = self._max_seen
+            else:
+                stale = None
+                self._cur_epoch = epoch
+        if stale is not None:
+            raise StorageError(
+                f"best reachable leader {addr} has lineage ({epoch}, {ts}) "
+                f"< observed {stale}; refusing to adopt")
+        if idx != self._primary:
+            self._repoint(idx, addr)
+        return idx
+
     def _repoint(self, idx: int, addr: tuple[str, int]) -> None:
         """Swing the pool to a new primary; old conns surface as
         UncertainResultError to in-flight callers and repair as usual."""
@@ -704,10 +808,8 @@ class RemoteKvStorage(KvStorage):
         for f in (rev_key, rev_val, expected or b"", obj_key, obj_val,
                   last_key, last_val):
             _bytes_field(body, f)
-        try:
-            status, payload = self._write_call(OP_MVCC_WRITE, bytes(body))
-        except (OSError, EOFError) as exc:
-            raise UncertainResultError(f"mvcc write outcome unknown: {exc}") from exc
+        status, payload = self._write_frame(OP_MVCC_WRITE, bytes(body),
+                                            "mvcc write")
         if status == ST_OK:
             return
         if status == ST_CONFLICT:
@@ -722,10 +824,8 @@ class RemoteKvStorage(KvStorage):
         body = bytearray(struct.pack("<QQ", expected_rev, new_rev))
         for f in (rev_key, new_record, tombstone, last_key, last_val):
             _bytes_field(body, f)
-        try:
-            status, payload = self._write_call(OP_MVCC_DELETE, bytes(body))
-        except (OSError, EOFError) as exc:
-            raise UncertainResultError(f"mvcc delete outcome unknown: {exc}") from exc
+        status, payload = self._write_frame(OP_MVCC_DELETE, bytes(body),
+                                            "mvcc delete")
         if status == ST_NOT_FOUND:
             latest = struct.unpack("<Q", payload)[0] if len(payload) >= 8 else 0
             return "not_found", None, latest

@@ -1,0 +1,387 @@
+"""Quorum (raft-lite) storage tier: kbstored --peers (VERDICT r3 next #1).
+
+The reference's TiKV is a raft-quorum store (pkg/storage/tikv/tikv.go:38-153):
+writes commit on majority ack and leadership moves by election. Round 3's
+tier was semi-sync with operator promotion and two documented holes — the
+all-follower-detach standalone degradation (acked writes that die with the
+primary's disk) and forced-promotion split-brain. Quorum mode closes both:
+
+- every member lists the same peer set; all boot followers; pre-vote +
+  term/log-match elections pick the leader (term = lineage epoch);
+- client ACKs release only once floor(n/2) followers durably applied the
+  record — a leader below quorum REFUSES writes outright;
+- writes applied on a leader that loses quorum/steps down before majority
+  ack come back ST_UNCERTAIN -> UncertainResultError (honestly unknown);
+- PROMOTE is refused: operators cannot fork a quorum tier.
+
+These tests are the verdict's done-criteria: kill -9 auto-election inside a
+bounded window with zero acked loss (strict-lincheck-verified under a
+nemesis), quorum refusal on a partitioned ex-leader, divergent rejoin.
+"""
+
+import math
+import os
+import signal
+import socket
+import subprocess
+import tempfile
+import threading
+import time
+
+import pytest
+
+from kubebrain_tpu.lincheck import History
+from kubebrain_tpu.storage import new_storage
+from kubebrain_tpu.storage.errors import (
+    KeyNotFoundError,
+    StorageError,
+    UncertainResultError,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+STORED_BIN = os.path.join(REPO, "native", "kvrpc", "kbstored")
+
+pytestmark = pytest.mark.skipif(
+    not os.path.exists(STORED_BIN), reason="kbstored not built (make -C native)"
+)
+
+
+def free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+def put(s, key, value):
+    b = s.begin_batch_write()
+    b.put(key, value)
+    b.commit()
+
+
+class Cluster:
+    """A 3-member kbstored --peers cluster with restartable members."""
+
+    def __init__(self, tmp, n=3, election_ms=500):
+        self.tmp = tmp
+        self.ports = [free_port() for _ in range(n)]
+        self.peers = ",".join(f"127.0.0.1:{p}" for p in self.ports)
+        self.env = dict(os.environ, KB_ELECTION_TIMEOUT_MS=str(election_ms))
+        self.procs: dict[int, subprocess.Popen] = {}
+        for i in range(n):
+            self.start(i)
+
+    def start(self, i):
+        path = os.path.join(self.tmp, f"n{i}")
+        os.makedirs(path, exist_ok=True)
+        proc = subprocess.Popen(
+            [STORED_BIN, str(self.ports[i]), path,
+             "--peers", self.peers, "--self", str(i)],
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, env=self.env)
+        assert b"READY" in proc.stdout.readline()
+        self.procs[i] = proc
+
+    def kill(self, i):
+        self.procs[i].kill()
+        self.procs[i].wait()
+        del self.procs[i]
+
+    def close(self):
+        for p in self.procs.values():
+            try:
+                p.kill()
+                p.wait()
+            except Exception:
+                pass
+
+    def storage(self, **kw):
+        kw.setdefault("pool", 2)
+        kw.setdefault("timeout", 8.0)
+        return new_storage("remote", address=self.peers, **kw)
+
+    def wait_leader(self, s, timeout=15.0, min_replicas=None):
+        """(leader_idx, epoch) once exactly one member leads (and, when
+        asked, has at least min_replicas attached)."""
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            leaders = []
+            for i in range(len(self.ports)):
+                if i not in self.procs:
+                    continue
+                try:
+                    is_f, ts, nrep, _, ep = s.member_info(i, timeout=1.0)
+                except Exception:
+                    continue
+                if not is_f:
+                    leaders.append((i, ep, nrep))
+            if len(leaders) == 1:
+                i, ep, nrep = leaders[0]
+                if min_replicas is None or nrep >= min_replicas:
+                    return i, ep
+            time.sleep(0.1)
+        raise AssertionError("no single stable leader emerged")
+
+
+def test_quorum_boots_and_elects_single_leader(tmp_path):
+    c = Cluster(str(tmp_path))
+    s = c.storage()
+    try:
+        leader, epoch = c.wait_leader(s, min_replicas=2)
+        assert epoch >= 1
+        put(s, b"/q/a", b"1")  # write path up end to end
+        assert s.get(b"/q/a") == b"1"
+        # PROMOTE is an operator fork attempt: refused in quorum mode
+        with pytest.raises(StorageError, match="election"):
+            s.promote((leader + 1) % 3, force=True)
+    finally:
+        s.close()
+        c.close()
+
+
+def test_quorum_refuses_writes_below_majority(tmp_path):
+    """Kill both followers: the leader must REFUSE writes (definite, before
+    apply) — never the legacy standalone acking whose acks die with the
+    leader's disk (kbstored.cc:512-514 in round 3)."""
+    c = Cluster(str(tmp_path))
+    s = c.storage()
+    try:
+        leader, _ = c.wait_leader(s, min_replicas=2)
+        put(s, b"/q/pre", b"1")
+        for i in range(3):
+            if i != leader:
+                c.kill(i)
+        time.sleep(0.5)  # let the leader notice the detachments
+        with pytest.raises(StorageError, match="no quorum|refused"):
+            put(s, b"/q/lost", b"2")
+        # reads still served (stale-tolerant by design; snapshot reads are
+        # what correctness rests on)
+        assert s.get(b"/q/pre") == b"1"
+        # restart one follower: quorum restored, writes flow again
+        for i in range(3):
+            if i != leader:
+                c.start(i)
+                break
+        deadline = time.time() + 15
+        while True:
+            try:
+                put(s, b"/q/back", b"3")
+                break
+            except (StorageError, UncertainResultError):
+                if time.time() > deadline:
+                    raise
+                time.sleep(0.2)
+        assert s.get(b"/q/back") == b"3"
+    finally:
+        s.close()
+        c.close()
+
+
+def test_quorum_uncertain_not_silent_on_stalled_followers(tmp_path):
+    """SIGSTOP both followers: an in-flight write (applied on the leader,
+    never majority-acked) must surface as UncertainResultError within the
+    quorum ack timeout — neither a success lie nor a definite-failure lie."""
+    c = Cluster(str(tmp_path))
+    s = c.storage()
+    try:
+        leader, _ = c.wait_leader(s, min_replicas=2)
+        put(s, b"/q/pre", b"1")
+        # stall (not kill) the followers: conns stay open, acks never come;
+        # the quorum ack timeout (default 2s) must expire the held write
+        for i in range(3):
+            if i != leader:
+                os.kill(c.procs[i].pid, signal.SIGSTOP)
+        try:
+            t0 = time.monotonic()
+            with pytest.raises(UncertainResultError):
+                put(s, b"/q/inflight", b"2")
+            assert time.monotonic() - t0 < 10.0
+        finally:
+            for i in range(3):
+                if i != leader and i in c.procs:
+                    os.kill(c.procs[i].pid, signal.SIGCONT)
+    finally:
+        s.close()
+        c.close()
+
+
+def test_quorum_kill9_leader_auto_elects_no_acked_loss(tmp_path):
+    """The verdict's done-criterion (a): kill -9 the leader under live
+    write load; the tier must elect a new leader inside a bounded window
+    with ZERO acked writes lost, and the whole concurrent history must be
+    strictly linearizable (no truncated lincheck searches)."""
+    c = Cluster(str(tmp_path))
+    s = c.storage()
+    history = History()
+    acked: dict[bytes, int] = {}
+    lock = threading.Lock()
+    stop = threading.Event()
+    # Ack-order revision counter: assigned under the lock AT RETURN TIME,
+    # so it respects real time across keys exactly as the checker's global
+    # revision pass demands (A returned before B called => rev(A) < rev(B)).
+    rev_counter = [0]
+
+    def writer(w):
+        i = 0
+        while not stop.is_set():
+            key = b"/soak/w%02d-%05d" % (w, i)
+            t0 = time.monotonic()
+            try:
+                put(s, key, b"v")
+                with lock:
+                    rev_counter[0] += 1
+                    acked[key] = rev_counter[0]
+                    history.record(w, "create", key, t0, time.monotonic(),
+                                   value=b"v", ok=True, rev=rev_counter[0])
+                i += 1
+            except UncertainResultError:
+                with lock:
+                    history.record(w, "create", key, t0, math.inf,
+                                   value=b"v", ok=None)
+                i += 1
+            except (StorageError, OSError):
+                time.sleep(0.05)
+
+    try:
+        leader0, epoch0 = c.wait_leader(s, min_replicas=2)
+        writers = [threading.Thread(target=writer, args=(w,)) for w in range(4)]
+        for t in writers:
+            t.start()
+        time.sleep(1.0)
+        t_kill = time.monotonic()
+        c.kill(leader0)
+        leader1, epoch1 = c.wait_leader(s, timeout=20.0)
+        elect_window = time.monotonic() - t_kill
+        assert leader1 != leader0 and epoch1 > epoch0
+        assert elect_window < 15.0, f"election took {elect_window:.1f}s"
+        time.sleep(1.5)  # post-failover progress
+        stop.set()
+        for t in writers:
+            t.join(timeout=30)
+        assert not any(t.is_alive() for t in writers)
+        assert len(acked) > 30, f"writers made little progress: {len(acked)}"
+
+        # zero acked loss, read back from the NEW leader
+        missing = [k for k in acked if _get(s, k) is None]
+        assert not missing, f"lost {len(missing)} acked writes: {missing[:5]}"
+
+        # strict linearizability over the concurrent run: fold the final
+        # state in as completed reads (unknown-outcome keys resolve either
+        # way; acked keys must be present)
+        t_end = time.monotonic()
+        for op in list(history.ops):
+            v = _get(s, op.key)
+            if v is not None:
+                # acked keys read back at their recorded revision; a landed
+                # unknown-outcome key reveals its (uncaptured) revision as 0
+                history.record(99, "get", op.key, t_end, t_end + 1e-3,
+                               value=v, ok=True, rev=acked.get(op.key, 0))
+            else:
+                history.record(99, "get", op.key, t_end, t_end + 1e-3,
+                               ok=False)
+            t_end += 2e-3
+        res = history.check()
+        assert res["ok"], f"tier history not linearizable: {res['violation']}"
+        assert not res.get("truncated") and res["truncated_keys"] == []
+        print(f"[raft-soak] elect={elect_window:.2f}s acked={len(acked)} "
+              f"ops={res['ops']} nodes={res['nodes_searched']}")
+    finally:
+        stop.set()
+        s.close()
+        c.close()
+
+
+def _get(s, key):
+    try:
+        return s.get(key)
+    except (KeyNotFoundError, StorageError, OSError):
+        return None
+
+
+@pytest.mark.slow
+def test_partitioned_exleader_cannot_ack_and_rejoins(tmp_path):
+    """Done-criteria (b) + divergent rejoin: freeze the leader (partition
+    stand-in), let the rest elect; the thawed ex-leader must (1) hold
+    divergent never-acked records only until it rejoins, (2) refuse writes
+    for lack of quorum, (3) step down to follower of the new term, with the
+    divergent suffix wiped by the rejoin dump."""
+    c = Cluster(str(tmp_path))
+    s = c.storage()
+    try:
+        leader0, epoch0 = c.wait_leader(s, min_replicas=2)
+        put(s, b"/p/committed", b"1")
+        os.kill(c.procs[leader0].pid, signal.SIGSTOP)
+        # majority side elects a new term
+        s2 = c.storage()
+        try:
+            leader1, epoch1 = c.wait_leader(s2, timeout=20.0)
+            assert leader1 != leader0 and epoch1 > epoch0
+            put(s2, b"/p/after", b"2")  # quorum side keeps committing
+            # thaw the ex-leader: its replicas are gone; writes to it must
+            # be REFUSED (no quorum), not silently acked
+            os.kill(c.procs[leader0].pid, signal.SIGCONT)
+            direct = new_storage(
+                "remote", address=f"127.0.0.1:{c.ports[leader0]}",
+                pool=1, timeout=5.0)
+            try:
+                with pytest.raises((StorageError, UncertainResultError)):
+                    put(direct, b"/p/fork", b"X")
+            finally:
+                direct.close()
+            # ...and within a few probe ticks it steps down and follows
+            deadline = time.time() + 20
+            while time.time() < deadline:
+                try:
+                    is_f, _, _, _, ep = s2.member_info(leader0, timeout=1.0)
+                    if is_f and ep == epoch1:
+                        break
+                except Exception:
+                    pass
+                time.sleep(0.2)
+            else:
+                raise AssertionError("ex-leader never stepped down")
+            # the quorum side's data is intact and visible everywhere
+            assert s2.get(b"/p/committed") == b"1"
+            assert s2.get(b"/p/after") == b"2"
+            # the fork attempt never became durable state on the tier
+            with pytest.raises(KeyNotFoundError):
+                s2.get(b"/p/fork")
+        finally:
+            s2.close()
+    finally:
+        s.close()
+        c.close()
+
+
+def test_quorum_leader_restart_rejoins_as_follower(tmp_path):
+    """kill -9 the leader, let a new term start, restart the old binary
+    with its old data dir: it must come back as a FOLLOWER of the new term
+    (persisted term + discovery), with all quorum-committed data served."""
+    c = Cluster(str(tmp_path))
+    s = c.storage()
+    try:
+        leader0, epoch0 = c.wait_leader(s, min_replicas=2)
+        for i in range(30):
+            put(s, b"/r/k%02d" % i, b"v%02d" % i)
+        c.kill(leader0)
+        leader1, epoch1 = c.wait_leader(s, timeout=20.0)
+        assert epoch1 > epoch0
+        c.start(leader0)
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            try:
+                is_f, _, _, _, ep = s.member_info(leader0, timeout=1.0)
+                if is_f and ep >= epoch1:
+                    break
+            except Exception:
+                pass
+            time.sleep(0.2)
+        else:
+            raise AssertionError("restarted ex-leader never rejoined")
+        for i in range(30):
+            assert s.get(b"/r/k%02d" % i) == b"v%02d" % i
+        put(s, b"/r/post", b"1")
+        assert s.get(b"/r/post") == b"1"
+    finally:
+        s.close()
+        c.close()
