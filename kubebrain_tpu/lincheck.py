@@ -148,40 +148,60 @@ class BudgetExhausted(Exception):
     result unless the caller explicitly opts into permissive mode."""
 
 
-def _check_key(ops: list[Op], node_budget: int = 2_000_000):
-    """Wing-Gong search with memoization over (remaining-set, state).
+def _search_segment(ops: list[Op], seeds, node_budget: int, nodes: int,
+                    collect_finals: bool, total_ops: int):
+    """Wing-Gong search over one segment with memoization on
+    (remaining-set, state), seeded with every state the previous segment
+    could have ended in.
 
     An op may be linearized first among the remaining ops iff no other
     remaining op returned before it was called. Unknown-outcome ops may also
     be dropped entirely (they never took effect).
 
-    Returns (ok, why, nodes_searched). Raises BudgetExhausted when the
-    node budget runs out before a verdict."""
-    ops = sorted(ops, key=lambda o: (o.call, o.ret))
+    When collect_finals is set, enumerates ALL reachable end states (needed
+    to seed the next segment); otherwise exits on the first complete
+    linearization. Returns (ok, finals, nodes). Raises BudgetExhausted when
+    the shared node budget runs out before a verdict."""
     n = len(ops)
-    if n == 0:
-        return True, None, 0
     calls = [o.call for o in ops]
     rets = [o.ret for o in ops]
+    # Symmetry reduction: two ops with the same observable signature are
+    # interchangeable — their _apply effect is identical, so among the ones
+    # currently available it suffices to expand ONLY the smallest-ret one
+    # (both branches). Soundness: availability (call < min_ret(remaining))
+    # is monotone as ops are removed, so any schedule that takes an
+    # identical sibling now can be rewritten to take the minimal-ret op now
+    # and the sibling at the later slot, and keeping the larger-ret sibling
+    # only raises min_ret for everyone else. This collapses the 2^k subsets
+    # of k identical unknown-outcome writes (e.g. a failover window full of
+    # uncertain creates carrying the same per-client value) to k+1 prefixes.
+    sigs = [
+        (o.kind, o.value, o.prev_rev, o.ok, o.rev, o.err, o.conflict_rev)
+        for o in ops
+    ]
     full = (1 << n) - 1
     seen: set = set()
-    nodes = 0
-
-    # DFS over (mask of remaining ops, state)
-    stack = [(full, _INIT)]
+    finals: set = set()
+    stack = [(full, s) for s in seeds]
     while stack:
         mask, state = stack.pop()
         if mask == 0:
-            return True, None, nodes
+            if not collect_finals:
+                return True, finals, nodes
+            finals.add(state)
+            continue
         key = (mask, state)
         if key in seen:
             continue
         seen.add(key)
         nodes += 1
         if nodes > node_budget:
+            n_unknown = sum(1 for o in ops if o.ok is None)
             raise BudgetExhausted(
                 f"key {ops[0].key!r}: search budget ({node_budget} nodes) "
-                f"exhausted over {n} ops — no verdict"
+                f"exhausted over {total_ops} ops — no verdict "
+                f"(segment: {n} ops, {n_unknown} unknown-outcome, "
+                f"{len(seeds)} seed states)"
             )
         min_ret = math.inf
         m = mask
@@ -190,23 +210,71 @@ def _check_key(ops: list[Op], node_budget: int = 2_000_000):
             m &= m - 1
             if rets[i] < min_ret:
                 min_ret = rets[i]
+        chosen: dict = {}  # signature -> available index with minimal ret
         m = mask
         while m:
             i = (m & -m).bit_length() - 1
             m &= m - 1
             if calls[i] >= min_ret:
                 continue
+            j = chosen.get(sigs[i])
+            if j is None or rets[i] < rets[j]:
+                chosen[sigs[i]] = i
+        for i in chosen.values():
             op = ops[i]
             for nxt in _apply(op, state):
                 stack.append((mask & ~(1 << i), nxt))
             if op.ok is None:
                 # the unacknowledged op may simply never have happened
                 stack.append((mask & ~(1 << i), state))
-    first = ops[0]
-    return False, (
-        f"key {first.key!r}: no legal linearization of {n} ops "
-        f"(first op {first.kind} @ {first.call:.6f})"
-    ), nodes
+    return bool(finals), finals, nodes
+
+
+def _check_key(ops: list[Op], node_budget: int = 2_000_000):
+    """Per-key search, decomposed at quiescent cuts.
+
+    A cut is a point in real time that no op interval spans: every earlier
+    op returned strictly before every later op was called. Real-time order
+    then forces ALL pre-cut ops before ALL post-cut ops in any
+    linearization, so the history factors into segments that compose
+    through their reachable end states — turning one exponential search
+    over hundreds of ops into many small ones. Open-window ops
+    (ret = inf, i.e. unknown outcomes) span every later cut and keep their
+    segment intact, preserving Jepsen semantics.
+
+    Returns (ok, why, nodes_searched). Raises BudgetExhausted when the
+    node budget runs out before a verdict."""
+    ops = sorted(ops, key=lambda o: (o.call, o.ret))
+    n = len(ops)
+    if n == 0:
+        return True, None, 0
+    segments: list[list[Op]] = []
+    seg_start = 0
+    max_ret = -math.inf
+    for i, o in enumerate(ops):
+        if i > seg_start and o.call > max_ret:
+            segments.append(ops[seg_start:i])
+            seg_start = i
+        if o.ret > max_ret:
+            max_ret = o.ret
+    segments.append(ops[seg_start:])
+
+    seeds: set = {_INIT}
+    nodes = 0
+    for si, seg in enumerate(segments):
+        last = si == len(segments) - 1
+        ok, finals, nodes = _search_segment(
+            seg, seeds, node_budget, nodes,
+            collect_finals=not last, total_ops=n)
+        if not ok:
+            first = seg[0]
+            return False, (
+                f"key {first.key!r}: no legal linearization of {n} ops "
+                f"(segment of {len(seg)} starting {first.kind} "
+                f"@ {first.call:.6f})"
+            ), nodes
+        seeds = finals
+    return True, None, nodes
 
 
 class History:
