@@ -1,9 +1,38 @@
 # Build / test / bench entry points (reference analogue: makefile +
 # build/build-*.sh; engine choice is a runtime flag here, not a build tag).
 
-.PHONY: all native test bench bench-all run clean protos
+SHELL := /bin/bash  # test-tier1 needs pipefail
+
+.PHONY: all native test bench bench-all run clean protos lint typecheck \
+        check test-tier1
 
 all: native
+
+# Static analysis: the kblint project-invariant rules (tools/kblint, see
+# docs/static_analysis.md) over all Python, plus the native lint pass.
+lint:
+	python -m tools.kblint kubebrain_tpu tools tests
+	$(MAKE) -C native lint
+
+# mypy over the typed core when installed; compileall fallback otherwise
+# (this container must not pip install anything).
+typecheck:
+	python tools/typecheck.py
+
+# The ROADMAP.md tier-1 verify command, the ONE definition CI and
+# tools/ci.sh both invoke (the flags and timeout must not drift apart).
+test-tier1:
+	set -o pipefail; rm -f /tmp/_t1.log; \
+	timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q \
+	    -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
+	    -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; \
+	rc=$$?; \
+	echo "DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log | tr -cd . | wc -c)"; \
+	exit $$rc
+
+# Everything CI runs: lint + typecheck + the tier-1 suite (tools/ci.sh).
+check:
+	tools/ci.sh
 
 native:
 	$(MAKE) -C native

@@ -207,8 +207,9 @@ class AioWatchService:
             """List-over-watch (negative start revision, watch.py protocol)."""
             from ..backend.errors import CompactedError, FutureRevisionError
             from ..proto import kv_pb2
+            from ..server.service.revision import decode_list_revision
 
-            revision = -int(creq.start_revision)
+            revision = decode_list_revision(creq.start_revision)
             try:
                 rev, stream = await loop.run_in_executor(
                     None, self.backend.list_by_stream,
@@ -264,7 +265,9 @@ class AioWatchService:
                         creq = req.create_request
                         next_id[0] += 1
                         watch_id = creq.watch_id if creq.watch_id > 0 else next_id[0]
-                        if creq.start_revision < 0:
+                        from ..server.service.revision import is_list_over_watch
+
+                        if is_list_over_watch(creq.start_revision):
                             task = asyncio.create_task(range_stream(creq, watch_id))
                             stream_tasks.add(task)
                             task.add_done_callback(stream_tasks.discard)

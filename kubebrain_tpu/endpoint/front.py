@@ -182,7 +182,7 @@ class FrontServer:
             os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)))),
             "native", "front", "kbfront",
         )
-        self._proc = subprocess.Popen(
+        self._proc = subprocess.Popen(  # kblint: disable=KB101 -- one-shot startup fork/exec before any stream is served; the loop is not shared yet
             [binary, str(self.tcp_port), self.socket_path, self.host,
              *getattr(self, "_tls_args", [])],
             stdout=subprocess.PIPE,

@@ -124,7 +124,9 @@ class _WatchSession:
         with self._lock:
             self._next_id += 1
             watch_id = creq.watch_id if creq.watch_id > 0 else self._next_id
-        if creq.start_revision < 0:
+        from ..service.revision import is_list_over_watch
+
+        if is_list_over_watch(creq.start_revision):
             # negative revision: list-over-watch range stream (watch.go:150)
             t = threading.Thread(
                 target=self._range_stream, args=(creq, watch_id), daemon=True
@@ -215,8 +217,9 @@ class _WatchSession:
         watch.go:204-273): PUT event batches at the snapshot revision, then a
         clean cancel."""
         from ...backend.errors import CompactedError, FutureRevisionError
+        from ..service.revision import decode_list_revision
 
-        revision = -int(creq.start_revision)
+        revision = decode_list_revision(creq.start_revision)
         try:
             rev, stream = self.backend.list_by_stream(
                 bytes(creq.key), bytes(creq.range_end), revision

@@ -18,6 +18,24 @@ from typing import Callable
 SYNC_TIMEOUT_SECONDS = 1.0
 
 
+# ---------------------------------------------------------------------------
+# Revision-value helpers. Revisions are opaque monotonic tokens minted by
+# the sequencer; every transformation the serving surface needs lives here
+# so the etcd shim never invents revisions by raw arithmetic (kblint KB105
+# enforces this over server/etcd/).
+
+def is_list_over_watch(start_revision: int) -> bool:
+    """Whether a WatchCreateRequest start_revision selects the
+    list-over-watch protocol (negative = 'stream me a list')."""
+    return int(start_revision) < 0
+
+
+def decode_list_revision(start_revision: int) -> int:
+    """The list revision a negative list-over-watch start_revision encodes
+    (the protocol ships ``-rev``; 0 means 'latest')."""
+    return -int(start_revision)
+
+
 class RevisionSyncError(Exception):
     pass
 

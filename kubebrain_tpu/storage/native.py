@@ -39,6 +39,9 @@ def _load_lib() -> ctypes.CDLL:
             return _lib
         path = os.path.abspath(_LIB_PATH)
         if not os.path.exists(path):
+            # first-use auto-build must be single-flight; every caller
+            # needs the lib before it can proceed anyway
+            # kblint: disable=KB102 -- deliberate build-under-lock
             subprocess.run(
                 ["make", "-C", os.path.dirname(path)], check=True, capture_output=True
             )

@@ -1,0 +1,326 @@
+"""Opt-in runtime lock-order race detector (``KB_LOCKCHECK=1``).
+
+The static linter (tools/kblint) proves what it can see lexically; this
+shim watches what actually happens. When installed it wraps every
+``threading.Lock``/``RLock`` *constructed by kubebrain code* so that each
+acquisition records, per thread, the stack of locks already held. From
+those observations it maintains a global lock-order graph (edge A -> B =
+"B was acquired while A was held") and reports:
+
+- **cycles** in the graph (an ABBA inversion: two threads that interleave
+  at the wrong moment deadlock), and
+- **blocking calls while a lock is held** (``time.sleep`` today; the
+  convoy/wedge shape behind intermittent watch stalls).
+
+Violations are recorded, not raised at the acquisition site — raising
+inside arbitrary third-party frames turns a diagnosis into a different
+crash. The pytest conftest drains :func:`take_violations` after each test
+and fails the test that produced them.
+
+Usage::
+
+    from kubebrain_tpu.util import lockcheck
+    lockcheck.install()          # or KB_LOCKCHECK=1 with tests/conftest.py
+    ...
+    for v in lockcheck.take_violations():
+        print(v.render())
+    lockcheck.uninstall()
+
+The shim only wraps locks whose constructing frame lives under this
+project (kubebrain_tpu/, tools/, tests/) — wrapping every lock in grpc or
+JAX internals would tax the hot path and drown the signal.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+import time
+import traceback
+
+__all__ = [
+    "install",
+    "uninstall",
+    "installed",
+    "reset",
+    "take_violations",
+    "violations",
+    "Violation",
+    "LockOrderError",
+]
+
+
+class LockOrderError(AssertionError):
+    """Raised by the test harness when a lock-discipline violation was
+    observed during the test that just ran."""
+
+
+@dataclasses.dataclass(frozen=True)
+class Violation:
+    kind: str          # "lock-order-cycle" | "blocking-call-under-lock"
+    detail: str        # human-readable one-liner
+    stack: str         # formatted stack at the observation point
+
+    def render(self) -> str:
+        return f"[lockcheck] {self.kind}: {self.detail}\n{self.stack}"
+
+
+# --------------------------------------------------------------------- state
+
+_orig_lock = threading.Lock
+_orig_rlock = threading.RLock
+_orig_sleep = time.sleep
+
+# _state_lock guards the graph + violation list. It is an ORIGINAL lock and
+# every wrapper re-enters the detector through a reentrancy latch, so the
+# detector never traces itself.
+_state_lock = _orig_lock()
+_edges: dict[tuple[str, str], str] = {}   # (site_a, site_b) -> stack that added it
+_violations: list[Violation] = []
+_seen_cycles: set[tuple[str, ...]] = set()
+_tls = threading.local()
+_installed = False
+
+_PROJECT_MARKERS = (
+    os.sep + "kubebrain_tpu" + os.sep,
+    os.sep + "tools" + os.sep,
+    os.sep + "tests" + os.sep,
+)
+
+
+def _creation_site() -> str | None:
+    """file:line of the first project frame below this module, or None for
+    locks constructed by third-party/stdlib code (left unwrapped)."""
+    for frame in reversed(traceback.extract_stack(limit=12)[:-2]):
+        fn = frame.filename
+        if fn == __file__ or os.path.basename(fn) == "lockcheck.py":
+            continue
+        if any(m in fn for m in _PROJECT_MARKERS):
+            return f"{os.path.basename(os.path.dirname(fn))}/{os.path.basename(fn)}:{frame.lineno}"
+        # threading.py frames (e.g. Condition allocating its lock) keep
+        # scanning outward to the project caller
+        if os.sep + "threading.py" in fn or os.sep + "queue.py" in fn:
+            continue
+        return None
+    return None
+
+
+# every thread's held-list, so reset() can clear stacks it does not own
+# (a leftover daemon thread from an earlier test must not leak edges or
+# sleep-under-lock blame into the next test's freshly-reset state)
+_held_lists: dict[int, list] = {}
+
+
+def _held() -> list[tuple[str, int]]:
+    held = getattr(_tls, "held", None)
+    if held is None:
+        held = _tls.held = []
+        with _state_lock:
+            _held_lists[threading.get_ident()] = held
+    return held
+
+
+def _record_violation(kind: str, detail: str) -> None:
+    stack = "".join(traceback.format_stack(limit=14)[:-2])
+    with _state_lock:
+        _violations.append(Violation(kind, detail, stack))
+
+
+def _find_cycle(start: str, target: str) -> list[str] | None:
+    """Path target ->* start in the edge graph (so start -> target closes a
+    cycle), or None."""
+    path = [target]
+    seen = {target}
+
+    def dfs(node: str) -> bool:
+        for (a, b) in _edges:
+            if a != node or b in seen:
+                continue
+            path.append(b)
+            if b == start:
+                return True
+            seen.add(b)
+            if dfs(b):
+                return True
+            path.pop()
+        return False
+
+    return path if dfs(target) else None
+
+
+def _note_acquired(site: str, obj_id: int) -> None:
+    held = _held()
+    new_edges: list[tuple[str, str]] = []
+    with _state_lock:
+        for held_site, held_id in held:
+            if held_site == site:
+                # same-site nesting (two instances of one class, or RLock
+                # reentry) — a self-edge would flag every such pattern;
+                # cross-site inversions are the deadlock shape we hunt
+                continue
+            if (held_site, site) not in _edges:
+                new_edges.append((held_site, site))
+                _edges[(held_site, site)] = ""
+        cycles: list[list[str]] = []
+        for (a, b) in new_edges:
+            path = _find_cycle(a, b)  # [b, ..., a]; a -> b closes the loop
+            if path is not None:
+                key = tuple(sorted(path))
+                if key not in _seen_cycles:
+                    _seen_cycles.add(key)
+                    cycles.append([a] + path)
+    held.append((site, obj_id))
+    for cyc in cycles:
+        chain = " -> ".join(cyc + [cyc[0]])
+        _record_violation(
+            "lock-order-cycle",
+            f"lock-order inversion (potential deadlock): {chain}",
+        )
+
+
+def _note_released(site: str, obj_id: int) -> None:
+    held = _held()
+    for i in range(len(held) - 1, -1, -1):
+        if held[i] == (site, obj_id):
+            del held[i]
+            return
+
+
+class _CheckedLockBase:
+    """Wraps a real lock; mirrors its blocking/timeout semantics exactly."""
+
+    _factory = staticmethod(_orig_lock)
+
+    def __init__(self, site: str):
+        self._kb_inner = self._factory()
+        self._kb_site = site
+
+    # threading.Lock API ----------------------------------------------------
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        got = self._kb_inner.acquire(blocking, timeout)
+        if got:
+            _note_acquired(self._kb_site, id(self))
+        return got
+
+    def release(self) -> None:
+        self._kb_inner.release()
+        _note_released(self._kb_site, id(self))
+
+    def locked(self) -> bool:
+        return self._kb_inner.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def __repr__(self) -> str:
+        return f"<lockcheck {type(self).__name__} site={self._kb_site} {self._kb_inner!r}>"
+
+
+class _CheckedLock(_CheckedLockBase):
+    _factory = staticmethod(_orig_lock)
+
+
+class _CheckedRLock(_CheckedLockBase):
+    _factory = staticmethod(_orig_rlock)
+
+    # threading.Condition compatibility: Condition looks these up on the
+    # lock it is given and only RLocks define them, so they must exist
+    # here (and must NOT exist on _CheckedLock, where Condition falls back
+    # to plain acquire/release)
+    def _acquire_restore(self, state) -> None:
+        self._kb_inner._acquire_restore(state)
+        _note_acquired(self._kb_site, id(self))
+
+    def _release_save(self):
+        state = self._kb_inner._release_save()
+        _note_released(self._kb_site, id(self))
+        return state
+
+    def _is_owned(self) -> bool:
+        return self._kb_inner._is_owned()
+
+
+def _lock_factory():
+    site = _creation_site()
+    if site is None or not _installed:
+        return _orig_lock()
+    return _CheckedLock(site)
+
+
+def _rlock_factory():
+    site = _creation_site()
+    if site is None or not _installed:
+        return _orig_rlock()
+    return _CheckedRLock(site)
+
+
+_BLOCKING_THRESHOLD = 0.0005  # sleep(0) yields are not blocking work
+
+
+def _checked_sleep(seconds: float) -> None:
+    if seconds is not None and seconds > _BLOCKING_THRESHOLD:
+        held = _held()
+        if held:
+            sites = ", ".join(site for site, _ in held)
+            _record_violation(
+                "blocking-call-under-lock",
+                f"time.sleep({seconds!r}) while holding [{sites}]",
+            )
+    _orig_sleep(seconds)
+
+
+# ----------------------------------------------------------------------- api
+
+def install() -> None:
+    """Patch threading.Lock/RLock and time.sleep. Idempotent."""
+    global _installed
+    if _installed:
+        return
+    _installed = True
+    threading.Lock = _lock_factory
+    threading.RLock = _rlock_factory
+    time.sleep = _checked_sleep
+
+
+def uninstall() -> None:
+    """Restore the originals. Locks already wrapped keep working (they
+    hold a real lock inside), but stop recording."""
+    global _installed
+    if not _installed:
+        return
+    _installed = False
+    threading.Lock = _orig_lock
+    threading.RLock = _orig_rlock
+    time.sleep = _orig_sleep
+
+
+def installed() -> bool:
+    return _installed
+
+
+def reset() -> None:
+    """Drop all recorded state (graph, violations, EVERY thread's stack)."""
+    with _state_lock:
+        _edges.clear()
+        _violations.clear()
+        _seen_cycles.clear()
+        for held in _held_lists.values():
+            held.clear()
+
+
+def violations() -> list[Violation]:
+    with _state_lock:
+        return list(_violations)
+
+
+def take_violations() -> list[Violation]:
+    """Return and clear the recorded violations (the conftest drain)."""
+    with _state_lock:
+        out = list(_violations)
+        _violations.clear()
+    return out

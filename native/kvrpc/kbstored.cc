@@ -41,8 +41,12 @@
 //                keys u8[n*kw] | lens i32[n] | revs u64[n] | tomb u8[n] |
 //                u64 alen | arena | offsets u64[n+1]. Paged by rows AND by
 //                a 32 MB arena cap; resume with start = next_start.
-//  11 REPL_HELLO u64 follower_ts [| u8 caps] -> u8 need_dump [| dump
-//                record]; caps bit 0 = understands empty heartbeat pushes
+//  11 REPL_HELLO u64 follower_ts [| u8 caps [| u64 term | u32 member_idx]]
+//                -> u8 need_dump [| dump record]; term + member_idx are
+//                quorum-mode only: the term lets a stale leader step down
+//                on contact, the member index is verified against the
+//                member list and counted at most once toward the quorum
+//                (SConn::member_idx); caps bit 0 = understands heartbeats
 //                (only capable replicas receive them); marks the
 //                conn as a replica stream: committed WAL records are pushed
 //                to it as frames with req_id=0 (semi-sync: client write
@@ -735,12 +739,17 @@ void release_pending() {
     int need = g_quorum - 1;  // follower acks required (leader counts too)
     if (need <= 0) {
       floor = UINT64_MAX;  // single-member cluster: self IS the majority
-    } else if (static_cast<int>(g_replicas.size()) < need) {
-      return;  // below quorum: nothing can commit
     } else {
+      // only verified members count: a stream that never proved a member
+      // identity (member_idx < 0) must not satisfy the majority
       std::vector<uint64_t> acks;
       acks.reserve(g_replicas.size());
-      for (SConn *r : g_replicas) acks.push_back(r->acked);
+      for (SConn *r : g_replicas) {
+        if (r->member_idx >= 0) acks.push_back(r->acked);
+      }
+      if (static_cast<int>(acks.size()) < need) {
+        return;  // below quorum: nothing can commit
+      }
       // floor = the need-th largest ack: exactly the highest ts that
       // (need) followers have durably applied
       std::nth_element(acks.begin(), acks.begin() + (need - 1), acks.end(),
@@ -787,6 +796,17 @@ void drop_replica(SConn *c) {
     }
   }
   release_pending();  // no replicas left -> flush everything
+}
+
+// Streams that count toward the quorum: attached AND member-verified.
+// Both the write-acceptance gate and release_pending() must use the same
+// count, or writes get accepted that can only ever time out ST_UNCERTAIN.
+int verified_replicas() {
+  int n = 0;
+  for (SConn *r : g_replicas) {
+    if (r->member_idx >= 0) ++n;
+  }
+  return n;
 }
 
 // Deferred teardown: a conn referenced by the epoll events batch currently
@@ -939,10 +959,22 @@ void handle_repl_op(SConn *c, uint8_t op, Reader &r, uint64_t req_id) {
     // quorum followers append their term: a leader hearing a newer term
     // must step down before it feeds anyone
     uint64_t fterm = r.n - r.off >= 8 ? r.num<uint64_t>() : 0;
+    // ...and their member index: only verified members count toward the
+    // quorum (a hello without one — pre-upgrade binary or legacy mode —
+    // attaches but never satisfies quorum acks). Parsed into a wide type
+    // so 0xFFFFFFFF cannot alias the "absent" sentinel via int overflow.
+    long long midx = r.n - r.off >= 4
+                         ? static_cast<long long>(r.num<uint32_t>())
+                         : -1;
     uint64_t myts = kb_tso(g_store);
     if (!r.ok) {
       status = ST_ERROR;
       body = "malformed hello";
+    } else if (quorum_mode() && midx >= 0 &&
+               (midx == g_self ||
+                midx >= static_cast<long long>(g_members.size()))) {
+      status = ST_ERROR;
+      body = "bad member identity in hello";
     } else if (quorum_mode() && fterm > g_epoch) {
       step_down(fterm);
       status = ST_ERROR;  // transient: follower retries at the real leader
@@ -960,6 +992,22 @@ void handle_repl_op(SConn *c, uint8_t op, Reader &r, uint64_t req_id) {
       status = ST_DRIFT;
       body = "follower ahead of primary";
     } else {
+      // a repeated HELLO on an already-attached stream must not leave two
+      // registrations (or, worse, doom this very conn in the member
+      // eviction below and then push the zombie back into the list) — and
+      // each hello re-establishes identity from scratch: a re-hello that
+      // omits the member index must not keep counting under the old one
+      if (c->kind == 1) drop_replica(c);
+      c->member_idx = -1;
+      if (quorum_mode() && midx >= 0) {
+        // one counted stream per member: a reconnecting follower whose
+        // old stream has not been reaped yet must not double-count its
+        // acks toward the quorum — evict the stale stream first
+        for (SConn *old : std::vector<SConn *>(g_replicas)) {
+          if (old != c && old->member_idx == midx) doom_conn(old);
+        }
+        c->member_idx = static_cast<int>(midx);
+      }
       c->kind = 1;
       c->caps = caps;
       c->acked = fts > myts ? 0 : fts;  // divergent clock: resync from zero
@@ -1023,13 +1071,16 @@ bool conn_ingest(SConn *c) {
       body = "read-only follower (promote or write to the primary)";
       status = ST_ERROR;
     } else if (quorum_mode() && is_write_op(op) &&
-               static_cast<int>(g_replicas.size()) < g_quorum - 1) {
+               verified_replicas() < g_quorum - 1) {
       // REFUSED before anything is applied: a definite failure the client
       // may safely retry on the real leader. Never the legacy standalone
-      // degradation — an ack the majority does not hold is a lie.
+      // degradation — an ack the majority does not hold is a lie. Counts
+      // VERIFIED members only, same as release_pending: an unverified
+      // stream can never satisfy the quorum, so accepting its write would
+      // just park it until the ST_UNCERTAIN ack timeout.
       char msg[96];
       snprintf(msg, sizeof msg, "no quorum (%d of %d needed followers attached)",
-               static_cast<int>(g_replicas.size()), g_quorum - 1);
+               verified_replicas(), g_quorum - 1);
       body = msg;
       status = ST_ERROR;
     } else {
@@ -1448,6 +1499,10 @@ bool vote_ingest(SConn *c) {
   if (c->in.size() < 13) return true;  // keep reading
   uint32_t blen;
   memcpy(&blen, c->in.data(), 4);
+  // a vote response is a handful of bytes; an oversized length prefix is
+  // garbage (or hostile) and must not make us buffer toward OOM waiting
+  // for bytes that never come — same MAX_FRAME bound the client plane has
+  if (blen > MAX_FRAME) return false;  // doom the link
   if (c->in.size() < 13 + blen) return true;
   uint8_t status = static_cast<uint8_t>(c->in[12]);
   bool stale_phase =
@@ -1479,7 +1534,7 @@ void quorum_tick(uint64_t now) {
     // Leader below quorum: it cannot commit anything. Probe peers (rate
     // limited, one per tick) for a higher-term leader to step down to —
     // the healed side of a partition rejoins this way.
-    if (static_cast<int>(g_replicas.size()) < g_quorum - 1 &&
+    if (verified_replicas() < g_quorum - 1 &&
         now >= g_probe_next_ms) {
       g_probe_next_ms = now + 1000;
       g_probe_rr = (g_probe_rr + 1) % static_cast<int>(g_members.size());
@@ -1573,16 +1628,22 @@ void upstream_connect() {
   c->fd = fd;
   c->kind = 2;
   // HELLO (req_id 1): my clock; primary dumps if it is ahead. Quorum
-  // followers append their term so a stale leader steps down on contact.
+  // followers append their term (so a stale leader steps down on contact)
+  // and their member index (so the leader can verify the identity and
+  // count at most one quorum ack per member — SConn::member_idx).
   uint64_t myts = kb_tso(g_store);
-  uint32_t blen = quorum_mode() ? 17 : 9;
+  uint32_t blen = quorum_mode() ? 21 : 9;
   uint64_t req_id = 1;
   c->out.append(reinterpret_cast<char *>(&blen), 4);
   c->out.append(reinterpret_cast<char *>(&req_id), 8);
   c->out.push_back(static_cast<char>(OP_REPL_HELLO));
   c->out.append(reinterpret_cast<char *>(&myts), 8);
   c->out.push_back(1);  // caps: heartbeats understood
-  if (quorum_mode()) c->out.append(reinterpret_cast<char *>(&g_epoch), 8);
+  if (quorum_mode()) {
+    c->out.append(reinterpret_cast<char *>(&g_epoch), 8);
+    uint32_t self_idx = static_cast<uint32_t>(g_self);
+    c->out.append(reinterpret_cast<char *>(&self_idx), 4);
+  }
   epoll_event ev{};
   ev.events = EPOLLIN | EPOLLOUT;
   ev.data.ptr = c;

@@ -47,6 +47,36 @@ import sys  # noqa: E402
 
 import pytest  # noqa: E402
 
+# ---------------------------------------------------------------------------
+# Opt-in lock-order race detector (see kubebrain_tpu/util/lockcheck.py and
+# docs/static_analysis.md). KB_LOCKCHECK=1 wraps every project-created
+# threading.Lock/RLock to build the runtime lock-order graph; a test that
+# produces an ABBA inversion or holds a lock across a blocking call FAILS
+# with the offending stacks. Installed here, before any test module imports
+# kubebrain_tpu, so module-level locks are wrapped too.
+
+_LOCKCHECK = os.environ.get("KB_LOCKCHECK") == "1"
+if _LOCKCHECK:
+    from kubebrain_tpu.util import lockcheck as _lockcheck
+
+    _lockcheck.install()
+
+
+@pytest.fixture(autouse=True)
+def _lockcheck_guard():
+    if not _LOCKCHECK:
+        yield
+        return
+    _lockcheck.take_violations()  # stale noise from other tests' threads
+    yield
+    found = _lockcheck.take_violations()
+    if found:
+        raise _lockcheck.LockOrderError(
+            "lock-discipline violations during this test:\n"
+            + "\n".join(v.render() for v in found)
+        )
+
+
 _DEADLINE_DEFAULT = 240.0
 
 

@@ -1,0 +1,263 @@
+"""kblint self-tests: each rule catches its target pattern, stays quiet on
+clean code, and honors the suppression syntax."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from tools.kblint import rules  # noqa: F401  -- registers the rules
+from tools.kblint.core import RULES, lint_source
+
+EP = "kubebrain_tpu/endpoint/x.py"
+SRV_ETCD = "kubebrain_tpu/server/etcd/x.py"
+OPS = "kubebrain_tpu/ops/x.py"
+ANY = "kubebrain_tpu/backend/x.py"
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def ids(src, relpath):
+    return [f.rule_id for f in lint_source(src, relpath)]
+
+
+# ------------------------------------------------------------------- KB101
+def test_kb101_flags_sleep_in_async():
+    src = "import time\nasync def f():\n    time.sleep(1)\n"
+    assert ids(src, EP) == ["KB101"]
+
+
+def test_kb101_flags_subprocess_in_async():
+    src = "import subprocess\nasync def f():\n    subprocess.Popen(['x'])\n"
+    assert ids(src, EP) == ["KB101"]
+
+
+def test_kb101_ignores_executor_thunk():
+    # a nested sync def is an executor thunk, not coroutine-body code
+    src = (
+        "import time\n"
+        "async def f(loop):\n"
+        "    def blocking():\n"
+        "        time.sleep(1)\n"
+        "    await loop.run_in_executor(None, blocking)\n"
+    )
+    assert ids(src, EP) == []
+
+
+def test_kb101_scoped_to_endpoint_and_server():
+    src = "import time\nasync def f():\n    time.sleep(1)\n"
+    assert ids(src, ANY) == []
+
+
+def test_kb101_sees_nested_async_def():
+    src = (
+        "import time\n"
+        "async def outer():\n"
+        "    async def inner():\n"
+        "        time.sleep(1)\n"
+        "    await inner()\n"
+    )
+    assert ids(src, EP) == ["KB101"]
+
+
+# ------------------------------------------------------------------- KB102
+def test_kb102_flags_jax_under_lock():
+    src = (
+        "import jax\n"
+        "def f(self):\n"
+        "    with self._lock:\n"
+        "        jax.device_put(1)\n"
+    )
+    assert ids(src, ANY) == ["KB102"]
+
+
+def test_kb102_flags_sleep_under_lock():
+    src = "import time\ndef f(self):\n    with self._mlock:\n        time.sleep(1)\n"
+    assert ids(src, ANY) == ["KB102"]
+
+
+def test_kb102_flags_rpc_under_lock():
+    src = (
+        "import urllib.request\n"
+        "def f(self):\n"
+        "    with self.lock:\n"
+        "        urllib.request.urlopen('http://x')\n"
+    )
+    assert ids(src, ANY) == ["KB102"]
+
+
+def test_kb102_ignores_non_lock_context():
+    src = "import time\ndef f(self):\n    with open('x') as fh:\n        time.sleep(1)\n"
+    assert ids(src, ANY) == []
+
+
+def test_kb102_ignores_callback_defined_under_lock():
+    src = (
+        "import jax\n"
+        "def f(self):\n"
+        "    with self._lock:\n"
+        "        def later():\n"
+        "            jax.device_put(1)\n"
+        "        self.cb = later\n"
+    )
+    assert ids(src, ANY) == []
+
+
+# ------------------------------------------------------------------- KB103
+def test_kb103_flags_bare_except():
+    src = "try:\n    x = 1\nexcept:\n    pass\n"
+    assert ids(src, ANY) == ["KB103"]
+
+
+def test_kb103_allows_typed_except():
+    src = "try:\n    x = 1\nexcept Exception:\n    pass\n"
+    assert ids(src, ANY) == []
+
+
+# ------------------------------------------------------------------- KB104
+@pytest.mark.parametrize("decorator", [
+    "@jax.jit",
+    "@jit",
+    "@partial(jax.jit, static_argnums=0)",
+    "@jax.jit(static_argnums=0)",
+])
+def test_kb104_flags_device_get_in_jit(decorator):
+    src = (
+        "import jax\nfrom functools import partial\nfrom jax import jit\n"
+        f"{decorator}\n"
+        "def kernel(x):\n"
+        "    return jax.device_get(x)\n"
+    )
+    assert ids(src, OPS) == ["KB104"]
+
+
+def test_kb104_flags_block_until_ready_in_jit():
+    src = "import jax\n@jax.jit\ndef kernel(x):\n    return x.block_until_ready()\n"
+    assert ids(src, OPS) == ["KB104"]
+
+
+def test_kb104_ignores_unjitted_and_out_of_ops():
+    src = "import jax\ndef driver(x):\n    return jax.device_get(x)\n"
+    assert ids(src, OPS) == []
+    jitted = "import jax\n@jax.jit\ndef kernel(x):\n    return jax.device_get(x)\n"
+    assert ids(jitted, ANY) == []
+
+
+# ------------------------------------------------------------------- KB105
+def test_kb105_flags_raw_revision_arithmetic():
+    assert ids("def f(rev):\n    return rev + 1\n", SRV_ETCD) == ["KB105"]
+    assert ids("def f(creq):\n    r = -int(creq.start_revision)\n", SRV_ETCD) == ["KB105"]
+    assert ids("def f(rev):\n    rev += 1\n    return rev\n", SRV_ETCD) == ["KB105"]
+
+
+def test_kb105_allows_helpers_and_encoding():
+    src = (
+        "from ..service.revision import next_revision\n"
+        "def f(rev):\n"
+        "    return next_revision(rev)\n"
+    )
+    assert ids(src, SRV_ETCD) == []
+    # serializing a revision into a frame is encoding, not arithmetic
+    enc = "def f(rev):\n    return b'HDR' + rev.to_bytes(8, 'big')\n"
+    assert ids(enc, SRV_ETCD) == []
+
+
+def test_kb105_scoped_to_server_etcd():
+    assert ids("def f(rev):\n    return rev + 1\n", ANY) == []
+
+
+def test_kb105_ignores_non_revision_arithmetic():
+    assert ids("def f(n):\n    return n + 1\n", SRV_ETCD) == []
+    assert ids("def f(prev):\n    return prev + 1\n", SRV_ETCD) == []
+
+
+# ------------------------------------------------------------- suppressions
+def test_suppression_on_flagged_line():
+    src = "import time\nasync def f():\n    time.sleep(1)  # kblint: disable=KB101 -- test\n"
+    assert ids(src, EP) == []
+
+
+def test_suppression_on_comment_line_above():
+    src = (
+        "import time\n"
+        "async def f():\n"
+        "    # kblint: disable=KB101 -- test\n"
+        "    time.sleep(1)\n"
+    )
+    assert ids(src, EP) == []
+
+
+def test_suppression_on_with_header_covers_block():
+    src = (
+        "import jax\n"
+        "def f(self):\n"
+        "    with self._lock:  # kblint: disable=KB102 -- mirror publish\n"
+        "        jax.device_put(1)\n"
+        "        jax.device_put(2)\n"
+    )
+    assert ids(src, ANY) == []
+
+
+def test_kb102_async_with_flagged_and_header_suppressible():
+    src = (
+        "import jax\n"
+        "async def f(self):\n"
+        "    async with self._lock:\n"
+        "        jax.device_put(1)\n"
+    )
+    assert ids(src, ANY) == ["KB102"]
+    sup = src.replace(
+        "async with self._lock:",
+        "async with self._lock:  # kblint: disable=KB102 -- test",
+    )
+    assert ids(sup, ANY) == []
+
+
+def test_file_level_suppression():
+    src = "# kblint: disable-file=KB103\ntry:\n    x = 1\nexcept:\n    pass\n"
+    assert ids(src, ANY) == []
+
+
+def test_wrong_rule_suppression_does_not_mask():
+    src = "import time\nasync def f():\n    time.sleep(1)  # kblint: disable=KB103\n"
+    assert ids(src, EP) == ["KB101"]
+
+
+def test_trailing_code_pragma_does_not_leak_to_next_line():
+    src = (
+        "import time\n"
+        "async def f():\n"
+        "    x = 1  # kblint: disable=KB101\n"
+        "    time.sleep(1)\n"
+    )
+    assert ids(src, EP) == ["KB101"]
+
+
+# ------------------------------------------------------------ registry/CLI
+def test_registry_has_all_rules():
+    assert set(RULES) == {"KB101", "KB102", "KB103", "KB104", "KB105"}
+    for rule in RULES.values():
+        assert rule.summary
+
+
+def test_syntax_error_reported_not_raised():
+    assert ids("def f(:\n", ANY) == ["KB000"]
+
+
+def test_cli_clean_on_this_repo():
+    """The acceptance invariant: the shipped tree lints clean."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.kblint", "kubebrain_tpu", "tools", "tests"],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_cli_list_rules():
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.kblint", "--list-rules"],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert proc.returncode == 0
+    for rid in ("KB101", "KB102", "KB103", "KB104", "KB105"):
+        assert rid in proc.stdout

@@ -1,0 +1,154 @@
+"""Race-detector fixtures: a deliberate ABBA lock inversion and a
+lock-held-across-sleep, both asserted to be caught by the lockcheck shim
+(and a few no-false-positive checks)."""
+
+import threading
+import time
+
+import pytest
+
+from kubebrain_tpu.util import lockcheck
+
+
+@pytest.fixture
+def lc():
+    """Install the shim for this test (idempotent under KB_LOCKCHECK=1)
+    with a clean graph, and drain whatever the test produced on the way
+    out so the conftest guard never double-reports fixture violations."""
+    was_installed = lockcheck.installed()
+    lockcheck.install()
+    lockcheck.reset()
+    yield lockcheck
+    lockcheck.take_violations()
+    lockcheck.reset()
+    if not was_installed:
+        lockcheck.uninstall()
+
+
+def _make_two_locks():
+    # distinct construction lines => distinct lock sites in the order graph
+    lock_a = threading.Lock()
+    lock_b = threading.Lock()
+    return lock_a, lock_b
+
+
+def test_abba_inversion_is_caught(lc):
+    lock_a, lock_b = _make_two_locks()
+
+    def t1():
+        with lock_a:
+            with lock_b:
+                pass
+
+    def t2():
+        with lock_b:
+            with lock_a:
+                pass
+
+    # run sequentially: the ORDER GRAPH (A->B then B->A) is the hazard,
+    # no actual interleaving needed to prove the deadlock potential
+    for fn in (t1, t2):
+        t = threading.Thread(target=fn)
+        t.start()
+        t.join()
+
+    cycles = [v for v in lc.violations() if v.kind == "lock-order-cycle"]
+    assert cycles, "ABBA inversion not detected"
+    assert "lock-order inversion" in cycles[0].detail
+    # both sites appear in the reported cycle
+    assert "test_lockcheck.py" in cycles[0].detail
+
+
+def test_consistent_order_is_clean(lc):
+    lock_a, lock_b = _make_two_locks()
+    for _ in range(3):
+        with lock_a:
+            with lock_b:
+                pass
+    assert [v for v in lc.violations() if v.kind == "lock-order-cycle"] == []
+
+
+def test_sleep_under_lock_is_caught(lc):
+    lock_a = threading.Lock()
+    with lock_a:
+        time.sleep(0.005)
+    sleeps = [v for v in lc.violations() if v.kind == "blocking-call-under-lock"]
+    assert sleeps, "lock-held-across-sleep not detected"
+    assert "time.sleep" in sleeps[0].detail
+    assert "test_lockcheck.py" in sleeps[0].detail
+
+
+def test_sleep_without_lock_is_clean(lc):
+    time.sleep(0.001)
+    assert [v for v in lc.violations() if v.kind == "blocking-call-under-lock"] == []
+
+
+def test_rlock_reentry_is_clean(lc):
+    rl = threading.RLock()
+
+    with rl:
+        with rl:
+            pass
+    assert lc.violations() == []
+
+
+def test_three_lock_cycle_is_caught(lc):
+    lock_a = threading.Lock()
+    lock_b = threading.Lock()
+    lock_c = threading.Lock()
+
+    with lock_a:
+        with lock_b:
+            pass
+    with lock_b:
+        with lock_c:
+            pass
+    with lock_c:
+        with lock_a:
+            pass
+
+    cycles = [v for v in lc.violations() if v.kind == "lock-order-cycle"]
+    assert cycles, "A->B->C->A cycle not detected"
+
+
+def test_take_violations_drains(lc):
+    lock_a = threading.Lock()
+    with lock_a:
+        time.sleep(0.002)
+    assert lc.take_violations()
+    assert lc.violations() == []
+
+
+def test_condition_on_checked_locks_works(lc):
+    """threading.Condition must keep functioning over wrapped locks (the
+    watch hub pairs conditions with its queue locks)."""
+    cond_plain = threading.Condition(threading.Lock())
+    cond_rlock = threading.Condition(threading.RLock())
+    for cond in (cond_plain, cond_rlock):
+        done = []
+
+        def waiter(c=cond):
+            with c:
+                while not done:
+                    c.wait(timeout=1.0)
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        time.sleep(0.01)
+        with cond:
+            done.append(True)
+            cond.notify_all()
+        t.join(timeout=2.0)
+        assert not t.is_alive()
+
+
+def test_uninstall_restores_primitives():
+    was_installed = lockcheck.installed()
+    lockcheck.install()
+    try:
+        assert threading.Lock is not lockcheck._orig_lock
+    finally:
+        if not was_installed:
+            lockcheck.uninstall()
+            assert threading.Lock is lockcheck._orig_lock
+            assert time.sleep is lockcheck._orig_sleep

@@ -1,0 +1,65 @@
+"""Sanitized native build smoke: `make -C native asan` must build, and the
+smoke binary (linked against the ASan/UBSan libkbstore.so) must drive the
+engine path clean — any sanitizer report fails the run."""
+
+import os
+import shutil
+import subprocess
+
+import pytest
+
+NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "native")
+
+
+def _toolchain_available() -> bool:
+    cxx = os.environ.get("CXX", "g++")
+    if shutil.which(cxx) is None or shutil.which("make") is None:
+        return False
+    # the sanitizer runtime may be missing even when g++ exists
+    probe = subprocess.run(
+        [cxx, "-fsanitize=address", "-x", "c++", "-", "-o", "/dev/null"],
+        input=b"int main(){return 0;}", capture_output=True,
+    )
+    return probe.returncode == 0
+
+
+pytestmark = pytest.mark.skipif(
+    not _toolchain_available(), reason="C++ toolchain or ASan runtime unavailable"
+)
+
+
+def test_asan_build_and_smoke(tmp_path):
+    build = subprocess.run(
+        ["make", "-C", NATIVE_DIR, "asan"], capture_output=True, text=True
+    )
+    assert build.returncode == 0, build.stdout + build.stderr
+
+    smoke = subprocess.run(
+        [os.path.join(NATIVE_DIR, "kbstore_smoke_asan"), str(tmp_path / "wal")],
+        capture_output=True, text=True,
+        env={**os.environ, "ASAN_OPTIONS": "abort_on_error=1",
+             "UBSAN_OPTIONS": "halt_on_error=1"},
+    )
+    assert smoke.returncode == 0, smoke.stdout + smoke.stderr
+    assert "SMOKE OK" in smoke.stdout
+    # the sanitized library is what the binary actually loaded
+    maps = subprocess.run(
+        ["ldd", os.path.join(NATIVE_DIR, "kbstore_smoke_asan")],
+        capture_output=True, text=True,
+    )
+    assert "libkbstore_asan.so" in maps.stdout
+
+
+@pytest.mark.slow
+def test_tsan_build_and_smoke(tmp_path):
+    build = subprocess.run(
+        ["make", "-C", NATIVE_DIR, "tsan"], capture_output=True, text=True
+    )
+    assert build.returncode == 0, build.stdout + build.stderr
+    smoke = subprocess.run(
+        [os.path.join(NATIVE_DIR, "kbstore_smoke_tsan"), str(tmp_path / "wal")],
+        capture_output=True, text=True,
+        env={**os.environ, "TSAN_OPTIONS": "halt_on_error=1"},
+    )
+    assert smoke.returncode == 0, smoke.stdout + smoke.stderr
+    assert "SMOKE OK" in smoke.stdout

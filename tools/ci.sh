@@ -1,0 +1,19 @@
+#!/usr/bin/env bash
+# The offline CI entry point (mirrored by .github/workflows/check.yml):
+#   1. make lint        — kblint project invariants + native lint
+#   2. make typecheck   — mypy (or compileall fallback)
+#   3. tier-1 pytest    — the ROADMAP.md verify command
+# Run from anywhere; operates on the repo this script lives in.
+
+set -uo pipefail
+
+cd "$(dirname "$0")/.."
+
+echo "=== [1/3] make lint"
+make lint || exit 1
+
+echo "=== [2/3] make typecheck"
+make typecheck || exit 1
+
+echo "=== [3/3] tier-1 tests (ROADMAP.md verify, one definition: make test-tier1)"
+exec make test-tier1

@@ -1,0 +1,23 @@
+"""kblint: project-invariant static analysis for kubebrain-tpu.
+
+The test suite samples the project's correctness invariants; kblint checks
+them on every line. Each rule encodes one invariant the architecture
+depends on (see docs/static_analysis.md for the full catalogue):
+
+- KB101  no blocking calls inside ``async def`` bodies (endpoint/, server/)
+- KB102  no JAX dispatch / RPC / sleeps while holding a ``threading.Lock``
+- KB103  no bare ``except:``
+- KB104  no host synchronization inside ``@jax.jit`` kernels (ops/)
+- KB105  revision arithmetic must flow through server/service/revision.py
+
+Suppress a finding with a trailing comment on the flagged line (or on the
+enclosing ``with``/``def`` header for block rules)::
+
+    subprocess.Popen(...)  # kblint: disable=KB101 -- one-shot startup fork
+
+Run as ``python -m tools.kblint [paths...]``.
+"""
+
+from .core import Finding, Rule, RULES, lint_paths, lint_source, register
+
+__all__ = ["Finding", "Rule", "RULES", "lint_paths", "lint_source", "register"]

@@ -1,0 +1,40 @@
+"""CLI: ``python -m tools.kblint [paths...] [--list-rules]``."""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from . import rules  # noqa: F401  -- importing registers the rules
+from .core import RULES, lint_paths
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="kblint", description="kubebrain-tpu project-invariant linter"
+    )
+    parser.add_argument("paths", nargs="*", default=["kubebrain_tpu"],
+                        help="files or directories to lint")
+    parser.add_argument("--list-rules", action="store_true",
+                        help="print the rule catalogue and exit")
+    parser.add_argument("--root", default=os.getcwd(),
+                        help="repo root for relative paths (default: cwd)")
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        for rid in sorted(RULES):
+            print(f"{rid}  {RULES[rid].summary}")
+        return 0
+
+    findings = lint_paths(args.paths or ["kubebrain_tpu"], root=args.root)
+    for f in findings:
+        print(f.format())
+    if findings:
+        print(f"kblint: {len(findings)} finding(s)", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

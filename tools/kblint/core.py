@@ -1,0 +1,145 @@
+"""Rule registry, suppression handling, and the lint driver."""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+from typing import Iterable
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    path: str
+    line: int
+    col: int
+    rule_id: str
+    message: str
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.rule_id} {self.message}"
+
+
+class Rule:
+    """One project invariant. Subclasses set ``rule_id``/``summary`` and
+    implement ``check(tree, src)`` yielding ``(node, message)`` pairs."""
+
+    rule_id: str = ""
+    summary: str = ""
+
+    def applies(self, relpath: str) -> bool:
+        """Whether this rule runs on the file at repo-relative ``relpath``."""
+        return True
+
+    def check(self, tree: ast.Module, src: str) -> Iterable[tuple[ast.AST, str]]:
+        raise NotImplementedError
+
+
+RULES: dict[str, Rule] = {}
+
+
+def register(rule_cls: type[Rule]) -> type[Rule]:
+    rule = rule_cls()
+    if not rule.rule_id:
+        raise ValueError(f"{rule_cls.__name__} has no rule_id")
+    if rule.rule_id in RULES:
+        raise ValueError(f"duplicate rule id {rule.rule_id}")
+    RULES[rule.rule_id] = rule
+    return rule_cls
+
+
+_DISABLE_RE = re.compile(r"#\s*kblint:\s*disable=([A-Z0-9,\s]+?)(?:\s*--.*)?$")
+_DISABLE_FILE_RE = re.compile(r"#\s*kblint:\s*disable-file=([A-Z0-9,\s]+?)(?:\s*--.*)?$")
+
+
+def _disabled_on_line(line: str) -> set[str]:
+    m = _DISABLE_RE.search(line)
+    if not m:
+        return set()
+    return {r.strip() for r in m.group(1).split(",") if r.strip()}
+
+
+def _file_disabled(lines: list[str]) -> set[str]:
+    out: set[str] = set()
+    for line in lines[:20]:  # file-level pragmas live in the header
+        m = _DISABLE_FILE_RE.search(line)
+        if m:
+            out |= {r.strip() for r in m.group(1).split(",") if r.strip()}
+    return out
+
+
+def _suppression_lines(node: ast.AST, tree: ast.Module) -> set[int]:
+    """Lines whose disable comment covers ``node``: the node's own first
+    line, the comment line directly above it, plus the header line of every
+    enclosing with/def/async-def block (so one pragma on ``with
+    self._lock:`` covers the whole block)."""
+    covered = {getattr(node, "lineno", 0)}
+    target_line = getattr(node, "lineno", 0)
+    for parent in ast.walk(tree):
+        if not isinstance(parent, (ast.With, ast.AsyncWith,
+                                   ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        end = getattr(parent, "end_lineno", 0) or 0
+        if parent.lineno <= target_line <= end:
+            covered.add(parent.lineno)
+    return covered
+
+
+def lint_source(src: str, relpath: str, rules: Iterable[Rule] | None = None) -> list[Finding]:
+    rules = list(rules if rules is not None else RULES.values())
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as e:
+        return [Finding(relpath, e.lineno or 0, e.offset or 0, "KB000",
+                        f"syntax error: {e.msg}")]
+    lines = src.splitlines()
+    file_off = _file_disabled(lines)
+    findings: list[Finding] = []
+    for rule in rules:
+        if rule.rule_id in file_off or not rule.applies(relpath):
+            continue
+        for node, message in rule.check(tree, src):
+            line = getattr(node, "lineno", 0)
+            col = getattr(node, "col_offset", 0)
+            candidates = _suppression_lines(node, tree)
+            # a pure comment line directly above the finding also counts
+            if line >= 2 and lines[line - 2].lstrip().startswith("#"):
+                candidates.add(line - 1)
+            suppressed = any(
+                rule.rule_id in _disabled_on_line(lines[ln - 1])
+                for ln in candidates if 1 <= ln <= len(lines)
+            )
+            if not suppressed:
+                findings.append(Finding(relpath, line, col, rule.rule_id, message))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule_id))
+    return findings
+
+
+def iter_py_files(paths: list[str], root: str) -> Iterable[str]:
+    skip_dirs = {".git", "__pycache__", ".claude", "node_modules"}
+    for p in paths:
+        ap = p if os.path.isabs(p) else os.path.join(root, p)
+        if os.path.isfile(ap) and ap.endswith(".py"):
+            yield ap
+            continue
+        for dirpath, dirnames, filenames in os.walk(ap):
+            dirnames[:] = [d for d in dirnames if d not in skip_dirs]
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    yield os.path.join(dirpath, fn)
+
+
+def lint_paths(paths: list[str], root: str | None = None) -> list[Finding]:
+    root = root or os.getcwd()
+    findings: list[Finding] = []
+    for ap in iter_py_files(paths, root):
+        relpath = os.path.relpath(ap, root)
+        try:
+            with open(ap, encoding="utf-8") as f:
+                src = f.read()
+        except (OSError, UnicodeDecodeError) as e:
+            findings.append(Finding(relpath, 0, 0, "KB000", f"unreadable: {e}"))
+            continue
+        findings.extend(lint_source(src, relpath))
+    return findings

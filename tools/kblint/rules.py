@@ -1,0 +1,258 @@
+"""The project-invariant rules. Importing this module populates RULES."""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterable, Iterator
+
+from .core import Rule, register
+
+
+def dotted_name(node: ast.expr) -> str:
+    """``a.b.c`` for Name/Attribute chains, "" for anything else."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def terminal_name(node: ast.expr) -> str:
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return ""
+
+
+def walk_same_scope(body: list[ast.stmt]) -> Iterator[ast.AST]:
+    """Walk statements without descending into nested function/class defs —
+    code inside a nested def runs later, under different conditions (e.g.
+    an executor thunk defined in a coroutine, or a callback defined under a
+    lock)."""
+    stack: list[ast.AST] = list(body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef, ast.Lambda)):
+            yield node  # the def statement itself, but not its contents
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+# Module-level callables that block the calling thread. Method calls on
+# arbitrary objects (sock.recv, proc.wait) are untypeable statically and are
+# the runtime lock-order detector's job (util/lockcheck.py).
+_BLOCKING_CALLS = {
+    "time.sleep",
+    "socket.create_connection",
+    "urllib.request.urlopen",
+    "open",
+}
+_BLOCKING_MODULES = ("subprocess", "requests")
+
+
+def _is_blocking_call(call: ast.Call) -> str | None:
+    name = dotted_name(call.func)
+    if name in _BLOCKING_CALLS:
+        return name
+    root = name.split(".", 1)[0]
+    if root in _BLOCKING_MODULES:
+        return name
+    return None
+
+
+@register
+class NoBlockingInAsync(Rule):
+    """An event-loop thread serves every watch stream on the port; one
+    blocking call stalls them all. Blocking work belongs in
+    ``run_in_executor``."""
+
+    rule_id = "KB101"
+    summary = "no blocking calls inside async def bodies (endpoint/, server/)"
+
+    def applies(self, relpath: str) -> bool:
+        return relpath.replace("\\", "/").startswith(
+            ("kubebrain_tpu/endpoint/", "kubebrain_tpu/server/")
+        )
+
+    def check(self, tree: ast.Module, src: str) -> Iterable[tuple[ast.AST, str]]:
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.AsyncFunctionDef):
+                continue
+            for inner in walk_same_scope(node.body):
+                # nested async defs are visited by the outer ast.walk
+                if isinstance(inner, ast.AsyncFunctionDef):
+                    continue
+                if isinstance(inner, ast.Call):
+                    name = _is_blocking_call(inner)
+                    if name:
+                        yield inner, (
+                            f"blocking call {name}() inside async def "
+                            f"{node.name!r}; use run_in_executor"
+                        )
+
+
+_LOCK_NAME_RE = re.compile(r"lock$", re.IGNORECASE)
+
+
+def _lock_expr(item: ast.withitem) -> str | None:
+    name = terminal_name(item.context_expr)
+    if name and _LOCK_NAME_RE.search(name):
+        return dotted_name(item.context_expr) or name
+    return None
+
+
+@register
+class NoDispatchUnderLock(Rule):
+    """JAX dispatch can block on device availability and RPC/sleep on the
+    network; either inside a ``threading.Lock`` region turns one slow call
+    into a process-wide convoy (and, cross-lock, a deadlock)."""
+
+    rule_id = "KB102"
+    summary = "no JAX dispatch, RPC, or sleeps while holding a threading lock"
+
+    def applies(self, relpath: str) -> bool:
+        return relpath.replace("\\", "/").startswith("kubebrain_tpu/")
+
+    def check(self, tree: ast.Module, src: str) -> Iterable[tuple[ast.AST, str]]:
+        for node in ast.walk(tree):
+            if not isinstance(node, (ast.With, ast.AsyncWith)):
+                continue
+            locks = [l for l in (_lock_expr(i) for i in node.items) if l]
+            if not locks:
+                continue
+            held = locks[0]
+            for inner in walk_same_scope(node.body):
+                if not isinstance(inner, ast.Call):
+                    continue
+                name = dotted_name(inner.func)
+                if name.startswith("jax."):
+                    yield inner, f"JAX dispatch {name}() while holding {held}"
+                elif terminal_name(inner.func) == "block_until_ready":
+                    yield inner, f"block_until_ready() while holding {held}"
+                elif _is_blocking_call(inner):
+                    yield inner, f"blocking call {name}() while holding {held}"
+
+
+@register
+class NoBareExcept(Rule):
+    """A bare ``except:`` swallows KeyboardInterrupt/SystemExit and hides
+    sequencer thread death as silent data loss."""
+
+    rule_id = "KB103"
+    summary = "no bare except clauses"
+
+    def check(self, tree: ast.Module, src: str) -> Iterable[tuple[ast.AST, str]]:
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ExceptHandler) and node.type is None:
+                yield node, "bare except: name the exceptions (or use Exception)"
+
+
+_JIT_NAMES = {"jax.jit", "jit", "pjit", "jax.pjit"}
+
+
+def _is_jit_decorator(dec: ast.expr) -> bool:
+    if dotted_name(dec) in _JIT_NAMES:
+        return True
+    if isinstance(dec, ast.Call):
+        fname = dotted_name(dec.func)
+        if fname in _JIT_NAMES:
+            return True  # @jax.jit(static_argnums=...)
+        if fname in ("partial", "functools.partial") and dec.args:
+            return dotted_name(dec.args[0]) in _JIT_NAMES
+    return False
+
+
+@register
+class NoHostSyncInJit(Rule):
+    """``device_get``/``block_until_ready`` inside a jitted kernel breaks
+    tracing purity: it either fails under jit or silently forces a host
+    sync per dispatch, destroying the scan kernel's pipelining."""
+
+    rule_id = "KB104"
+    summary = "no jax.device_get / block_until_ready inside @jax.jit kernels (ops/)"
+
+    def applies(self, relpath: str) -> bool:
+        return relpath.replace("\\", "/").startswith("kubebrain_tpu/ops/")
+
+    def check(self, tree: ast.Module, src: str) -> Iterable[tuple[ast.AST, str]]:
+        for node in ast.walk(tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if not any(_is_jit_decorator(d) for d in node.decorator_list):
+                continue
+            for inner in walk_same_scope(node.body):
+                if not isinstance(inner, ast.Call):
+                    continue
+                name = dotted_name(inner.func)
+                if name in ("jax.device_get", "device_get"):
+                    yield inner, f"host sync {name}() inside jitted {node.name!r}"
+                elif terminal_name(inner.func) == "block_until_ready":
+                    yield inner, f"block_until_ready() inside jitted {node.name!r}"
+
+
+_REV_TOKENS = {"rev", "revision"}
+
+
+def _revision_like(expr: ast.expr) -> str | None:
+    """The dotted name of the first revision-carrying Name/Attribute inside
+    ``expr``, if any ('rev', 'guard_rev', 'request.revision', ...)."""
+    for node in ast.walk(expr):
+        name = terminal_name(node) if isinstance(node, (ast.Name, ast.Attribute)) else ""
+        if name and _REV_TOKENS & set(name.lower().split("_")):
+            return dotted_name(node) or name
+    return None
+
+
+@register
+class RevisionFlowsThroughHelpers(Rule):
+    """Revisions are opaque monotonic tokens minted by the sequencer; raw
+    arithmetic in the etcd surface invents revisions the backend never
+    issued. Transformations live in server/service/revision.py helpers."""
+
+    rule_id = "KB105"
+    summary = "revision arithmetic in server/etcd/ must use revision.py helpers"
+
+    def applies(self, relpath: str) -> bool:
+        return relpath.replace("\\", "/").startswith("kubebrain_tpu/server/etcd/")
+
+    def check(self, tree: ast.Module, src: str) -> Iterable[tuple[ast.AST, str]]:
+        arith = (ast.Add, ast.Sub, ast.Mult, ast.FloorDiv, ast.Div, ast.Mod)
+
+        def _is_text(n: ast.expr) -> bool:
+            # serializing a revision into a bytes/str frame is encoding,
+            # not revision arithmetic
+            if isinstance(n, ast.Constant) and isinstance(n.value, (str, bytes)):
+                return True
+            return isinstance(n, ast.BinOp) and (_is_text(n.left) or _is_text(n.right))
+
+        for node in ast.walk(tree):
+            if isinstance(node, ast.BinOp) and isinstance(node.op, arith):
+                if isinstance(node.op, ast.Add) and (_is_text(node.left) or _is_text(node.right)):
+                    continue
+                name = _revision_like(node.left) or _revision_like(node.right)
+                if name:
+                    yield node, (
+                        f"raw arithmetic on revision value {name!r}; use a "
+                        "server/service/revision.py helper"
+                    )
+            elif isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub):
+                name = _revision_like(node.operand)
+                if name:
+                    yield node, (
+                        f"raw negation of revision value {name!r}; use a "
+                        "server/service/revision.py helper"
+                    )
+            elif isinstance(node, ast.AugAssign) and isinstance(node.op, arith):
+                name = _revision_like(node.target)
+                if name:
+                    yield node, (
+                        f"raw in-place arithmetic on revision value {name!r}; "
+                        "use a server/service/revision.py helper"
+                    )
