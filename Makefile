@@ -3,8 +3,8 @@
 
 SHELL := /bin/bash  # test-tier1 needs pipefail
 
-.PHONY: all native test bench bench-all run clean protos lint typecheck \
-        check test-tier1
+.PHONY: all native test bench bench-all bench-smoke run clean protos lint \
+        typecheck check test-tier1
 
 all: native
 
@@ -51,6 +51,13 @@ bench-all: native
 	KB_BENCH_METRIC=fanout python bench.py
 	KB_BENCH_METRIC=compact python bench.py
 	KB_BENCH_METRIC=insert python bench.py
+
+# Scheduler microbench on a tiny dataset (CPU, no native build needed):
+# asserts scheduled == unscheduled byte-identically, reports coalescing
+# and shed counters. Fast enough for CI smoke.
+bench-smoke:
+	JAX_PLATFORMS=cpu KB_BENCH_METRIC=sched KB_BENCH_KEYS=2000 \
+	    KB_BENCH_OPS=200 python bench.py
 
 run: native
 	python -m kubebrain_tpu.cli --single-node --storage=tpu --inner-storage=native

@@ -918,6 +918,93 @@ def _bench_sim_wire() -> None:
     }))
 
 
+def bench_sched() -> None:
+    """Scheduler microbench (make bench-smoke): randomized Range workloads
+    over a real backend, scheduled (concurrent, coalesced, depth-bounded)
+    vs unscheduled sequential. On the CPU fallback the two paths must be
+    byte-identical per request — the scheduler is a throughput/fairness
+    layer, never a semantics layer. Small by default (KB_BENCH_KEYS=2000)
+    so it runs as a smoke check anywhere."""
+    import random
+    import threading
+
+    from kubebrain_tpu.backend import Backend, BackendConfig
+    from kubebrain_tpu.sched import SchedConfig, ensure_scheduler
+    from kubebrain_tpu.storage import new_storage
+
+    n_keys = int(os.environ.get("KB_BENCH_KEYS", 2_000))
+    n_req = int(os.environ.get("KB_BENCH_OPS", 200))
+    depth = int(os.environ.get("KB_SCHED_DEPTH", 4))
+    rng = random.Random(0)
+
+    store = new_storage("memkv")
+    backend = Backend(store, BackendConfig(event_ring_capacity=max(8192, n_keys * 2)))
+    sched = ensure_scheduler(backend, SchedConfig(depth=depth))
+    for i in range(n_keys):
+        backend.create(b"/registry/pods/ns-%02d/pod-%06d" % (i % 20, i), b"x" * 64)
+    rev = backend.current_revision()
+
+    workloads = []
+    for _ in range(n_req):
+        ns = rng.randrange(20)
+        workloads.append((
+            b"/registry/pods/ns-%02d/" % ns, b"/registry/pods/ns-%02d0" % ns,
+            rng.choice([0, rev]), rng.choice([0, 50]),
+        ))
+
+    def fingerprint(res):
+        out = [b"%d|%d|%d" % (res.revision, res.count, int(res.more))]
+        for kv in res.kvs:
+            out.append(kv.key + b"\x00" + kv.value + b"\x00%d" % kv.revision)
+        return b"\xff".join(out)
+
+    # unscheduled sequential baseline
+    t0 = time.time()
+    expect = [fingerprint(backend.list_(*w)) for w in workloads]
+    seq_dt = time.time() - t0
+
+    # scheduled, concurrent (8 client threads sharing the queue)
+    results: list = [None] * n_req
+    idx = iter(range(n_req))
+    lock = threading.Lock()
+
+    def worker():
+        while True:
+            with lock:
+                try:
+                    i = next(idx)
+                except StopIteration:
+                    return
+            results[i] = fingerprint(sched.list_(*workloads[i], client="w"))
+
+    threads = [threading.Thread(target=worker) for _ in range(8)]
+    t0 = time.time()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    sched_dt = time.time() - t0
+
+    mismatches = sum(1 for a, b in zip(results, expect) if a != b)
+    assert mismatches == 0, f"{mismatches}/{n_req} scheduled results diverged"
+    print(json.dumps({
+        "metric": "scheduled range reqs/sec",
+        "value": round(n_req / sched_dt),
+        "unit": "requests/sec",
+        "vs_baseline": round(seq_dt / sched_dt, 3),
+        "detail": {
+            "requests": n_req, "keys": n_keys, "depth": depth,
+            "byte_identical": True,
+            "coalesced": sched.coalesced,
+            "shed": {l.name.lower(): c for l, c in sched.shed_counts.items()},
+            "sequential_reqs_per_sec": round(n_req / seq_dt),
+            "baseline": "unscheduled sequential backend.list_",
+        },
+    }))
+    backend.close()
+    store.close()
+
+
 def bench_watcurve() -> None:
     """Scan QPS vs the ``wat`` (read-replica) mesh axis — SURVEY P6.
 
@@ -1066,6 +1153,8 @@ def main() -> None:
         return bench_sim()
     if metric == "rebuild":
         return bench_rebuild()
+    if metric == "sched":
+        return bench_sched()
     if metric == "watcurve":
         return bench_watcurve()
 
@@ -1224,6 +1313,34 @@ def main() -> None:
     print(f"[bench] device pipelined x{BURST}: {pipelined/1e6:.1f}M rows/s",
           file=sys.stderr)
 
+    # THE SERVING-PATH number: the same dispatches routed through the
+    # request scheduler (kubebrain_tpu/sched) at bounded depth — what a
+    # Range flood actually gets end to end. Each worker blocks on its own
+    # result, so up to `depth` kernels are in flight (the pipelined shape
+    # above), while admission, lanes, and coalescing stay on.
+    from kubebrain_tpu.sched import RequestScheduler, SchedConfig
+
+    depth = int(os.environ.get("KB_SCHED_DEPTH", 4))
+    n_req = max(16, 2 * depth)
+    sched = RequestScheduler(None, SchedConfig(depth=depth))
+    try:
+        def one_scan(i):
+            return lambda: jax.block_until_ready(
+                scan_count(d_args[0], d_args[1], d_args[2], d_args[3], nv,
+                           s_dev, e_dev, qhi, qlo))
+        # warm the scheduler threads once
+        sched.submit(one_scan(-1))
+        t0 = time.time()
+        reqs = [sched.submit_async(one_scan(i), client=f"c{i % 4}")
+                for i in range(n_req)]
+        for r in reqs:
+            r.wait(300.0)
+        scheduled = n * n_req / (time.time() - t0)
+    finally:
+        sched.close()
+    print(f"[bench] scheduled x{n_req} depth {depth}: "
+          f"{scheduled/1e6:.1f}M rows/s", file=sys.stderr)
+
     print(json.dumps({
         "metric": "range-scan keys/sec",
         "value": round(rate),
@@ -1234,6 +1351,9 @@ def main() -> None:
             "scan_p50_ms": round(p50 * 1e3, 2),
             "pipelined_rows_per_sec": round(pipelined),
             "pipelined_depth": BURST,
+            "scheduled_rows_per_sec": round(scheduled),
+            "scheduled_depth": depth,
+            "scheduled_vs_single_dispatch": round(scheduled / rate, 3),
             "cpu_numpy_rows_per_sec": round(cpu_rate),
             "device": str(dev),
             "kernel": "pallas" if use_pallas else "jnp",

@@ -708,6 +708,11 @@ class Backend:
         return read_rev
 
     def close(self) -> None:
+        # the request scheduler (sched.ensure_scheduler attaches it here)
+        # must unblock queued readers before the scan pipeline goes away
+        sched = getattr(self, "_kb_scheduler", None)
+        if sched is not None:
+            sched.close()
         with self._ring_cond:
             self._closed = True
             self._ring_cond.notify_all()

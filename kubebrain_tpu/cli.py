@@ -79,6 +79,16 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--secure-only", action="store_true",
                    help="with TLS configured, refuse plaintext clients "
                         "(reference endpoint secure modes, config.go:159)")
+    p.add_argument("--sched-depth", type=int, default=4,
+                   help="request scheduler: bounded in-flight device scan "
+                        "dispatches (pipelined; bench pipelined_rows_per_sec "
+                        "saturates by ~8)")
+    p.add_argument("--sched-shed-ms", type=float, default=5000.0,
+                   help="request scheduler: shed queued range reads older "
+                        "than this (etcd ResourceExhausted on the wire)")
+    p.add_argument("--sched-queue-limit", type=int, default=1024,
+                   help="request scheduler: per-lane queued-request bound; "
+                        "enqueue past it sheds immediately")
     p.add_argument("--grpc-workers", type=int, default=256,
                    help="gRPC worker threads; each open watch stream holds one")
     p.add_argument("--aio-port", type=int, default=0,
@@ -124,6 +134,10 @@ def validate_args(args) -> None:
             raise SystemExit(f"TLS file not found: {f}")
     if args.storage == "tpu" and args.inner_storage == "tpu":
         raise SystemExit("--inner-storage cannot be tpu")
+    if getattr(args, "sched_depth", 1) < 1 or getattr(args, "sched_queue_limit", 1) < 1:
+        raise SystemExit("--sched-depth and --sched-queue-limit must be >= 1")
+    if getattr(args, "sched_shed_ms", 1.0) <= 0:
+        raise SystemExit("--sched-shed-ms must be > 0")
     if args.data_dir and not (
         args.storage == "native" or (args.storage == "tpu" and args.inner_storage == "native")
     ):
@@ -190,6 +204,17 @@ def build_endpoint(args):
         enable_etcd_compatibility=not args.disable_etcd_compatibility,
         fanout_matcher=fanout,
     ))
+
+    # the device-aware request scheduler, created here (before any service
+    # constructs a KVService) so every surface shares the flag-configured
+    # instance with real metrics — later ensure_scheduler calls adopt it
+    from .sched import SchedConfig, ensure_scheduler
+
+    ensure_scheduler(backend, SchedConfig(
+        depth=args.sched_depth,
+        queue_limit=args.sched_queue_limit,
+        shed_ms=args.sched_shed_ms,
+    ), metrics=metrics)
 
     identity = args.identity or f"{get_host()}:{args.peer_port}"
     if args.single_node:

@@ -197,10 +197,18 @@ class EtcdCompatClient:
     # ---------------------------------------------------------------- watch
     def watch(
         self, key: bytes, range_end: bytes = b"", start_revision: int = 0,
-        prev_kv: bool = False,
+        prev_kv: bool = False, ready_timeout: float = 30.0,
     ):
         """Returns (events_iterator, cancel_fn). Events are (type, ClientKV,
-        prev ClientKV|None) tuples; the iterator ends on cancel."""
+        prev ClientKV|None) tuples; the iterator ends on cancel.
+
+        Blocks until the server acks registration (``created=True``):
+        without the ack, a write issued right after watch() returns races
+        the server-side ``watch_range`` registration — with start_revision
+        0 there is no replay, so the event is silently missed and the
+        caller waits forever (the intermittent test_client crud_watch
+        wedge). A watchdog dumps every thread's stack and cancels the
+        stream if the ack doesn't arrive within ``ready_timeout``."""
         requests: queue.Queue = queue.Queue()
         req = rpc_pb2.WatchRequest()
         req.create_request.key = key
@@ -211,9 +219,57 @@ class EtcdCompatClient:
         responses = self._watch(iter(requests.get, None))
         rpc_error = grpc.RpcError  # closure-bound: survives module teardown
 
+        ack_lock = threading.Lock()
+        acked = False
+        fired = False
+
+        def _ack_watchdog():
+            nonlocal fired
+            import faulthandler
+            import sys
+
+            with ack_lock:
+                if acked:
+                    return  # ack won the race with the timer firing
+                fired = True
+            sys.__stderr__.write(
+                f"[client.watch] no created ack within {ready_timeout}s; "
+                "dumping all thread stacks and cancelling the stream\n")
+            faulthandler.dump_traceback(file=sys.__stderr__)
+            sys.__stderr__.flush()
+            responses.cancel()
+
+        pending: list = []  # event-bearing responses seen before the ack
+        watchdog = threading.Timer(ready_timeout, _ack_watchdog)
+        watchdog.daemon = True
+        watchdog.start()
+        try:
+            for resp in responses:
+                with ack_lock:
+                    acked = True  # any server response proves liveness
+                if resp.events or resp.canceled:
+                    # events()/the caller must still see these
+                    pending.append(resp)
+                if resp.created or resp.canceled:
+                    break
+        except rpc_error as e:
+            raise TimeoutError(
+                "watch registration not acked by server "
+                f"(stream error: {e})") from e
+        finally:
+            watchdog.cancel()
+        with ack_lock:
+            if fired:
+                # the timer cancelled the stream just as the ack landed:
+                # the watch is dead, surface it instead of silently ending
+                raise TimeoutError(
+                    "watch stream cancelled by the registration watchdog")
+
+        import itertools
+
         def events():
             try:
-                for resp in responses:
+                for resp in itertools.chain(pending, responses):
                     if resp.canceled:
                         return
                     for ev in resp.events:

@@ -210,9 +210,11 @@ class AioWatchService:
             from ..server.service.revision import decode_list_revision
 
             revision = decode_list_revision(creq.start_revision)
+            from ..sched import ensure_scheduler
+
             try:
                 rev, stream = await loop.run_in_executor(
-                    None, self.backend.list_by_stream,
+                    None, ensure_scheduler(self.backend).list_by_stream,
                     bytes(creq.key), bytes(creq.range_end), revision,
                 )
             except (CompactedError, FutureRevisionError):

@@ -135,10 +135,16 @@ class Endpoint:
         bound = False
         if cfg.cert_file and cfg.key_file:
             creds = self._grpc_creds()
-            self._grpc.add_secure_port(f"{cfg.host}:{cfg.client_port}", creds)
+            if not self._grpc.add_secure_port(f"{cfg.host}:{cfg.client_port}", creds):
+                raise RuntimeError(
+                    f"failed to bind client port {cfg.host}:{cfg.client_port} (TLS)")
             bound = True
         if cfg.insecure or not bound:
-            self._grpc.add_insecure_port(f"{cfg.host}:{cfg.client_port}")
+            # add_*_port returns 0 on failure instead of raising; unchecked,
+            # the process keeps running and "serves" with no listener
+            if not self._grpc.add_insecure_port(f"{cfg.host}:{cfg.client_port}"):
+                raise RuntimeError(
+                    f"failed to bind client port {cfg.host}:{cfg.client_port}")
         self._grpc.start()
 
         routes = dict(self.server.http_handlers())

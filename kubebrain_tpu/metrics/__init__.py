@@ -25,6 +25,12 @@ class Metrics(abc.ABC):
         """(content_type, body_bytes) callable for the /metrics endpoint."""
         return lambda: ("text/plain", b"")
 
+    def register_gauge_fn(self, name: str, fn, **tags: str) -> None:
+        """Register a gauge sampled at scrape time (``fn() -> float``).
+        Backpressure state (queue depths, in-flight counts) is sampled, not
+        emitted per event — per-op emit_gauge on a hot path both costs and
+        under-reports between scrapes. Default: no-op."""
+
     def timed(self, name: str, **tags: str):
         """Context manager emitting a latency histogram + count."""
         return _Timer(self, name, tags)

@@ -25,12 +25,47 @@ _BUCKETS = (
 )
 
 
+class _CallbackGauges:
+    """Scrape-time gauges: callables sampled inside ``collect()`` so
+    backpressure state (scheduler queue depths, in-flight dispatches) is
+    always current on /metrics without per-event emission on hot paths."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        # pname -> (labelnames, [(labelvalues, fn), ...])
+        self._gauges: dict[str, tuple[tuple[str, ...], list]] = {}
+
+    def register(self, pname: str, labelnames: tuple[str, ...],
+                 labelvalues: tuple[str, ...], fn) -> None:
+        with self._lock:
+            entry = self._gauges.setdefault(pname, (labelnames, []))
+            entry[1].append((labelvalues, fn))
+
+    def collect(self):
+        from prometheus_client.core import GaugeMetricFamily
+
+        with self._lock:
+            snapshot = [
+                (pname, names, list(items))
+                for pname, (names, items) in self._gauges.items()
+            ]
+        for pname, names, items in snapshot:
+            g = GaugeMetricFamily(pname, pname, labels=list(names))
+            for values, fn in items:
+                try:
+                    g.add_metric(list(values), float(fn()))
+                except Exception:
+                    continue  # a dead provider must not break the scrape
+            yield g
+
+
 class PrometheusMetrics(Metrics):
     def __init__(self, cluster: str = ""):
         self.registry = CollectorRegistry()
         self._cluster = cluster
         self._lock = threading.Lock()
         self._vecs: dict[tuple[str, str], object] = {}
+        self._callbacks: _CallbackGauges | None = None
 
     def _vec(self, kind: str, name: str, tags: dict):
         pname = name.replace(".", "_").replace("-", "_")
@@ -55,6 +90,18 @@ class PrometheusMetrics(Metrics):
 
     def emit_histogram(self, name, value, **tags):
         self._vec("histogram", name, tags).observe(value)
+
+    def register_gauge_fn(self, name, fn, **tags):
+        pname = name.replace(".", "_").replace("-", "_")
+        if self._cluster:
+            tags = {**tags, "cluster": self._cluster}
+        names = tuple(sorted(tags))
+        values = tuple(str(tags[k]) for k in names)
+        with self._lock:
+            if self._callbacks is None:
+                self._callbacks = _CallbackGauges()
+                self.registry.register(self._callbacks)
+        self._callbacks.register(pname, names, values, fn)
 
     def http_handler(self):
         def handler():

@@ -1,0 +1,56 @@
+"""Priority lanes + request classification.
+
+Reference analogue: kube-apiserver API Priority and Fairness (APF) — a
+small fixed set of priority levels with fair queuing per flow inside each
+level. Three lanes are enough for the traffic kube-apiserver actually
+sends a metadata store:
+
+- ``SYSTEM``: reads that gate control-plane liveness — leader-election
+  leases, masterleases, and the compactor's coordination key. Starving
+  these flaps leadership cluster-wide, so they always dispatch first.
+- ``NORMAL``: everything else — paged LISTs, Counts, point-range gets.
+- ``BACKGROUND``: unpaged full-range LISTs (informer relist storms,
+  Snapshot dumps). These move the most bytes per request and are the
+  first to shed under pressure.
+"""
+
+from __future__ import annotations
+
+import enum
+
+
+class Lane(enum.IntEnum):
+    """Dispatch priority; lower value pops first."""
+
+    SYSTEM = 0
+    NORMAL = 1
+    BACKGROUND = 2
+
+
+#: key prefixes whose reads gate control-plane liveness (leader election
+#: leases + the apiserver compactor's coordination key)
+SYSTEM_PREFIXES: tuple[bytes, ...] = (
+    b"/registry/leases/",
+    b"/registry/masterleases/",
+    b"/registry/services/endpoints/kube-system/",  # pre-Lease leader election
+    b"compact_rev_key",
+)
+
+
+def classify(start: bytes, end: bytes = b"", limit: int = 0,
+             count_only: bool = False) -> Lane:
+    """Lane for a range read over [start, end). etcd single-key reads never
+    reach the scheduler (they use the point-read path), so by the time a
+    request is classified ``end == b""`` means *unbounded above* — backend
+    range semantics — not "single key". An unbounded unpaged list (e.g. the
+    Snapshot dump's ``list_by_stream(b"", b"")``) is the heaviest background
+    shape there is."""
+    for p in SYSTEM_PREFIXES:
+        if start.startswith(p):
+            return Lane.SYSTEM
+    if count_only:
+        return Lane.NORMAL
+    if limit == 0:
+        # unpaged LIST (bounded range or whole keyspace): relist/snapshot
+        return Lane.BACKGROUND
+    return Lane.NORMAL

@@ -1,0 +1,434 @@
+"""The device-aware request scheduler.
+
+Queue model (docs/scheduler.md):
+
+- every range read (list / count / list_wire / list_by_stream) becomes a
+  ``_Request`` in one of three priority lanes (lanes.py), with per-client
+  FIFO sub-queues served round-robin inside a lane — one chatty client
+  cannot monopolize its lane;
+- ONE dispatcher thread pops strictly by lane priority and hands requests
+  to a worker pool whose in-flight count is bounded by ``depth``. Workers
+  block on their own result, so up to ``depth`` device dispatches are in
+  flight at once — the async-dispatch pipelining the bench proves out
+  (bench.py pipelined_rows_per_sec), with host-side overlay/materialize
+  work overlapping device compute for neighbors;
+- identical queued requests coalesce: followers attach to the queued
+  leader and share its one execution. This is revision-safe for rev-0
+  reads because the leader resolves its read revision at *execution*
+  start, which is later than every follower's enqueue — so each follower
+  sees everything it wrote before asking (read-your-writes holds);
+  explicit-revision requests additionally join an already-executing
+  leader, whose result is deterministic;
+- overload: each lane queue is bounded (``queue_limit``; enqueue sheds
+  immediately when full) and every request carries an age deadline
+  (``shed_ms``; stale requests shed at pop). Shed requests surface as
+  ``SchedOverloadError`` which the etcd surface maps to the
+  ``ResourceExhausted`` wire status kube-apiserver already retries on.
+
+The scheduler is engine-agnostic: it schedules *backend* entry points, so
+the same admission path runs over the TPU mirror scanner and the generic
+iterator scanner (the CPU fallback exercised by tier-1).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+
+from .lanes import Lane, classify
+
+#: wire message kube-apiserver's etcd3 client recognizes and retries on
+ERR_TOO_MANY_REQUESTS = "etcdserver: too many requests"
+
+
+class SchedOverloadError(Exception):
+    """Request shed by admission control (queue full or deadline passed)."""
+
+    def __init__(self, lane: Lane, reason: str):
+        super().__init__(f"{ERR_TOO_MANY_REQUESTS} (lane={lane.name.lower()}, {reason})")
+        self.lane = lane
+        self.reason = reason
+
+
+class SchedClosedError(Exception):
+    """Scheduler shut down while the request was queued."""
+
+
+def client_of(context) -> str:
+    """Fair-queuing flow id for a gRPC(-ish) context: the transport peer
+    when the context has one (python-grpc), else anonymous (native-front
+    backhaul contexts have no peer()). Shared by every service surface so
+    flow ids cannot drift between protocols."""
+    peer = getattr(context, "peer", None)
+    try:
+        return peer() if callable(peer) else ""
+    except Exception:
+        return ""
+
+
+@dataclass
+class SchedConfig:
+    depth: int = 4           # bounded in-flight device dispatches
+    queue_limit: int = 1024  # per-lane queued-request bound
+    shed_ms: float = 5000.0  # max queue age before a request is shed
+    workers: int = 0         # worker threads; 0 = same as depth
+
+
+class _Request:
+    __slots__ = ("fn", "lane", "client", "key", "deterministic", "enqueued",
+                 "done", "result", "error", "followers")
+
+    def __init__(self, fn, lane: Lane, client: str, key, deterministic=False):
+        self.fn = fn
+        self.lane = lane
+        self.client = client
+        self.key = key
+        self.deterministic = deterministic
+        self.enqueued = time.monotonic()
+        self.done = threading.Event()
+        self.result = None
+        self.error: BaseException | None = None
+        self.followers: list["_Request"] = []
+
+    # ---- completion (leader result fans out to coalesced followers)
+    def finish(self, result=None, error: BaseException | None = None) -> None:
+        self.result = result
+        self.error = error
+        self.done.set()
+        for f in self.followers:
+            f.result = result
+            f.error = error
+            f.done.set()
+
+    def wait(self, timeout: float) -> object:
+        if not self.done.wait(timeout):
+            raise SchedOverloadError(self.lane, "result wait timed out")
+        if self.error is not None:
+            raise self.error
+        return self.result
+
+
+class _LaneQueue:
+    """Per-client FIFOs + round-robin service order, O(1) ops.
+
+    Invariant: a client appears in ``order`` exactly once while (and only
+    while) it has a non-empty deque in ``clients`` — push creates both
+    together, pop removes both together when the deque drains, or re-queues
+    the client at the back of the service order otherwise. Anything looser
+    accumulates stale ``order`` entries across drain/refill cycles, which
+    both leaks and skews the round-robin toward long-lived clients."""
+
+    __slots__ = ("clients", "order", "size")
+
+    def __init__(self):
+        self.clients: dict[str, deque] = {}
+        self.order: deque[str] = deque()
+        self.size = 0
+
+    def push(self, req: _Request) -> None:
+        q = self.clients.get(req.client)
+        if q is None:
+            q = self.clients[req.client] = deque()
+            self.order.append(req.client)
+        q.append(req)
+        self.size += 1
+
+    def pop(self) -> _Request | None:
+        while self.order:
+            client = self.order.popleft()
+            q = self.clients.get(client)
+            if not q:  # defensive; unreachable while the invariant holds
+                self.clients.pop(client, None)
+                continue
+            req = q.popleft()
+            self.size -= 1
+            if q:
+                self.order.append(client)  # back of the service order
+            else:
+                del self.clients[client]
+            return req
+        return None
+
+
+class RequestScheduler:
+    """Admission + coalescing + bounded-depth pipelined dispatch.
+
+    ``backend`` may be None for generic use (``submit``/``submit_async``
+    only, e.g. the bench microharness).
+    """
+
+    def __init__(self, backend=None, config: SchedConfig | None = None,
+                 metrics=None):
+        self.backend = backend
+        self.config = config or SchedConfig()
+        self.metrics = metrics
+        self._cv = threading.Condition()
+        self._queues = {lane: _LaneQueue() for lane in Lane}
+        self._pending: dict[object, _Request] = {}   # queued, by coalesce key
+        self._inflight: dict[object, _Request] = {}  # executing, by key
+        self._inflight_count = 0
+        self._sem = threading.BoundedSemaphore(max(1, self.config.depth))
+        self._closed = False
+        self._started = False
+        self._dispatcher: threading.Thread | None = None
+        self._workers: list[threading.Thread] = []
+        self._runq: deque[_Request] = deque()
+        self._run_cv = threading.Condition()
+        self.shed_counts = {lane: 0 for lane in Lane}
+        self.coalesced = 0
+        self.dispatched = 0
+        if metrics is not None:
+            for lane in Lane:
+                metrics.register_gauge_fn(
+                    "kb.sched.queue.depth",
+                    (lambda l=lane: self._queues[l].size), lane=lane.name.lower(),
+                )
+            metrics.register_gauge_fn(
+                "kb.sched.inflight", lambda: self._inflight_count)
+
+    # ------------------------------------------------------------- lifecycle
+    def _ensure_started(self) -> None:
+        if self._started:
+            return
+        with self._cv:
+            if self._started or self._closed:
+                return
+            from ..util.env import crash_guard
+
+            self._dispatcher = threading.Thread(
+                target=crash_guard(self._dispatch_loop), name="kb-sched",
+                daemon=True,
+            )
+            n = self.config.workers or max(1, self.config.depth)
+            self._workers = [
+                threading.Thread(target=self._work_loop,
+                                 name=f"kb-sched-w{i}", daemon=True)
+                for i in range(n)
+            ]
+            self._started = True
+            self._dispatcher.start()
+            for w in self._workers:
+                w.start()
+
+    def close(self) -> None:
+        with self._cv:
+            if self._closed:
+                return
+            self._closed = True
+            dangling: list[_Request] = []
+            for lq in self._queues.values():
+                while True:
+                    r = lq.pop()
+                    if r is None:
+                        break
+                    dangling.append(r)
+            self._pending.clear()
+            self._cv.notify_all()
+        with self._run_cv:
+            self._run_cv.notify_all()
+        for r in dangling:
+            r.finish(error=SchedClosedError("scheduler closed"))
+        if self._dispatcher is not None:
+            self._dispatcher.join(timeout=2.0)
+        for w in self._workers:
+            w.join(timeout=2.0)
+        # final sweep: anything the dispatcher managed to hand off after
+        # the workers exited must still be completed, not strand a caller
+        with self._run_cv:
+            leftovers = list(self._runq)
+            self._runq.clear()
+        for r in leftovers:
+            r.finish(error=SchedClosedError("scheduler closed"))
+
+    # -------------------------------------------------------------- enqueue
+    def submit_async(self, fn, lane: Lane = Lane.NORMAL, client: str = "",
+                     key=None, deterministic: bool = False) -> _Request:
+        """Enqueue ``fn`` and return the waitable request (``.wait(t)``).
+        Raises SchedOverloadError immediately when the lane queue is full.
+        ``deterministic`` marks a request whose result is a pure function
+        of its key (explicit read revision): it may additionally join an
+        already-executing leader."""
+        self._ensure_started()
+        req = _Request(fn, lane, client, key, deterministic)
+        with self._cv:
+            if self._closed:
+                raise SchedClosedError("scheduler closed")
+            if key is not None:
+                leader = self._pending.get(key)
+                if leader is not None:
+                    leader.followers.append(req)
+                    self.coalesced += 1
+                    self._emit_counter("kb.sched.coalesced.total", lane)
+                    return req
+                if req.deterministic:
+                    running = self._inflight.get(key)
+                    if running is not None:
+                        running.followers.append(req)
+                        self.coalesced += 1
+                        self._emit_counter("kb.sched.coalesced.total", lane)
+                        return req
+            lq = self._queues[lane]
+            if lq.size >= self.config.queue_limit:
+                self.shed_counts[lane] += 1
+                self._emit_counter("kb.sched.shed.total", lane, reason="queue_full")
+                raise SchedOverloadError(lane, "queue full")
+            lq.push(req)
+            if key is not None:
+                self._pending[key] = req
+            self._cv.notify()
+        return req
+
+    def submit(self, fn, lane: Lane = Lane.NORMAL, client: str = "", key=None,
+               deterministic: bool = False):
+        """Blocking submit: schedule ``fn`` and return its result."""
+        req = self.submit_async(fn, lane, client, key, deterministic)
+        timeout = self.config.shed_ms / 1000.0 * 4 + 60.0
+        res = req.wait(timeout)
+        if self.metrics is not None:
+            self.metrics.emit_histogram(
+                "kb.sched.wait.seconds", time.monotonic() - req.enqueued,
+                lane=lane.name.lower(),
+            )
+        return res
+
+    # ----------------------------------------------- backend range entries
+    # (the only scan path the service layer may use; kblint KB106)
+    def list_(self, start: bytes, end: bytes, revision: int = 0,
+              limit: int = 0, client: str = ""):
+        lane = classify(start, end, limit)
+        key = ("list", start, end, revision, limit)
+        return self.submit(
+            lambda: self.backend.list_(start, end, revision, limit),
+            lane, client, key, deterministic=revision != 0,
+        )
+
+    def count(self, start: bytes, end: bytes, revision: int = 0,
+              client: str = ""):
+        lane = classify(start, end, count_only=True)
+        key = ("count", start, end, revision)
+        return self.submit(
+            lambda: self.backend.count(start, end, revision), lane, client,
+            key, deterministic=revision != 0,
+        )
+
+    def list_wire(self, start: bytes, end: bytes, revision: int = 0,
+                  limit: int = 0, client: str = ""):
+        if getattr(self.backend.scanner, "list_wire", None) is None:
+            return None  # engine has no wire encoder; skip the queue round
+        lane = classify(start, end, limit)
+        key = ("wire", start, end, revision, limit)
+        return self.submit(
+            lambda: self.backend.list_wire(start, end, revision, limit),
+            lane, client, key, deterministic=revision != 0,
+        )
+
+    def list_by_stream(self, start: bytes, end: bytes, revision: int = 0,
+                       client: str = ""):
+        """Admission + initial dispatch for a streamed list. The returned
+        iterator is consumed on the caller's thread (a stream can outlive
+        any sane queue deadline); coalescing is disabled — iterators are
+        single-consumer."""
+        lane = classify(start, end, limit=0)
+        return self.submit(
+            lambda: self.backend.list_by_stream(start, end, revision),
+            lane, client, key=None,
+        )
+
+    # ------------------------------------------------------------- dispatch
+    def _dispatch_loop(self) -> None:
+        while True:
+            req = self._next_request()
+            if req is None:
+                return
+            # bound in-flight depth: block until a dispatch slot frees
+            self._sem.acquire()
+            if self._closed:
+                # workers may already have exited: never strand the popped
+                # request in _runq where nothing will finish it
+                self._sem.release()
+                req.finish(error=SchedClosedError("scheduler closed"))
+                return
+            if self._shed_if_stale(req):
+                self._sem.release()
+                continue
+            with self._cv:
+                if req.key is not None:
+                    self._inflight[req.key] = req
+                self._inflight_count += 1
+            self.dispatched += 1
+            with self._run_cv:
+                self._runq.append(req)
+                self._run_cv.notify()
+
+    def _next_request(self) -> _Request | None:
+        with self._cv:
+            while True:
+                if self._closed:
+                    return None
+                for lane in Lane:  # strict priority order
+                    req = self._queues[lane].pop()
+                    if req is not None:
+                        if req.key is not None and \
+                                self._pending.get(req.key) is req:
+                            del self._pending[req.key]
+                        return req
+                self._cv.wait(timeout=0.2)
+
+    def _shed_if_stale(self, req: _Request) -> bool:
+        age_ms = (time.monotonic() - req.enqueued) * 1000.0
+        if age_ms <= self.config.shed_ms:
+            return False
+        with self._cv:
+            self.shed_counts[req.lane] += 1 + len(req.followers)
+        self._emit_counter("kb.sched.shed.total", req.lane, reason="deadline")
+        req.finish(error=SchedOverloadError(req.lane, f"queued {age_ms:.0f}ms"))
+        return True
+
+    def _work_loop(self) -> None:
+        while True:
+            with self._run_cv:
+                while not self._runq:
+                    if self._closed:
+                        return
+                    self._run_cv.wait(timeout=0.2)
+                req = self._runq.popleft()
+            try:
+                result = req.fn()
+                err = None
+            except BaseException as e:  # surfaced to the waiting caller
+                result, err = None, e
+            finally:
+                self._sem.release()
+                with self._cv:
+                    if req.key is not None and \
+                            self._inflight.get(req.key) is req:
+                        del self._inflight[req.key]
+                    self._inflight_count -= 1
+            req.finish(result=result, error=err)
+
+    # -------------------------------------------------------------- metrics
+    def _emit_counter(self, name: str, lane: Lane, **tags) -> None:
+        if self.metrics is not None:
+            self.metrics.emit_counter(name, 1, lane=lane.name.lower(), **tags)
+
+
+_ENSURE_LOCK = threading.Lock()
+
+
+def ensure_scheduler(backend, config: SchedConfig | None = None,
+                     metrics=None) -> RequestScheduler:
+    """The process-wide scheduler for ``backend``: every service surface
+    (sync etcd, aio, native front, brain) must share one admission queue or
+    lanes mean nothing. First caller wins; cli.build_endpoint calls this
+    early with the flag-derived config + real metrics."""
+    sched = getattr(backend, "_kb_scheduler", None)
+    if sched is not None:
+        return sched
+    with _ENSURE_LOCK:
+        sched = getattr(backend, "_kb_scheduler", None)
+        if sched is None:
+            sched = RequestScheduler(backend, config, metrics)
+            backend._kb_scheduler = sched
+    return sched

@@ -23,6 +23,7 @@ from ...backend import (
     FutureRevisionError,
     KeyExistsError,
 )
+from ...sched import SchedOverloadError, client_of
 from ...storage.errors import KeyNotFoundError
 from ...proto import brain_pb2
 from ..etcd.server import _bidi, _unary
@@ -88,12 +89,22 @@ class BrainServer:
             header_revision=self.backend.current_revision(),
         )
 
+    def _sched(self):
+        """Range reads share the etcd surface's admission scheduler: both
+        protocols drain one device pipeline, so they must share one queue."""
+        from ...sched import ensure_scheduler
+
+        return ensure_scheduler(self.backend)
+
     def Range(self, request, context) -> brain_pb2.BrainRangeResponse:
         self._sync_read()
         try:
-            res = self.backend.list_(
-                request.start, request.end, request.revision, int(request.limit)
+            res = self._sched().list_(
+                request.start, request.end, request.revision, int(request.limit),
+                client=self._client_of(context),
             )
+        except SchedOverloadError as e:
+            context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, str(e))
         except (CompactedError, FutureRevisionError) as e:
             context.abort(grpc.StatusCode.OUT_OF_RANGE, str(e))
         resp = brain_pb2.BrainRangeResponse(more=res.more, header_revision=res.revision)
@@ -103,9 +114,13 @@ class BrainServer:
 
     def RangeStream(self, request, context):
         self._sync_read()
-        rev, stream = self.backend.list_by_stream(
-            request.start, request.end, request.revision
-        )
+        try:
+            rev, stream = self._sched().list_by_stream(
+                request.start, request.end, request.revision,
+                client=self._client_of(context),
+            )
+        except SchedOverloadError as e:
+            context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, str(e))
         for batch in stream:
             resp = brain_pb2.BrainRangeResponse(header_revision=rev)
             for kv in batch:
@@ -114,8 +129,15 @@ class BrainServer:
 
     def Count(self, request, context) -> brain_pb2.CountResponse:
         self._sync_read()
-        n, rev = self.backend.count(request.start, request.end)
+        try:
+            n, rev = self._sched().count(
+                request.start, request.end, client=self._client_of(context)
+            )
+        except SchedOverloadError as e:
+            context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, str(e))
         return brain_pb2.CountResponse(count=n, header_revision=rev)
+
+    _client_of = staticmethod(client_of)  # fair-queuing flow id (sched)
 
     def ListPartition(self, request, context) -> brain_pb2.ListPartitionResponse:
         self._sync_read()

@@ -30,6 +30,7 @@ from ...backend import (
     FutureRevisionError,
     KeyExistsError,
 )
+from ...sched import SchedOverloadError, client_of, ensure_scheduler
 from ...storage.errors import KeyNotFoundError
 from ...proto import rpc_pb2
 from . import shim
@@ -53,7 +54,12 @@ class KVService:
     def __init__(self, backend: Backend, peers=None, limiter=None):
         self.backend = backend
         self.peers = peers  # PeerService: leader check / proxy / revision sync
-        self.limiter = limiter
+        # the device-aware request scheduler: every range read goes through
+        # its admission lanes (kblint KB106). All services over one backend
+        # share one scheduler, or priority lanes mean nothing.
+        self.limiter = limiter if limiter is not None else ensure_scheduler(backend)
+
+    _client_of = staticmethod(client_of)  # fair-queuing flow id (sched)
 
     # ------------------------------------------------------------------ Range
     def Range(self, request: rpc_pb2.RangeRequest, context) -> rpc_pb2.RangeResponse:
@@ -83,13 +89,20 @@ class KVService:
                     except KeyNotFoundError:
                         n, rev = 0, self.backend.current_revision()
                 else:
-                    n, rev = self.backend.count(request.key, range_end, request.revision)
+                    n, rev = self.limiter.count(
+                        request.key, range_end, request.revision,
+                        client=self._client_of(context),
+                    )
                 return rpc_pb2.RangeResponse(header=shim.header(rev), count=n)
             if request.revision == PARTITION_MAGIC_REVISION:
                 return self._partitions(request)
             if single_key:
                 return self._get(request)
-            return self._list(request, range_end, raw_ok)
+            return self._list(request, range_end, raw_ok, self._client_of(context))
+        except SchedOverloadError as e:
+            # admission control shed this request: the etcd error
+            # kube-apiserver's client retries with backoff
+            context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, str(e))
         except CompactedError:
             context.abort(grpc.StatusCode.OUT_OF_RANGE, ERR_COMPACTED)
         except FutureRevisionError:
@@ -123,7 +136,8 @@ class KVService:
             for kv in resp.kvs:
                 kv.version = kv.mod_revision
 
-    def _list(self, request, range_end: bytes, raw_ok: bool = False) -> rpc_pb2.RangeResponse:
+    def _list(self, request, range_end: bytes, raw_ok: bool = False,
+              client: str = "") -> rpc_pb2.RangeResponse:
         # raw fast path: the C engine encodes RangeResponse.kvs wire bytes
         # directly (kb_mvcc_list_wire) and the native frontend forwards them
         # without reserialization — no per-row Python anywhere on the list
@@ -133,8 +147,9 @@ class KVService:
                 and request.sort_order == rpc_pb2.RangeRequest.NONE
                 and not request.keys_only
                 and request.key != COMPACT_REV_KEY):
-            fast = self.backend.list_wire(
-                request.key, range_end, request.revision, int(request.limit)
+            fast = self.limiter.list_wire(
+                request.key, range_end, request.revision, int(request.limit),
+                client=client,
             )
             if fast is not None:
                 blob, n, more, read_rev = fast
@@ -142,8 +157,9 @@ class KVService:
                     header=shim.header(read_rev), more=more, count=n
                 ).SerializeToString()
                 return _RawResponse(scalar + blob)
-        res = self.backend.list_(
-            request.key, range_end, request.revision, int(request.limit)
+        res = self.limiter.list_(
+            request.key, range_end, request.revision, int(request.limit),
+            client=client,
         )
         resp = rpc_pb2.RangeResponse(
             header=shim.header(res.revision), more=res.more, count=len(res.kvs)

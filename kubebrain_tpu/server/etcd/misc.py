@@ -91,7 +91,10 @@ class MaintenanceService:
         engine-portable (restorable into any engine by replaying creates),
         streamed batch-by-batch so the keyspace never materializes in full
         (backend.list_by_stream)."""
-        rev, stream = self.backend.list_by_stream(b"", b"")
+        from ...sched import ensure_scheduler
+
+        # background lane: a snapshot dump must queue behind serving reads
+        rev, stream = ensure_scheduler(self.backend).list_by_stream(b"", b"")
         pending = b"KBSNAP1" + rev.to_bytes(8, "big")
         for batch in stream:
             frames = [pending]

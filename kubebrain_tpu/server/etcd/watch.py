@@ -220,10 +220,25 @@ class _WatchSession:
         from ..service.revision import decode_list_revision
 
         revision = decode_list_revision(creq.start_revision)
+        from ...sched import SchedOverloadError, ensure_scheduler
+
         try:
-            rev, stream = self.backend.list_by_stream(
+            rev, stream = ensure_scheduler(self.backend).list_by_stream(
                 bytes(creq.key), bytes(creq.range_end), revision
             )
+        except SchedOverloadError as e:
+            # shed by admission control: cancel without a compact marker so
+            # the client retries the same revision instead of re-listing
+            self._send(
+                rpc_pb2.WatchResponse(
+                    header=shim.header(self.backend.current_revision()),
+                    watch_id=watch_id,
+                    created=True,
+                    canceled=True,
+                    cancel_reason=str(e),
+                )
+            )
+            return
         except (CompactedError, FutureRevisionError) as e:
             self._send(
                 rpc_pb2.WatchResponse(

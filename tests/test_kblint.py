@@ -171,6 +171,52 @@ def test_kb105_ignores_non_revision_arithmetic():
     assert ids("def f(prev):\n    return prev + 1\n", SRV_ETCD) == []
 
 
+# ------------------------------------------------------------------- KB106
+def test_kb106_flags_direct_backend_scan_calls():
+    for entry in ("list_", "count", "list_wire", "list_by_stream"):
+        src = f"def f(self, s, e):\n    return self.backend.{entry}(s, e)\n"
+        assert ids(src, SRV_ETCD) == ["KB106"], entry
+        assert ids(src, EP) == ["KB106"], entry
+
+
+def test_kb106_flags_direct_scanner_calls():
+    src = "def f(self, s, e):\n    return self.backend.scanner.range_(s, e, 0)\n"
+    assert ids(src, SRV_ETCD) == ["KB106"]
+
+
+def test_kb106_allows_scheduler_and_non_scan_calls():
+    clean = (
+        "def f(self, s, e):\n"
+        "    kv = self.backend.get(s)\n"
+        "    rev = self.backend.current_revision()\n"
+        "    parts = self.backend.get_partitions(s, e)\n"
+        "    return self.limiter.list_(s, e)\n"
+    )
+    assert ids(clean, SRV_ETCD) == []
+    via_ensure = (
+        "from kubebrain_tpu.sched import ensure_scheduler\n"
+        "def f(self, s, e):\n"
+        "    return ensure_scheduler(self.backend).list_by_stream(s, e)\n"
+    )
+    assert ids(via_ensure, EP) == []
+
+
+def test_kb106_scoped_to_service_layer():
+    # the scheduler itself and the backend core ARE the scan path
+    src = "def f(self, s, e):\n    return self.backend.list_(s, e)\n"
+    assert ids(src, ANY) == []
+    assert ids(src, "kubebrain_tpu/sched/scheduler.py") == []
+    assert ids(src, "kubebrain_tpu/server/brain/server.py") == []
+
+
+def test_kb106_suppressible():
+    src = (
+        "def f(self, s, e):\n"
+        "    return self.backend.list_(s, e)  # kblint: disable=KB106 -- test\n"
+    )
+    assert ids(src, SRV_ETCD) == []
+
+
 # ------------------------------------------------------------- suppressions
 def test_suppression_on_flagged_line():
     src = "import time\nasync def f():\n    time.sleep(1)  # kblint: disable=KB101 -- test\n"
@@ -235,7 +281,7 @@ def test_trailing_code_pragma_does_not_leak_to_next_line():
 
 # ------------------------------------------------------------ registry/CLI
 def test_registry_has_all_rules():
-    assert set(RULES) == {"KB101", "KB102", "KB103", "KB104", "KB105"}
+    assert set(RULES) == {"KB101", "KB102", "KB103", "KB104", "KB105", "KB106"}
     for rule in RULES.values():
         assert rule.summary
 

@@ -822,15 +822,27 @@ def test_tier_auto_failover_watchdog(tmp_path):
     try:
         from kubebrain_tpu.client import EtcdCompatClient
 
-        c = EtcdCompatClient(f"127.0.0.1:{cport}")
+        # Boot probe with a FRESH channel per attempt. A channel created
+        # before the server binds eats repeated connection-refused results
+        # during the ~5-30s jax-import startup on this 2-vCPU box, and
+        # grpc's subchannel reconnect backoff (1s x1.6 up to 120s) then
+        # keeps the channel in TRANSIENT_FAILURE long after the server is
+        # up — reproduced: the "poisoned" early channel fails for 35s+
+        # while a fresh channel to the same port connects instantly. One
+        # shared channel here is what made this test fail its whole 60s
+        # boot budget ("server never served").
+        c = None
         deadline = time.time() + 60
         while time.time() < deadline:
+            if c is not None:
+                c.close()
+            c = EtcdCompatClient(f"127.0.0.1:{cport}")
             try:
                 ok, _ = c.create(b"/af/boot", b"1")
                 assert ok
                 break
             except Exception:
-                time.sleep(0.3)
+                time.sleep(0.5)
         else:
             raise AssertionError("server never served")
         # make sure the replica is attached before trusting the guard

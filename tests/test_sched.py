@@ -1,0 +1,411 @@
+"""Scheduler semantics (ISSUE 2 acceptance):
+
+- coalescing preserves per-request ``read_revision`` visibility — results
+  byte-identical to the unscheduled path (CPU fallback over the generic
+  scanner, no TPU required);
+- priority inversion does not occur under a saturated low-priority flood
+  (high-priority p99 stays bounded at 10x queue oversubscription);
+- shed requests carry the etcd ``ResourceExhausted`` wire error, and the
+  shed/queue-depth counters are visible on /metrics.
+"""
+
+import queue
+import threading
+import time
+import urllib.request
+
+import grpc
+import pytest
+
+from kubebrain_tpu.backend import Backend, BackendConfig
+from kubebrain_tpu.sched import (
+    Lane,
+    RequestScheduler,
+    SchedConfig,
+    SchedOverloadError,
+    classify,
+    ensure_scheduler,
+)
+from kubebrain_tpu.storage import new_storage
+
+from test_etcd_server import EtcdClient, free_port
+
+
+# ---------------------------------------------------------------- lanes
+def test_classify_lanes():
+    assert classify(b"/registry/leases/kube-system/x", b"", 0) is Lane.SYSTEM
+    assert classify(b"/registry/masterleases/1.2.3.4", b"", 0) is Lane.SYSTEM
+    assert classify(b"/registry/pods/", b"/registry/pods0", 500) is Lane.NORMAL
+    assert classify(b"/registry/pods/", b"/registry/pods0", 0) is Lane.BACKGROUND
+    assert classify(b"/registry/pods/", b"/registry/pods0",
+                    count_only=True) is Lane.NORMAL
+    # empty end at the scheduler means UNBOUNDED (single-key reads never
+    # reach it): the Snapshot whole-keyspace dump is background traffic
+    assert classify(b"", b"", 0) is Lane.BACKGROUND
+    assert classify(b"/registry/pods/a", b"", limit=10) is Lane.NORMAL
+
+
+# --------------------------------------------------- generic submit layer
+def test_submit_runs_and_returns():
+    s = RequestScheduler(None, SchedConfig(depth=2))
+    try:
+        assert s.submit(lambda: 41 + 1) == 42
+    finally:
+        s.close()
+
+
+def test_submit_propagates_exceptions():
+    s = RequestScheduler(None, SchedConfig(depth=2))
+    try:
+        with pytest.raises(ValueError):
+            s.submit(lambda: (_ for _ in ()).throw(ValueError("boom")))
+    finally:
+        s.close()
+
+
+def test_queue_full_sheds_immediately():
+    s = RequestScheduler(None, SchedConfig(depth=1, queue_limit=2))
+    release = threading.Event()
+    try:
+        s.submit_async(release.wait, Lane.NORMAL)  # occupies the one slot
+        time.sleep(0.1)  # let the dispatcher move it to a worker
+        # the dispatcher can hold at most one popped request in hand, so
+        # by the 4th filler the 2-slot queue must overflow
+        sheds = 0
+        for _ in range(4):
+            try:
+                s.submit_async(lambda: None, Lane.NORMAL)
+            except SchedOverloadError:
+                sheds += 1
+        assert sheds >= 1
+        assert s.shed_counts[Lane.NORMAL] == sheds
+    finally:
+        release.set()
+        s.close()
+
+
+def test_deadline_shed_on_stale_requests():
+    s = RequestScheduler(None, SchedConfig(depth=1, shed_ms=50.0))
+    release = threading.Event()
+    try:
+        s.submit_async(release.wait, Lane.NORMAL)
+        time.sleep(0.1)
+        stale = s.submit_async(lambda: "ran", Lane.NORMAL)
+        time.sleep(0.2)  # let it age past shed_ms while the slot is held
+        release.set()
+        with pytest.raises(SchedOverloadError):
+            stale.wait(5.0)
+        assert s.shed_counts[Lane.NORMAL] >= 1
+    finally:
+        release.set()
+        s.close()
+
+
+def test_lane_queue_round_robin_fair_after_drain_cycles():
+    """Regression: drain/refill cycles must not accumulate stale service-
+    order entries that skew round-robin toward long-lived clients."""
+    from kubebrain_tpu.sched.scheduler import _LaneQueue, _Request
+
+    lq = _LaneQueue()
+
+    def mk(c):
+        return _Request(lambda: None, Lane.NORMAL, c, None)
+
+    for _ in range(5):  # client A drains repeatedly before B shows up
+        lq.push(mk("A"))
+        assert lq.pop().client == "A"
+    for _ in range(6):
+        lq.push(mk("A"))
+        lq.push(mk("B"))
+    got = [lq.pop().client for _ in range(12)]
+    assert got == ["A", "B"] * 6, got  # strict alternation, no A-burst
+    assert lq.pop() is None
+    assert not lq.order and not lq.clients and lq.size == 0
+
+
+# ------------------------------------------------------------- priority
+def test_no_priority_inversion_under_background_flood():
+    """A SYSTEM request enqueued behind a saturated BACKGROUND flood must
+    dispatch as soon as a slot frees — at most one head-of-line background
+    request (already popped by the dispatcher) runs ahead of it."""
+    s = RequestScheduler(None, SchedConfig(depth=1, queue_limit=256))
+    done: list[str] = []
+    lock = threading.Lock()
+
+    def record(tag):
+        def fn():
+            time.sleep(0.01)
+            with lock:
+                done.append(tag)
+        return fn
+
+    release = threading.Event()
+    try:
+        s.submit_async(release.wait, Lane.NORMAL)  # plug the single slot
+        time.sleep(0.1)
+        bg = [s.submit_async(record(f"bg{i}"), Lane.BACKGROUND)
+              for i in range(30)]
+        sys_req = s.submit_async(record("system"), Lane.SYSTEM)
+        release.set()
+        sys_req.wait(10.0)
+        for r in bg:
+            r.wait(10.0)
+        # dispatcher may have one background request in hand when the
+        # system request arrives; everything else must queue behind it
+        assert "system" in done[:2], done[:5]
+    finally:
+        release.set()
+        s.close()
+
+
+def test_overload_high_priority_p99_bounded_at_10x():
+    """10x queue oversubscription on the background lane: background work
+    sheds, while SYSTEM requests keep a bounded p99."""
+    qlimit = 16
+    s = RequestScheduler(None, SchedConfig(depth=2, queue_limit=qlimit,
+                                           shed_ms=30_000.0))
+    stop = threading.Event()
+    shed = 0
+    shed_lock = threading.Lock()
+    admitted = []
+    try:
+        # 10x oversubscription: keep the background queue pinned at its
+        # limit for the whole measurement window
+        def flood():
+            nonlocal shed
+            while not stop.is_set():
+                try:
+                    req = s.submit_async(lambda: time.sleep(0.005),
+                                         Lane.BACKGROUND)
+                    with shed_lock:
+                        admitted.append(req)
+                except SchedOverloadError:
+                    with shed_lock:
+                        shed += 1
+        flooders = [threading.Thread(target=flood, daemon=True)
+                    for _ in range(4)]
+        for t in flooders:
+            t.start()
+        time.sleep(0.2)
+        lat = []
+        for _ in range(20):
+            t0 = time.monotonic()
+            s.submit(lambda: None, Lane.SYSTEM)
+            lat.append(time.monotonic() - t0)
+        stop.set()
+        for t in flooders:
+            t.join(5.0)
+        lat.sort()
+        p99 = lat[-1]
+        # bounded: a slot frees every ~5ms; generous 2s bound absorbs CI
+        # noise while still ruling out queued-behind-the-flood (the flood
+        # alone is > 160 x 5ms deep at all times)
+        assert p99 < 2.0, f"system p99 {p99:.3f}s under background flood"
+        assert shed > len(admitted), (shed, len(admitted))
+        assert s.shed_counts[Lane.BACKGROUND] == shed
+    finally:
+        stop.set()
+        s.close()
+
+
+# ------------------------------------------------- backend-level parity
+def _build_backend():
+    store = new_storage("memkv")
+    backend = Backend(store, BackendConfig(event_ring_capacity=8192))
+    return store, backend
+
+
+def _snapshot(res):
+    """Byte-string fingerprint of a RangeResult (order included)."""
+    out = [b"%d|%d|%d" % (res.revision, res.count, int(res.more))]
+    for kv in res.kvs:
+        out.append(kv.key + b"\x00" + kv.value + b"\x00%d" % kv.revision)
+    return b"\xff".join(out)
+
+
+def test_scheduled_results_byte_identical_randomized():
+    """Randomized Range workloads over the CPU fallback path: scheduled
+    and unscheduled results are byte-identical (revision pinned and
+    unpinned; the store is quiescent during comparison)."""
+    import random
+
+    rng = random.Random(20260803)
+    store, backend = _build_backend()
+    sched = ensure_scheduler(backend, SchedConfig(depth=4))
+    try:
+        keys = []
+        checkpoints = []
+        for i in range(60):
+            k = b"/registry/%s/obj-%04d" % (
+                rng.choice([b"pods", b"services", b"secrets"]), i)
+            keys.append(k)
+            backend.create(k, b"v0-%d" % i)
+        checkpoints.append(backend.current_revision())
+        for k in rng.sample(keys, 30):
+            rec = backend._read_rev_record(k)
+            backend.update(k, b"v1-" + k, rec[0])
+        checkpoints.append(backend.current_revision())
+        for k in rng.sample(keys, 10):
+            try:
+                backend.delete(k)
+            except Exception:
+                pass
+        checkpoints.append(backend.current_revision())
+
+        bounds = sorted(rng.sample(keys, 20)) + [b"/registry/", b"/registry0"]
+        workloads = []
+        for _ in range(40):
+            a, b = rng.choice(bounds), rng.choice(bounds)
+            if a > b:
+                a, b = b, a
+            if a == b:
+                b = a + b"\xff"
+            rev = rng.choice([0] + checkpoints)
+            limit = rng.choice([0, 0, 7, 100])
+            workloads.append((a, b, rev, limit))
+
+        results: dict[int, bytes] = {}
+
+        def run(i, w):
+            results[i] = _snapshot(sched.list_(*w))
+
+        threads = [threading.Thread(target=run, args=(i, w))
+                   for i, w in enumerate(workloads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(30.0)
+        for i, w in enumerate(workloads):
+            assert results[i] == _snapshot(backend.list_(*w)), w
+        # counts match too
+        for a, b, rev, _ in workloads[:10]:
+            assert sched.count(a, b, rev) == backend.count(a, b, rev)
+    finally:
+        backend.close()
+        store.close()
+
+
+def test_coalescing_preserves_read_revision_visibility():
+    """Identical queued requests coalesce into one execution; requests at
+    different explicit revisions never share results."""
+    store, backend = _build_backend()
+    sched = ensure_scheduler(backend, SchedConfig(depth=1, queue_limit=256))
+    try:
+        for i in range(20):
+            backend.create(b"/registry/co/k%03d" % i, b"v0")
+        r1 = backend.current_revision()
+        for i in range(20):
+            rec = backend._read_rev_record(b"/registry/co/k%03d" % i)
+            backend.update(b"/registry/co/k%03d" % i, b"v1", rec[0])
+        r2 = backend.current_revision()
+
+        release = threading.Event()
+        sched.submit_async(release.wait, Lane.SYSTEM)  # plug the slot
+        time.sleep(0.1)
+
+        outs: dict[int, object] = {}
+        revs = [r1, r2, r1, r2, r1, r2, r1, r1]
+
+        def run(i):
+            outs[i] = sched.list_(b"/registry/co/", b"/registry/co0",
+                                  revs[i], 0)
+
+        threads = [threading.Thread(target=run, args=(i,))
+                   for i in range(len(revs))]
+        for t in threads:
+            t.start()
+        time.sleep(0.3)  # all enqueued against the plugged slot
+        release.set()
+        for t in threads:
+            t.join(20.0)
+
+        assert sched.coalesced > 0  # identical queued requests merged
+        for i, rev in enumerate(revs):
+            expect = backend.list_(b"/registry/co/", b"/registry/co0", rev, 0)
+            assert _snapshot(outs[i]) == _snapshot(expect), rev
+        # r1 results really differ from r2 (the visibility check has teeth)
+        assert _snapshot(outs[0]) != _snapshot(outs[1])
+    finally:
+        release.set()
+        backend.close()
+        store.close()
+
+
+# ------------------------------------------------------- wire-level shed
+@pytest.fixture()
+def overloaded_endpoint():
+    """A live endpoint whose backend list path is artificially slow and
+    whose scheduler queue is tiny — Range floods must shed."""
+    from kubebrain_tpu.endpoint import Endpoint, EndpointConfig
+    from kubebrain_tpu.metrics.prom import PrometheusMetrics
+    from kubebrain_tpu.server import Server
+    from kubebrain_tpu.server.service import SingleNodePeerService
+
+    store = new_storage("memkv")
+    backend = Backend(store, BackendConfig(event_ring_capacity=8192))
+    metrics = PrometheusMetrics()
+    ensure_scheduler(backend, SchedConfig(depth=1, queue_limit=2,
+                                          shed_ms=30_000.0), metrics=metrics)
+    slow_list = backend.list_
+
+    def slowed(*a, **kw):
+        time.sleep(0.15)
+        return slow_list(*a, **kw)
+
+    backend.list_ = slowed
+    peers = SingleNodePeerService(backend)
+    server = Server(backend, peers, metrics)
+    cport, info = free_port(), free_port()
+    ep = Endpoint(server, metrics, EndpointConfig(
+        host="127.0.0.1", client_port=cport,
+        peer_port=free_port(), info_port=info,
+    ))
+    ep.run()
+    yield f"127.0.0.1:{cport}", info, backend
+    ep.close()
+    backend.close()
+    store.close()
+
+
+def test_shed_returns_resource_exhausted_on_wire(overloaded_endpoint):
+    target, info_port, backend = overloaded_endpoint
+    from kubebrain_tpu.proto import rpc_pb2
+
+    c = EtcdClient(target)
+    for i in range(5):
+        c.create(b"/registry/pods/p%02d" % i, b"v")
+
+    codes: list = []
+    details: list = []
+
+    def one_list(i):
+        try:
+            # distinct limits => distinct coalesce keys: identical requests
+            # would legitimately merge into one execution and never shed
+            c.range_(rpc_pb2.RangeRequest(
+                key=b"/registry/pods/", range_end=b"/registry/pods0",
+                limit=i + 1))
+            codes.append("ok")
+        except grpc.RpcError as e:
+            codes.append(e.code())
+            details.append(e.details())
+
+    threads = [threading.Thread(target=one_list, args=(i,)) for i in range(16)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(30.0)
+
+    shed = [x for x in codes if x == grpc.StatusCode.RESOURCE_EXHAUSTED]
+    assert shed, codes  # 16 concurrent vs depth 1 + queue 2: must shed
+    assert any("etcdserver: too many requests" in d for d in details), details
+    assert "ok" in codes  # admitted requests still served
+
+    body = urllib.request.urlopen(
+        f"http://127.0.0.1:{info_port}/metrics", timeout=10
+    ).read().decode()
+    assert "kb_sched_shed_total" in body, body[:2000]
+    assert "kb_sched_queue_depth" in body
+    assert "kb_sched_inflight" in body
+    sched = backend._kb_scheduler
+    assert sum(sched.shed_counts.values()) >= len(shed)

@@ -2,18 +2,27 @@
 # The offline CI entry point (mirrored by .github/workflows/check.yml):
 #   1. make lint        — kblint project invariants + native lint
 #   2. make typecheck   — mypy (or compileall fallback)
-#   3. tier-1 pytest    — the ROADMAP.md verify command
+#   3. scheduler gate   — sched semantics tests + bench-smoke (the
+#                         byte-identical scheduled-path check; fast, and a
+#                         scheduler regression should fail before the long
+#                         tier-1 run, not 10 minutes into it)
+#   4. tier-1 pytest    — the ROADMAP.md verify command
 # Run from anywhere; operates on the repo this script lives in.
 
 set -uo pipefail
 
 cd "$(dirname "$0")/.."
 
-echo "=== [1/3] make lint"
+echo "=== [1/4] make lint"
 make lint || exit 1
 
-echo "=== [2/3] make typecheck"
+echo "=== [2/4] make typecheck"
 make typecheck || exit 1
 
-echo "=== [3/3] tier-1 tests (ROADMAP.md verify, one definition: make test-tier1)"
+echo "=== [3/4] scheduler semantics + bench-smoke (CPU fallback)"
+env JAX_PLATFORMS=cpu python -m pytest tests/test_sched.py -q -m 'not slow' \
+    -p no:cacheprovider || exit 1
+make bench-smoke || exit 1
+
+echo "=== [4/4] tier-1 tests (ROADMAP.md verify, one definition: make test-tier1)"
 exec make test-tier1

@@ -210,6 +210,49 @@ def _revision_like(expr: ast.expr) -> str | None:
     return None
 
 
+#: backend/scanner range-read entry points the service layer must reach
+#: through the request scheduler (kubebrain_tpu/sched), never directly —
+#: a direct call bypasses admission lanes, coalescing, and overload
+#: shedding, so one unthrottled caller can starve the device pipeline.
+_SCAN_ENTRY_POINTS = {
+    "list_", "list_wire", "list_by_stream", "count", "range_", "range_stream",
+}
+_SCAN_RECEIVERS = {"backend", "scanner"}
+
+
+@register
+class RangeReadsThroughScheduler(Rule):
+    """Service-layer range reads go through the request scheduler
+    (``sched.ensure_scheduler``/the KVService ``limiter``); calling the
+    backend/scanner scan entry points directly skips priority lanes and
+    overload protection."""
+
+    rule_id = "KB106"
+    summary = ("service-layer code must not call engine scan entry points "
+               "directly (server/etcd/, endpoint/); use the scheduler")
+
+    def applies(self, relpath: str) -> bool:
+        return relpath.replace("\\", "/").startswith(
+            ("kubebrain_tpu/server/etcd/", "kubebrain_tpu/endpoint/")
+        )
+
+    def check(self, tree: ast.Module, src: str) -> Iterable[tuple[ast.AST, str]]:
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if not isinstance(func, ast.Attribute):
+                continue
+            if func.attr not in _SCAN_ENTRY_POINTS:
+                continue
+            receiver = terminal_name(func.value)
+            if receiver in _SCAN_RECEIVERS:
+                yield node, (
+                    f"direct scan call {receiver}.{func.attr}(); range reads "
+                    "go through the request scheduler (sched.ensure_scheduler)"
+                )
+
+
 @register
 class RevisionFlowsThroughHelpers(Rule):
     """Revisions are opaque monotonic tokens minted by the sequencer; raw
