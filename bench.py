@@ -1299,6 +1299,41 @@ def main() -> None:
     print(f"[bench] device: best {best*1e3:.1f}ms p50 {p50*1e3:.1f}ms "
           f"= {rate/1e6:.1f}M rows/s", file=sys.stderr)
 
+    # KB_TRACE=1: rerun the same scan under full span/stage tracing and
+    # bound the tracer's cost on the north-star metric. Compared on
+    # best-of-iters (noise-robust); the tracer's per-span cost is a few
+    # monotonic() reads + list appends, so >5% means a regression in the
+    # trace hot path, not machine jitter.
+    trace_on = os.environ.get("KB_TRACE") == "1"
+    trace_overhead = None
+    if trace_on:
+        from kubebrain_tpu.trace import TRACER
+
+        TRACER.reset()
+
+        # IDENTICAL work to the untraced loop (dispatch + block) — an extra
+        # host pull here would measure a device-link round trip as "tracer
+        # overhead" and fail the <5% assert spuriously over the axon tunnel
+        def traced_scan():
+            with TRACER.span("bench.scan"):
+                with TRACER.stage("device_dispatch", device=True):
+                    out = scan_count(d_args[0], d_args[1], d_args[2],
+                                     d_args[3], nv, s_dev, e_dev, qhi, qlo)
+                with TRACER.stage("device_compute", device=True):
+                    jax.block_until_ready(out)
+
+        lat_tr = []
+        for _ in range(iters):
+            t0 = time.time()
+            traced_scan()
+            lat_tr.append(time.time() - t0)
+        trace_overhead = min(lat_tr) / best - 1
+        print(f"[bench] traced: best {min(lat_tr)*1e3:.1f}ms "
+              f"(overhead {trace_overhead:+.2%})", file=sys.stderr)
+        assert trace_overhead < 0.05, (
+            f"tracing overhead {trace_overhead:.1%} >= 5% "
+            f"(traced best {min(lat_tr)*1e3:.2f}ms vs {best*1e3:.2f}ms)")
+
     # sustained throughput: jax dispatch is async, so issuing a burst and
     # blocking once amortizes the per-dispatch transport RTT (over the axon
     # tunnel that RTT dominates single-query p50; with locally-attached
@@ -1341,6 +1376,22 @@ def main() -> None:
     print(f"[bench] scheduled x{n_req} depth {depth}: "
           f"{scheduled/1e6:.1f}M rows/s", file=sys.stderr)
 
+    # per-stage time fractions from the tracer's EWMAs: device stages from
+    # the traced single-dispatch run, queue_wait from the scheduled run
+    # (the scheduler records it for every request)
+    stage_breakdown = None
+    if trace_on:
+        from kubebrain_tpu.trace import TRACER
+
+        ew = {
+            "queue_wait": TRACER.ewma("queue_wait") or 0.0,
+            "dispatch": TRACER.ewma("device_dispatch") or 0.0,
+            "device": TRACER.ewma("device_compute") or 0.0,
+            "host_copy": TRACER.ewma("host_copy") or 0.0,
+        }
+        total_ew = sum(ew.values()) or 1.0
+        stage_breakdown = {k: round(v / total_ew, 4) for k, v in ew.items()}
+
     print(json.dumps({
         "metric": "range-scan keys/sec",
         "value": round(rate),
@@ -1357,6 +1408,9 @@ def main() -> None:
             "cpu_numpy_rows_per_sec": round(cpu_rate),
             "device": str(dev),
             "kernel": "pallas" if use_pallas else "jnp",
+            **({"stage_breakdown": stage_breakdown,
+                "trace_overhead": round(trace_overhead, 4)}
+               if trace_on else {}),
         },
     }))
 

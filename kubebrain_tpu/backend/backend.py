@@ -29,6 +29,7 @@ from typing import Iterator
 from .. import coder
 from ..storage import CASFailedError, KvStorage, Partition, UncertainResultError
 from ..storage.errors import KeyNotFoundError, RevisionDriftBackError
+from ..trace import TRACER
 from ..util.env import txn_log
 from . import creator
 from .common import (
@@ -419,7 +420,10 @@ class Backend:
         if fast is None:
             return None
         read_rev = self._read_revision_checked(revision)
-        blob, n, more = fast(start, end, read_rev, limit)
+        # one C call does scan + wire encode; attribute it as the engine
+        # compute stage so the raw fast path still shows up in traces
+        with TRACER.stage("device_compute"):
+            blob, n, more = fast(start, end, read_rev, limit)
         return blob, n, more, read_rev
 
     def count(self, start: bytes, end: bytes, revision: int = 0) -> tuple[int, int]:
@@ -607,6 +611,7 @@ class Backend:
                 batch: list[WatchEvent] = []
                 for event in ready:
                     self.tso.commit(event.revision)
+                    event.ts = time.monotonic()
                     if event.err is not None and isinstance(event.err, UncertainResultError):
                         self.retry.append(event)
                     elif event.valid:

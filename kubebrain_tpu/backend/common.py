@@ -34,6 +34,9 @@ class WatchEvent:
     prev_value: bytes | None = None
     valid: bool = True
     err: BaseException | None = None
+    # monotonic commit time, stamped by the sequencer when this revision is
+    # committed — the zero point of the watch-path delivery-lag histograms
+    ts: float = 0.0
 
 
 @dataclass

@@ -29,6 +29,7 @@ from typing import Callable, Iterator
 
 from .. import coder
 from ..storage import CASFailedError, KvStorage, Partition
+from ..trace import TRACER
 from .common import TOMBSTONE, KeyValue
 from .errors import CompactedError
 
@@ -149,23 +150,35 @@ class Scanner:
         """
         lo, hi = coder.internal_range(start, end)
         snapshot = self._snapshot_checked(read_revision)
+        # trace attribution: the engine scan is this scanner's "device"
+        # (host iteration here; a kernel dispatch in the TPU scanner), the
+        # result merge is the host copy — the same stage names both engines
+        # report so /debug/traces reads identically across storage choices
         if limit > 0:
             kvs: list[KeyValue] = []
-            self._scan_partition(
-                Partition(lo, hi), snapshot, read_revision, kvs.append, limit=limit + 1
-            )
-            more = len(kvs) > limit
-            return kvs[:limit], more
-        results = self._parallel_scan(lo, hi, snapshot, read_revision)
-        merged: list[KeyValue] = []
-        for r in results:
-            merged.extend(r.kvs)
+            with TRACER.stage("device_compute"):
+                self._scan_partition(
+                    Partition(lo, hi), snapshot, read_revision, kvs.append,
+                    limit=limit + 1,
+                )
+            with TRACER.stage("host_copy"):
+                more = len(kvs) > limit
+                out = kvs[:limit]
+            return out, more
+        with TRACER.stage("device_compute"):
+            results = self._parallel_scan(lo, hi, snapshot, read_revision)
+        with TRACER.stage("host_copy"):
+            merged: list[KeyValue] = []
+            for r in results:
+                merged.extend(r.kvs)
         return merged, False
 
     def count(self, start: bytes, end: bytes, read_revision: int) -> int:
         lo, hi = coder.internal_range(start, end)
         snapshot = self._snapshot_checked(read_revision)
-        results = self._parallel_scan(lo, hi, snapshot, read_revision, count_only=True)
+        with TRACER.stage("device_compute"):
+            results = self._parallel_scan(
+                lo, hi, snapshot, read_revision, count_only=True)
         return sum(r.count for r in results)
 
     def range_stream(

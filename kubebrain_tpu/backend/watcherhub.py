@@ -20,6 +20,7 @@ from __future__ import annotations
 import bisect
 import queue
 import threading
+import time
 from typing import Callable
 
 from .common import WatchEvent
@@ -113,6 +114,9 @@ class WatcherHub:
         # lazily (re)built interval index for host-side matching
         self._index: _RangeIndex | None = None
         self._index_version = -1
+        # optional metrics sink (set_metrics): commit->delivery lag histogram
+        # + per-watcher backlog gauges
+        self._metrics = None
         if fanout_matcher is not None:
             import inspect
 
@@ -122,6 +126,21 @@ class WatcherHub:
                 )
             except (TypeError, ValueError):
                 pass
+
+    def set_metrics(self, metrics) -> None:
+        """Arm watch-path lag instrumentation: ``kb.watch.lag.seconds``
+        (commit -> subscriber-queue delivery, emitted in ``stream``) and a
+        ``kb.watch.backlog{watcher=}`` scrape-time gauge per live watcher.
+        Dead watchers unregister themselves by raising LookupError at scrape
+        (the callback-gauge collector drops them)."""
+        self._metrics = metrics
+
+    def _backlog_of(self, wid: int) -> float:
+        q = self._subs.get(wid)
+        if q is None:
+            raise LookupError(wid)  # watcher gone: gauge self-unregisters
+        qsize = getattr(q, "qsize", None)
+        return float(qsize()) if callable(qsize) else 0.0
 
     def add_watcher(
         self, start: bytes = b"", end: bytes = b"", min_revision: int = 0,
@@ -143,6 +162,11 @@ class WatcherHub:
         q = factory(SUBSCRIBER_BUFFER)
         self._subs[wid] = q
         self._filters[wid] = (start, end, min_revision)
+        if self._metrics is not None:
+            self._metrics.register_gauge_fn(
+                "kb.watch.backlog", lambda w=wid: self._backlog_of(w),
+                watcher=str(wid),
+            )
         return wid, q
 
     def add_watcher_with_replay(
@@ -196,6 +220,12 @@ class WatcherHub:
             q = self._subs.pop(wid, None)
             self._filters.pop(wid, None)
             self._version += 1
+        if q is not None and self._metrics is not None:
+            # eager unregistration (outside the hub lock): scrape-time
+            # LookupError GC alone would leak one dead entry per watcher
+            # on servers nothing ever scrapes
+            self._metrics.unregister_gauge_fn("kb.watch.backlog",
+                                              watcher=str(wid))
         if q is not None:
             # poison pill: stream closed. If the queue is full (that's why the
             # watcher is being dropped), evict one batch so the pill fits —
@@ -330,14 +360,23 @@ class WatcherHub:
                 ]
 
         dead: list[int] = []
+        delivered = False
         for wid, q in subs:
             events = per_watcher.get(wid)
             if not events:
                 continue
             try:
                 q.put_nowait(events)
+                delivered = True
             except queue.Full:
                 dead.append(wid)  # slow consumer: drop it
+        if delivered and self._metrics is not None and batch[0].ts:
+            # commit-revision -> subscriber-queue delivery lag, one
+            # observation per fan-out (the oldest event bounds the batch)
+            self._metrics.emit_histogram(
+                "kb.watch.lag.seconds", time.monotonic() - batch[0].ts,
+                point="queue",
+            )
         for wid in dead:
             self.delete_watcher(wid)
 

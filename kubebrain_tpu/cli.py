@@ -82,7 +82,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--sched-depth", type=int, default=4,
                    help="request scheduler: bounded in-flight device scan "
                         "dispatches (pipelined; bench pipelined_rows_per_sec "
-                        "saturates by ~8)")
+                        "saturates by ~8). 0 = auto: sized from the tracer's "
+                        "measured dispatch-RTT EWMA, clamped 2-16")
+    p.add_argument("--trace-slow-ms", type=float, default=500.0,
+                   help="request tracer: RPCs slower than this land in the "
+                        "slow-request log (/debug/traces \"slow\") and a "
+                        "warning log line; 0 disables the slow log")
     p.add_argument("--sched-shed-ms", type=float, default=5000.0,
                    help="request scheduler: shed queued range reads older "
                         "than this (etcd ResourceExhausted on the wire)")
@@ -134,10 +139,13 @@ def validate_args(args) -> None:
             raise SystemExit(f"TLS file not found: {f}")
     if args.storage == "tpu" and args.inner_storage == "tpu":
         raise SystemExit("--inner-storage cannot be tpu")
-    if getattr(args, "sched_depth", 1) < 1 or getattr(args, "sched_queue_limit", 1) < 1:
-        raise SystemExit("--sched-depth and --sched-queue-limit must be >= 1")
+    if getattr(args, "sched_depth", 1) < 0 or getattr(args, "sched_queue_limit", 1) < 1:
+        raise SystemExit("--sched-depth must be >= 0 (0 = auto) and "
+                         "--sched-queue-limit must be >= 1")
     if getattr(args, "sched_shed_ms", 1.0) <= 0:
         raise SystemExit("--sched-shed-ms must be > 0")
+    if getattr(args, "trace_slow_ms", 0.0) < 0:
+        raise SystemExit("--trace-slow-ms must be >= 0")
     if args.data_dir and not (
         args.storage == "native" or (args.storage == "tpu" and args.inner_storage == "native")
     ):
@@ -160,6 +168,14 @@ def build_endpoint(args):
     from .util.net import get_host
 
     metrics = new_metrics(args.cluster_name)
+
+    # arm the process tracer: stage histograms (kb_rpc_stage_seconds) flow
+    # into this metrics sink, slow requests into the /debug/traces slow log
+    from .trace import TRACER
+
+    TRACER.configure(metrics=metrics,
+                     slow_ms=getattr(args, "trace_slow_ms", 500.0))
+
     native_kw = {"partitions": args.native_partitions}
     if getattr(args, "data_dir", ""):
         native_kw.update({"data_dir": args.data_dir, "fsync": args.fsync})
@@ -204,6 +220,10 @@ def build_endpoint(args):
         enable_etcd_compatibility=not args.disable_etcd_compatibility,
         fanout_matcher=fanout,
     ))
+
+    # watch-path lag instrumentation: commit->delivery histogram + per-
+    # watcher backlog gauges on /metrics
+    backend.watcher_hub.set_metrics(metrics)
 
     # the device-aware request scheduler, created here (before any service
     # constructs a KVService) so every surface shares the flag-configured

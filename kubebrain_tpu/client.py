@@ -24,8 +24,23 @@ from typing import Iterator
 import grpc
 
 from .proto import brain_pb2, kv_pb2, rpc_pb2
+from .trace import make_traceparent
 
 PARTITION_MAGIC_REVISION = 1888
+
+
+def _traced_call(callable_):
+    """Wrap a grpc multicallable so every invocation carries a W3C
+    ``traceparent`` metadata entry — the server parents its span tree under
+    it, so a client-observed slow call is findable in ``/debug/traces`` by
+    trace id. Continues the ambient span's trace when the caller is itself
+    inside one."""
+
+    def call(request, timeout=None, metadata=None):
+        md = tuple(metadata or ()) + (("traceparent", make_traceparent()),)
+        return callable_(request, timeout=timeout, metadata=md)
+
+    return call
 
 
 @dataclass
@@ -46,18 +61,19 @@ class EtcdCompatClient:
         self._range = self._unary("/etcdserverpb.KV/Range", p.RangeRequest, p.RangeResponse)
         self._txn = self._unary("/etcdserverpb.KV/Txn", p.TxnRequest, p.TxnResponse)
         self._compact = self._unary("/etcdserverpb.KV/Compact", p.CompactionRequest, p.CompactionResponse)
-        self._watch = self.channel.stream_stream(
+        raw_watch = self.channel.stream_stream(
             "/etcdserverpb.Watch/Watch",
             request_serializer=p.WatchRequest.SerializeToString,
             response_deserializer=p.WatchResponse.FromString,
         )
+        self._watch = _traced_call(raw_watch)
 
     def _unary(self, method, req, resp):
-        return self.channel.unary_unary(
+        return _traced_call(self.channel.unary_unary(
             method,
             request_serializer=req.SerializeToString,
             response_deserializer=resp.FromString,
-        )
+        ))
 
     # --------------------------------------------------------------- writes
     def create(self, key: bytes, value: bytes) -> tuple[bool, int]:
@@ -305,18 +321,18 @@ class BrainClient:
         p = brain_pb2
 
         def u(name, req, resp):
-            return self.channel.unary_unary(
+            return _traced_call(self.channel.unary_unary(
                 f"/brainpb.Brain/{name}",
                 request_serializer=req.SerializeToString,
                 response_deserializer=resp.FromString,
-            )
+            ))
 
         def us(name, req, resp):
-            return self.channel.unary_stream(
+            return _traced_call(self.channel.unary_stream(
                 f"/brainpb.Brain/{name}",
                 request_serializer=req.SerializeToString,
                 response_deserializer=resp.FromString,
-            )
+            ))
 
         self._create = u("Create", p.CreateRequest, p.CreateResponse)
         self._update = u("Update", p.UpdateRequest, p.UpdateResponse)

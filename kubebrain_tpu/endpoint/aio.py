@@ -100,6 +100,10 @@ class AioBridgeQueue:
         with self._lock:
             return not self._items
 
+    def qsize(self) -> int:
+        with self._lock:
+            return len(self._items)
+
     # ---- async side (watch coroutine)
     async def get(self):
         while True:

@@ -86,17 +86,30 @@ class EndpointConfig:
     extra_http: dict = field(default_factory=dict)
 
 
+def http_call(fn, qs: str):
+    """Zero-arg invoker for an HTTP route handler — the ONE place the
+    query-string contract lives (both HTTP fronts dispatch through it):
+    query-aware handlers (``fn.kb_query``, e.g. /debug/profile?seconds=N)
+    receive the parsed query string as a flat last-value-wins dict."""
+    if getattr(fn, "kb_query", False):
+        from urllib.parse import parse_qs
+
+        query = {k: v[-1] for k, v in parse_qs(qs).items()}
+        return lambda: fn(query)
+    return fn
+
+
 class _HttpHandler(BaseHTTPRequestHandler):
     routes: dict = {}
 
     def do_GET(self):  # noqa: N802
-        path = self.path.split("?")[0]
+        path, _, qs = self.path.partition("?")
         fn = self.routes.get(path)
         if fn is None:
             self.send_error(404)
             return
         try:
-            content_type, body = fn()
+            content_type, body = http_call(fn, qs)()
         except Exception as e:  # surface handler errors as 500s
             self.send_error(500, str(e))
             return

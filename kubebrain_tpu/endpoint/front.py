@@ -502,12 +502,15 @@ class FrontServer:
         /election /metrics /debug/*."""
         parts = req.split(" ", 1)
         path = parts[1] if len(parts) == 2 else "/"
-        path = path.split("?", 1)[0]
+        path, _, qs = path.partition("?")
         handlers = self.server.http_handlers() if self.server is not None else {}
         try:
             if path in handlers:
+                from .endpoint import http_call
+
                 loop = asyncio.get_running_loop()
-                _ctype, body = await loop.run_in_executor(None, handlers[path])
+                _ctype, body = await loop.run_in_executor(
+                    None, http_call(handlers[path], qs))
                 self._send(cid, sid, K_END, struct.pack("<IH", 200, 0) + body)
             elif path == "/metrics" and self.metrics is not None:
                 loop = asyncio.get_running_loop()

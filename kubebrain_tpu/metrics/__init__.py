@@ -31,6 +31,12 @@ class Metrics(abc.ABC):
         emitted per event — per-op emit_gauge on a hot path both costs and
         under-reports between scrapes. Default: no-op."""
 
+    def unregister_gauge_fn(self, name: str, **tags: str) -> None:
+        """Drop every scrape-time gauge registered under (name, tags).
+        Short-lived subjects (watchers) must unregister eagerly — relying
+        on scrape-time GC alone leaks entries on unscraped servers.
+        Default: no-op."""
+
     def timed(self, name: str, **tags: str):
         """Context manager emitting a latency histogram + count."""
         return _Timer(self, name, tags)

@@ -41,6 +41,12 @@ class _CallbackGauges:
             entry = self._gauges.setdefault(pname, (labelnames, []))
             entry[1].append((labelvalues, fn))
 
+    def unregister(self, pname: str, labelvalues: tuple[str, ...]) -> None:
+        with self._lock:
+            entry = self._gauges.get(pname)
+            if entry is not None:
+                entry[1][:] = [it for it in entry[1] if it[0] != labelvalues]
+
     def collect(self):
         from prometheus_client.core import GaugeMetricFamily
 
@@ -51,11 +57,26 @@ class _CallbackGauges:
             ]
         for pname, names, items in snapshot:
             g = GaugeMetricFamily(pname, pname, labels=list(names))
+            dead: list = []
             for values, fn in items:
                 try:
                     g.add_metric(list(values), float(fn()))
+                except LookupError:
+                    # the provider says its subject is gone (e.g. a watcher
+                    # backlog gauge after the watcher dropped): unregister,
+                    # or churn leaks one dead entry per registration forever
+                    dead.append((values, fn))
                 except Exception:
                     continue  # a dead provider must not break the scrape
+            if dead:
+                with self._lock:
+                    entry = self._gauges.get(pname)
+                    if entry is not None:
+                        for item in dead:
+                            try:
+                                entry[1].remove(item)
+                            except ValueError:
+                                pass
             yield g
 
 
@@ -92,16 +113,26 @@ class PrometheusMetrics(Metrics):
         self._vec("histogram", name, tags).observe(value)
 
     def register_gauge_fn(self, name, fn, **tags):
+        pname, _names, values = self._gauge_key(name, tags)
+        with self._lock:
+            if self._callbacks is None:
+                self._callbacks = _CallbackGauges()
+                self.registry.register(self._callbacks)
+        self._callbacks.register(pname, _names, values, fn)
+
+    def unregister_gauge_fn(self, name, **tags):
+        if self._callbacks is None:
+            return
+        pname, _names, values = self._gauge_key(name, tags)
+        self._callbacks.unregister(pname, values)
+
+    def _gauge_key(self, name, tags):
         pname = name.replace(".", "_").replace("-", "_")
         if self._cluster:
             tags = {**tags, "cluster": self._cluster}
         names = tuple(sorted(tags))
         values = tuple(str(tags[k]) for k in names)
-        with self._lock:
-            if self._callbacks is None:
-                self._callbacks = _CallbackGauges()
-                self.registry.register(self._callbacks)
-        self._callbacks.register(pname, names, values, fn)
+        return pname, names, values
 
     def http_handler(self):
         def handler():

@@ -21,6 +21,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from . import keys as keyops
+from ..trace import TRACER
 from .scan import lex_less, rev_leq
 
 
@@ -152,7 +153,12 @@ class FanoutMatcher:
             revs += [0] * (epad - e)
         ek, _ = keyops.pack_keys(keys, self._width)
         ehi, elo = keyops.split_revs(np.array(revs, dtype=np.uint64))
-        mask = fanout_mask_range(
-            jnp.asarray(ek), jnp.asarray(ehi), jnp.asarray(elo), ws, we, wu, whi, wlo
-        )
-        return np.asarray(mask)[:e, :len(watcher_specs)]
+        # watch fan-out device time: dispatch (async kernel enqueue) vs the
+        # blocking mask pull — the watch path's slice of kb_rpc_stage_seconds
+        with TRACER.stage("fanout_dispatch"):
+            mask = fanout_mask_range(
+                jnp.asarray(ek), jnp.asarray(ehi), jnp.asarray(elo),
+                ws, we, wu, whi, wlo,
+            )
+        with TRACER.stage("fanout_copy"):
+            return np.asarray(mask)[:e, :len(watcher_specs)]

@@ -32,15 +32,25 @@ iterator scanner (the CPU fallback exercised by tier-1).
 
 from __future__ import annotations
 
+import math
 import threading
 import time
 from collections import deque
 from dataclasses import dataclass, field
 
+from ..trace import TRACER
 from .lanes import Lane, classify
 
 #: wire message kube-apiserver's etcd3 client recognizes and retries on
 ERR_TOO_MANY_REQUESTS = "etcdserver: too many requests"
+
+#: auto-depth (--sched-depth 0) bounds: the measured dispatch-RTT / compute
+#: ratio is clamped here so a noisy EWMA can neither serialize the pipeline
+#: nor oversubscribe the device queue
+AUTO_DEPTH_MIN = 2
+AUTO_DEPTH_MAX = 16
+#: depth used in auto mode until the tracer has device-stage measurements
+AUTO_DEPTH_DEFAULT = 4
 
 
 class SchedOverloadError(Exception):
@@ -70,7 +80,9 @@ def client_of(context) -> str:
 
 @dataclass
 class SchedConfig:
-    depth: int = 4           # bounded in-flight device dispatches
+    depth: int = 4           # bounded in-flight device dispatches; 0 = auto
+    #                          (sized from the tracer's dispatch-RTT EWMA,
+    #                          clamped AUTO_DEPTH_MIN..MAX)
     queue_limit: int = 1024  # per-lane queued-request bound
     shed_ms: float = 5000.0  # max queue age before a request is shed
     workers: int = 0         # worker threads; 0 = same as depth
@@ -78,7 +90,8 @@ class SchedConfig:
 
 class _Request:
     __slots__ = ("fn", "lane", "client", "key", "deterministic", "enqueued",
-                 "done", "result", "error", "followers")
+                 "done", "result", "error", "followers", "span", "joined",
+                 "finished_at")
 
     def __init__(self, fn, lane: Lane, client: str, key, deterministic=False):
         self.fn = fn
@@ -91,15 +104,22 @@ class _Request:
         self.result = None
         self.error: BaseException | None = None
         self.followers: list["_Request"] = []
+        # the submitting thread's trace span: workers adopt it so scheduler
+        # and backend stages land on the RPC's span tree
+        self.span = TRACER.current()
+        self.joined = False       # attached to a coalesced leader
+        self.finished_at = 0.0    # monotonic completion time (result_deliver)
 
     # ---- completion (leader result fans out to coalesced followers)
     def finish(self, result=None, error: BaseException | None = None) -> None:
         self.result = result
         self.error = error
+        self.finished_at = time.monotonic()
         self.done.set()
         for f in self.followers:
             f.result = result
             f.error = error
+            f.finished_at = self.finished_at
             f.done.set()
 
     def wait(self, timeout: float) -> object:
@@ -169,7 +189,10 @@ class RequestScheduler:
         self._pending: dict[object, _Request] = {}   # queued, by coalesce key
         self._inflight: dict[object, _Request] = {}  # executing, by key
         self._inflight_count = 0
-        self._sem = threading.BoundedSemaphore(max(1, self.config.depth))
+        # dispatch-slot gate (was a BoundedSemaphore): a counter + condition
+        # so the bound can follow current_depth() when depth is auto (0)
+        self._slots_cv = threading.Condition()
+        self._slots_used = 0
         self._closed = False
         self._started = False
         self._dispatcher: threading.Thread | None = None
@@ -187,6 +210,47 @@ class RequestScheduler:
                 )
             metrics.register_gauge_fn(
                 "kb.sched.inflight", lambda: self._inflight_count)
+            metrics.register_gauge_fn("kb.sched.depth", self.current_depth)
+            metrics.register_gauge_fn(
+                "kb.sched.dispatch.rtt.seconds",
+                lambda: TRACER.dispatch_rtt() or 0.0)
+
+    # ---------------------------------------------------------------- depth
+    def current_depth(self) -> int:
+        """The in-flight dispatch bound. Fixed (--sched-depth N) or, in auto
+        mode (N=0), derived from the tracer's measured device timings: to
+        keep the device busy the pipeline must cover the full dispatch round
+        trip, so depth ≈ ceil(dispatch_rtt / device_compute) — over a remote
+        accelerator link (axon tunnel) the RTT dwarfs compute and depth
+        grows toward AUTO_DEPTH_MAX; with locally attached chips it settles
+        near AUTO_DEPTH_MIN."""
+        if self.config.depth > 0:
+            return self.config.depth
+        # device-marked EWMAs only: host-path scans share the stage names
+        # (uniform traces) but must not shrink the divisor — see
+        # Tracer.record_stage(device=)
+        rtt = TRACER.dispatch_rtt()
+        compute = TRACER.device_ewma("device_compute")
+        if not rtt or not compute or compute <= 0:
+            return AUTO_DEPTH_DEFAULT
+        return max(AUTO_DEPTH_MIN, min(AUTO_DEPTH_MAX, math.ceil(rtt / compute)))
+
+    def _acquire_slot(self) -> bool:
+        """Block until an in-flight slot frees (False when closing). The
+        bound is re-read each wakeup so auto depth applies immediately."""
+        with self._slots_cv:
+            while True:
+                if self._closed:
+                    return False
+                if self._slots_used < self.current_depth():
+                    self._slots_used += 1
+                    return True
+                self._slots_cv.wait(timeout=0.2)
+
+    def _release_slot(self) -> None:
+        with self._slots_cv:
+            self._slots_used -= 1
+            self._slots_cv.notify()
 
     # ------------------------------------------------------------- lifecycle
     def _ensure_started(self) -> None:
@@ -201,7 +265,9 @@ class RequestScheduler:
                 target=crash_guard(self._dispatch_loop), name="kb-sched",
                 daemon=True,
             )
-            n = self.config.workers or max(1, self.config.depth)
+            # auto depth (0) can grow to AUTO_DEPTH_MAX at runtime; the
+            # worker pool must already be wide enough to use those slots
+            n = self.config.workers or max(1, self.config.depth or AUTO_DEPTH_MAX)
             self._workers = [
                 threading.Thread(target=self._work_loop,
                                  name=f"kb-sched-w{i}", daemon=True)
@@ -226,6 +292,8 @@ class RequestScheduler:
                     dangling.append(r)
             self._pending.clear()
             self._cv.notify_all()
+        with self._slots_cv:
+            self._slots_cv.notify_all()
         with self._run_cv:
             self._run_cv.notify_all()
         for r in dangling:
@@ -258,6 +326,7 @@ class RequestScheduler:
             if key is not None:
                 leader = self._pending.get(key)
                 if leader is not None:
+                    req.joined = True
                     leader.followers.append(req)
                     self.coalesced += 1
                     self._emit_counter("kb.sched.coalesced.total", lane)
@@ -265,6 +334,7 @@ class RequestScheduler:
                 if req.deterministic:
                     running = self._inflight.get(key)
                     if running is not None:
+                        req.joined = True
                         running.followers.append(req)
                         self.coalesced += 1
                         self._emit_counter("kb.sched.coalesced.total", lane)
@@ -285,7 +355,20 @@ class RequestScheduler:
         """Blocking submit: schedule ``fn`` and return its result."""
         req = self.submit_async(fn, lane, client, key, deterministic)
         timeout = self.config.shed_ms / 1000.0 * 4 + 60.0
-        res = req.wait(timeout)
+        try:
+            res = req.wait(timeout)
+        finally:
+            now = time.monotonic()
+            if req.joined:
+                # follower: its whole scheduler residency is one stage — the
+                # execution stages live on the leader's span
+                TRACER.record_stage("coalesce_join", req.enqueued, now,
+                                    span=req.span)
+            elif req.finished_at:
+                # worker completion -> waiter wakeup, so stage durations sum
+                # to the observed end-to-end latency (no unattributed tail)
+                TRACER.record_stage("result_deliver", req.finished_at, now,
+                                    span=req.span)
         if self.metrics is not None:
             self.metrics.emit_histogram(
                 "kb.sched.wait.seconds", time.monotonic() - req.enqueued,
@@ -343,15 +426,17 @@ class RequestScheduler:
             if req is None:
                 return
             # bound in-flight depth: block until a dispatch slot frees
-            self._sem.acquire()
+            if not self._acquire_slot():
+                # closing: never strand the popped request in _runq where
+                # nothing will finish it
+                req.finish(error=SchedClosedError("scheduler closed"))
+                return
             if self._closed:
-                # workers may already have exited: never strand the popped
-                # request in _runq where nothing will finish it
-                self._sem.release()
+                self._release_slot()
                 req.finish(error=SchedClosedError("scheduler closed"))
                 return
             if self._shed_if_stale(req):
-                self._sem.release()
+                self._release_slot()
                 continue
             with self._cv:
                 if req.key is not None:
@@ -394,13 +479,17 @@ class RequestScheduler:
                         return
                     self._run_cv.wait(timeout=0.2)
                 req = self._runq.popleft()
+            # enqueue -> execution start; recorded on the submitter's span
+            TRACER.record_stage("queue_wait", req.enqueued, time.monotonic(),
+                                span=req.span)
             try:
-                result = req.fn()
+                with TRACER.use(req.span):
+                    result = req.fn()
                 err = None
             except BaseException as e:  # surfaced to the waiting caller
                 result, err = None, e
             finally:
-                self._sem.release()
+                self._release_slot()
                 with self._cv:
                     if req.key is not None and \
                             self._inflight.get(req.key) is req:

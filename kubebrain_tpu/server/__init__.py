@@ -100,9 +100,18 @@ class Server:
             "/status": self._status,
             "/election": self._election,
             "/debug/threads": self._threads,
-            "/debug/jax-profile": self._jax_profile,
+            "/debug/traces": self._traces,
+            "/debug/profile": self._profile,
+            "/debug/jax-profile": self._jax_profile,  # legacy fixed-2s alias
             "/tier/failover": self._tier_failover,
         }
+
+    def _traces(self):
+        """Recent request span trees + slow-request log + stage EWMAs from
+        the process tracer (kubebrain_tpu.trace)."""
+        from ..trace import TRACER
+
+        return "application/json", json.dumps(TRACER.snapshot()).encode()
 
     def _health(self):
         return "application/json", json.dumps({"health": "true"}).encode()
@@ -159,15 +168,24 @@ class Server:
 
     _profile_lock = threading.Lock()
 
-    def _jax_profile(self):
-        """Capture a 2s jax profiler trace of the data plane (the kernel
-        analogue of the reference's pprof mounts, pkg/endpoint/pprof.go;
-        inspect with tensorboard or xprof). One capture at a time — an
-        overlapping request would stop the in-flight trace mid-capture."""
+    def _profile(self, query=None):
+        """``/debug/profile?seconds=N``: capture an on-demand ``jax.profiler``
+        device trace of the data plane for N seconds (default 2, clamped to
+        [0.05, 60]) — the kernel analogue of the reference's pprof mounts,
+        pkg/endpoint/pprof.go; inspect with tensorboard or xprof. One capture
+        at a time — an overlapping request would stop the in-flight trace
+        mid-capture."""
         import time
 
         import jax
 
+        try:
+            seconds = float((query or {}).get("seconds", 2.0))
+        except (TypeError, ValueError):
+            return "application/json", json.dumps(
+                {"error": "seconds must be a number"}
+            ).encode()
+        seconds = min(60.0, max(0.05, seconds))
         if not self._profile_lock.acquire(blocking=False):
             return "application/json", json.dumps(
                 {"error": "profile capture already in progress"}
@@ -176,12 +194,19 @@ class Server:
             out_dir = f"/tmp/kb-jax-profile-{int(time.time())}"
             jax.profiler.start_trace(out_dir)
             try:
-                time.sleep(2.0)
+                time.sleep(seconds)
             finally:
                 jax.profiler.stop_trace()
-            return "application/json", json.dumps({"trace_dir": out_dir}).encode()
+            return "application/json", json.dumps(
+                {"trace_dir": out_dir, "seconds": seconds}
+            ).encode()
         finally:
             self._profile_lock.release()
+
+    _profile.kb_query = True  # HTTP layers pass the parsed query string
+
+    def _jax_profile(self):
+        return self._profile()
 
     def start_tier_watchdog(self, interval: float = 1.0, failures: int = 3) -> bool:
         """Auto-failover for the replicated kbstored tier: probe the tier

@@ -33,6 +33,7 @@ from ...backend import (
 from ...sched import SchedOverloadError, client_of, ensure_scheduler
 from ...storage.errors import KeyNotFoundError
 from ...proto import rpc_pb2
+from ...trace import TRACER, traceparent_of
 from . import shim
 
 PARTITION_MAGIC_REVISION = 1888  # reference kv.go:33
@@ -63,17 +64,25 @@ class KVService:
 
     # ------------------------------------------------------------------ Range
     def Range(self, request: rpc_pb2.RangeRequest, context) -> rpc_pb2.RangeResponse:
-        # the native-front backhaul forwards pre-serialized bytes verbatim;
-        # python-grpc listeners reserialize, so the raw path is front-only
-        raw_ok = bool(getattr(context, "kb_raw_ok", False))
-        if self.peers is not None:
-            self.peers.sync_read_revision()
-        # etcd range conventions: empty range_end = the single key;
-        # range_end == b"\0" = everything >= key ("from key")
-        range_end = bytes(request.range_end)
-        single_key = not range_end
-        if range_end == b"\x00":
-            range_end = b""
+        # every Range is one span tree in /debug/traces; the client's W3C
+        # traceparent (gRPC metadata) parents it when the transport has one
+        with TRACER.span("etcd.KV/Range", traceparent=traceparent_of(context)):
+            return self._range(request, context)
+
+    def _range(self, request: rpc_pb2.RangeRequest, context) -> rpc_pb2.RangeResponse:
+        with TRACER.stage("endpoint_recv"):
+            # the native-front backhaul forwards pre-serialized bytes
+            # verbatim; python-grpc listeners reserialize, so the raw path
+            # is front-only
+            raw_ok = bool(getattr(context, "kb_raw_ok", False))
+            if self.peers is not None:
+                self.peers.sync_read_revision()
+            # etcd range conventions: empty range_end = the single key;
+            # range_end == b"\0" = everything >= key ("from key")
+            range_end = bytes(request.range_end)
+            single_key = not range_end
+            if range_end == b"\x00":
+                range_end = b""
         try:
             if request.count_only:
                 if not self.backend.config.enable_etcd_compatibility:
@@ -93,7 +102,8 @@ class KVService:
                         request.key, range_end, request.revision,
                         client=self._client_of(context),
                     )
-                return rpc_pb2.RangeResponse(header=shim.header(rev), count=n)
+                with TRACER.stage("response_encode"):
+                    return rpc_pb2.RangeResponse(header=shim.header(rev), count=n)
             if request.revision == PARTITION_MAGIC_REVISION:
                 return self._partitions(request)
             if single_key:
@@ -153,29 +163,31 @@ class KVService:
             )
             if fast is not None:
                 blob, n, more, read_rev = fast
-                scalar = rpc_pb2.RangeResponse(
-                    header=shim.header(read_rev), more=more, count=n
-                ).SerializeToString()
-                return _RawResponse(scalar + blob)
+                with TRACER.stage("response_encode"):
+                    scalar = rpc_pb2.RangeResponse(
+                        header=shim.header(read_rev), more=more, count=n
+                    ).SerializeToString()
+                    return _RawResponse(scalar + blob)
         res = self.limiter.list_(
             request.key, range_end, request.revision, int(request.limit),
             client=client,
         )
-        resp = rpc_pb2.RangeResponse(
-            header=shim.header(res.revision), more=res.more, count=len(res.kvs)
-        )
-        kvs = res.kvs
-        # results are produced key-ascending; honor the sort options clients
-        # like etcdctl send (kube-apiserver always uses the default)
-        if request.sort_target == rpc_pb2.RangeRequest.MOD:
-            kvs = sorted(kvs, key=lambda kv: kv.revision)
-        if request.sort_order == rpc_pb2.RangeRequest.DESCEND:
-            kvs = list(reversed(kvs))
-        for kv in kvs:
-            if request.keys_only:
-                kv = type(kv)(kv.key, b"", kv.revision)
-            resp.kvs.append(shim.to_kv(kv))
-        return resp
+        with TRACER.stage("response_encode"):
+            resp = rpc_pb2.RangeResponse(
+                header=shim.header(res.revision), more=res.more, count=len(res.kvs)
+            )
+            kvs = res.kvs
+            # results are produced key-ascending; honor the sort options
+            # clients like etcdctl send (kube-apiserver uses the default)
+            if request.sort_target == rpc_pb2.RangeRequest.MOD:
+                kvs = sorted(kvs, key=lambda kv: kv.revision)
+            if request.sort_order == rpc_pb2.RangeRequest.DESCEND:
+                kvs = list(reversed(kvs))
+            for kv in kvs:
+                if request.keys_only:
+                    kv = type(kv)(kv.key, b"", kv.revision)
+                resp.kvs.append(shim.to_kv(kv))
+            return resp
 
     def _partitions(self, request) -> rpc_pb2.RangeResponse:
         """Partition borders as bare KeyValues (reference kv.go:54-57 +
@@ -190,23 +202,28 @@ class KVService:
 
     # -------------------------------------------------------------------- Txn
     def Txn(self, request: rpc_pb2.TxnRequest, context) -> rpc_pb2.TxnResponse:
-        if self.peers is not None and not self.peers.is_leader():
-            fwd = self.peers.forward_txn(request)
-            if fwd is not None:
-                return fwd
-            context.abort(grpc.StatusCode.UNAVAILABLE, "etcdserver: not leader")
-        m = self._match(request, context)
+        with TRACER.span("etcd.KV/Txn", traceparent=traceparent_of(context)):
+            return self._txn(request, context)
+
+    def _txn(self, request: rpc_pb2.TxnRequest, context) -> rpc_pb2.TxnResponse:
+        with TRACER.stage("endpoint_recv"):
+            if self.peers is not None and not self.peers.is_leader():
+                fwd = self.peers.forward_txn(request)
+                if fwd is not None:
+                    return fwd
+                context.abort(grpc.StatusCode.UNAVAILABLE, "etcdserver: not leader")
+            m = self._match(request, context)
         kind, key, guard_rev, value, ttl = m
         try:
-            if kind == "create":
-                rev = self.backend.create(key, value, ttl=ttl)
-                return self._txn_ok(rev, put=True)
-            if kind == "update":
-                rev = self.backend.update(key, value, guard_rev, ttl=ttl)
-                return self._txn_ok(rev, put=True)
-            # delete
-            rev, prev = self.backend.delete(key, guard_rev)
-            return self._txn_ok(rev, put=False)
+            with TRACER.stage("backend_write"):
+                if kind == "create":
+                    rev = self.backend.create(key, value, ttl=ttl)
+                elif kind == "update":
+                    rev = self.backend.update(key, value, guard_rev, ttl=ttl)
+                else:  # delete
+                    rev, _prev = self.backend.delete(key, guard_rev)
+            with TRACER.stage("response_encode"):
+                return self._txn_ok(rev, put=kind != "delete")
         except KeyExistsError as e:
             return self._txn_failed(request, e.revision)
         except (CASRevisionMismatchError,) as e:

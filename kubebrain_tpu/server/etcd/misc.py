@@ -92,9 +92,14 @@ class MaintenanceService:
         streamed batch-by-batch so the keyspace never materializes in full
         (backend.list_by_stream)."""
         from ...sched import ensure_scheduler
+        from ...trace import TRACER
 
-        # background lane: a snapshot dump must queue behind serving reads
-        rev, stream = ensure_scheduler(self.backend).list_by_stream(b"", b"")
+        # background lane: a snapshot dump must queue behind serving reads.
+        # Only the admission + initial dispatch is spanned — the stream
+        # drains across yields, and a span must not straddle a generator's
+        # suspension points (the contextvar would leak into the consumer).
+        with TRACER.span("etcd.Maintenance/Snapshot"):
+            rev, stream = ensure_scheduler(self.backend).list_by_stream(b"", b"")
         pending = b"KBSNAP1" + rev.to_bytes(8, "big")
         for batch in stream:
             frames = [pending]

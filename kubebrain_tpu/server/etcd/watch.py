@@ -21,6 +21,7 @@ import threading
 
 from ...backend import Backend, WatchExpiredError
 from ...proto import rpc_pb2
+from ...trace import emit_histogram
 from . import shim
 
 
@@ -181,7 +182,12 @@ class _WatchSession:
               progress_notify=False) -> None:
         import time as _time
 
-        last_sent = _time.monotonic()
+        # lag gate: only events committed after this pump started count
+        # toward the wire-lag histogram — replayed catch-up batches carry
+        # their ORIGINAL commit ts (possibly minutes old) and would record
+        # bogus multi-second lag on every reconnect-with-replay
+        registered = _time.monotonic()
+        last_sent = registered
         while not stop.is_set():
             try:
                 batch = q.get(timeout=0.5)
@@ -211,6 +217,14 @@ class _WatchSession:
             if resp is not None:
                 last_sent = _time.monotonic()
                 self._send(resp)
+                if batch[0].ts >= registered:
+                    # commit -> wire handoff for this watcher (the hub emits
+                    # the commit -> queue point; the spread between the two
+                    # is pump/backlog time)
+                    emit_histogram(
+                        "kb.watch.lag.seconds", last_sent - batch[0].ts,
+                        point="wire",
+                    )
 
     def _range_stream(self, creq, watch_id: int) -> None:
         """List delivered over the watch protocol (reference watcher.List,

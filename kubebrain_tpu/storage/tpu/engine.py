@@ -36,6 +36,7 @@ from ...ops import keys as keyops
 from ...ops.compact import victim_mask
 from ...ops.scan import lex_geq, lex_less, visibility_mask
 from ...parallel.mesh import make_mesh
+from ...trace import TRACER
 from .. import BatchWrite, CASFailedError, KvStorage, Partition, register_engine
 from ..errors import UncertainResultError
 from .blocks import (
@@ -448,26 +449,34 @@ class TpuScanner(Scanner):
         with self._mlock:
             mirror = self._mirror
             overlay = self._delta.overlay(start, end, read_revision)
-        mask, counts = self._dev_mask(mirror, start, end, read_revision)
-        total, idx = self._dev_visible_indices(
-            mask, counts, mirror.keys_host.shape[0] * mirror.keys_host.shape[1]
-        )
+        # device-time attribution: dispatch = query assembly + async kernel
+        # enqueue; compute = the first blocking device transfer (counts +
+        # index pull, which waits out the kernel); host_copy = row
+        # materialization + overlay merge on the host. device=True feeds
+        # the auto-depth RTT EWMAs — only this engine's kernel path does.
+        with TRACER.stage("device_dispatch", device=True):
+            mask, counts = self._dev_mask(mirror, start, end, read_revision)
+        with TRACER.stage("device_compute", device=True):
+            total, idx = self._dev_visible_indices(
+                mask, counts, mirror.keys_host.shape[0] * mirror.keys_host.shape[1]
+            )
         n_rows = mirror.keys_host.shape[1]
         from ...backend.common import KeyValue
 
-        kvs: list[KeyValue] = []
-        parts, rows = np.divmod(idx, n_rows)
-        for p in np.unique(parts):
-            p_rows = rows[parts == p]
-            keys, values, revs = mirror.materialize(int(p), p_rows)
-            for uk, val, rv in zip(keys, values, revs):
-                if uk in overlay:
-                    continue  # delta supersedes
-                kvs.append(KeyValue(uk, val, int(rv)))
-        for uk, entry in overlay.items():
-            if entry is not None:
-                kvs.append(KeyValue(uk, entry[1], entry[0]))
-        kvs.sort(key=lambda kv: kv.key)
+        with TRACER.stage("host_copy"):
+            kvs: list[KeyValue] = []
+            parts, rows = np.divmod(idx, n_rows)
+            for p in np.unique(parts):
+                p_rows = rows[parts == p]
+                keys, values, revs = mirror.materialize(int(p), p_rows)
+                for uk, val, rv in zip(keys, values, revs):
+                    if uk in overlay:
+                        continue  # delta supersedes
+                    kvs.append(KeyValue(uk, val, int(rv)))
+            for uk, entry in overlay.items():
+                if entry is not None:
+                    kvs.append(KeyValue(uk, entry[1], entry[0]))
+            kvs.sort(key=lambda kv: kv.key)
         if limit:
             return kvs[:limit], len(kvs) > limit
         return kvs, False
@@ -539,9 +548,11 @@ class TpuScanner(Scanner):
         with self._mlock:
             mirror = self._mirror
             overlay = self._delta.overlay(start, end, read_revision)
-        _, counts = self._dev_mask(mirror, start, end, read_revision)
-        counts = np.asarray(counts)
-        total = int(counts.sum())
+        with TRACER.stage("device_dispatch", device=True):
+            _, counts = self._dev_mask(mirror, start, end, read_revision)
+        with TRACER.stage("device_compute", device=True):
+            counts = np.asarray(counts)
+            total = int(counts.sum())
         for uk, entry in overlay.items():
             had = self._host_visible(mirror, uk, read_revision)
             if entry is None and had:

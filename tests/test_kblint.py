@@ -279,9 +279,52 @@ def test_trailing_code_pragma_does_not_leak_to_next_line():
     assert ids(src, EP) == ["KB101"]
 
 
+# ------------------------------------------------------------------- KB107
+def test_kb107_flags_print_on_serving_path():
+    src = "def f(x):\n    print(x)\n"
+    assert ids(src, SRV_ETCD) == ["KB107"]
+    assert ids(src, EP) == ["KB107"]
+    assert ids(src, "kubebrain_tpu/sched/x.py") == ["KB107"]
+
+
+def test_kb107_flags_raw_time_time_latency():
+    assert ids(
+        "import time\ndef f(t0):\n    return time.time() - t0\n", SRV_ETCD
+    ) == ["KB107"]
+    assert ids(
+        "import time as _time\ndef f(t0):\n    d = _time.time() - t0\n", EP
+    ) == ["KB107"]
+    # either side of the subtraction counts
+    assert ids(
+        "import time\ndef f(t1):\n    return t1 - time.time()\n", SRV_ETCD
+    ) == ["KB107"]
+
+
+def test_kb107_allows_monotonic_and_non_latency_time():
+    # monotonic()/perf_counter() deltas are the correct clock — allowed
+    assert ids(
+        "import time\ndef f(t0):\n    return time.monotonic() - t0\n", SRV_ETCD
+    ) == []
+    # time.time() not in a subtraction (timestamps, dir names) is fine
+    assert ids(
+        "import time\ndef f():\n    return f'/tmp/p-{int(time.time())}'\n",
+        SRV_ETCD,
+    ) == []
+    assert ids("import time\ndef f(rec):\n    return rec.expired(time.time())\n",
+               SRV_ETCD) == []
+
+
+def test_kb107_scoped_and_suppressible():
+    src = "def f(x):\n    print(x)\n"
+    assert ids(src, ANY) == []  # backend/ etc. are out of scope
+    sup = "def f(x):\n    print(x)  # kblint: disable=KB107\n"
+    assert ids(sup, SRV_ETCD) == []
+
+
 # ------------------------------------------------------------ registry/CLI
 def test_registry_has_all_rules():
-    assert set(RULES) == {"KB101", "KB102", "KB103", "KB104", "KB105", "KB106"}
+    assert set(RULES) == {"KB101", "KB102", "KB103", "KB104", "KB105", "KB106",
+                          "KB107"}
     for rule in RULES.values():
         assert rule.summary
 

@@ -10,6 +10,14 @@ import urllib.request
 import grpc
 import pytest
 
+try:
+    import cryptography  # noqa: F401  -- cert generation dependency
+except ImportError:
+    pytest.skip(
+        "cryptography not installed in this image (needed to generate the "
+        "self-signed test certs)", allow_module_level=True,
+    )
+
 from kubebrain_tpu.cli import build_endpoint, build_parser
 from kubebrain_tpu.proto import rpc_pb2
 

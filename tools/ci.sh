@@ -6,23 +6,31 @@
 #                         byte-identical scheduled-path check; fast, and a
 #                         scheduler regression should fail before the long
 #                         tier-1 run, not 10 minutes into it)
-#   4. tier-1 pytest    — the ROADMAP.md verify command
+#   4. observability    — trace/span tests + a live-server smoke: one Range
+#                         must populate /debug/traces and the
+#                         kb_rpc_stage_seconds histogram
+#   5. tier-1 pytest    — the ROADMAP.md verify command
 # Run from anywhere; operates on the repo this script lives in.
 
 set -uo pipefail
 
 cd "$(dirname "$0")/.."
 
-echo "=== [1/4] make lint"
+echo "=== [1/5] make lint"
 make lint || exit 1
 
-echo "=== [2/4] make typecheck"
+echo "=== [2/5] make typecheck"
 make typecheck || exit 1
 
-echo "=== [3/4] scheduler semantics + bench-smoke (CPU fallback)"
+echo "=== [3/5] scheduler semantics + bench-smoke (CPU fallback)"
 env JAX_PLATFORMS=cpu python -m pytest tests/test_sched.py -q -m 'not slow' \
     -p no:cacheprovider || exit 1
 make bench-smoke || exit 1
 
-echo "=== [4/4] tier-1 tests (ROADMAP.md verify, one definition: make test-tier1)"
+echo "=== [4/5] request tracing: span tests + live-server /debug/traces smoke"
+env JAX_PLATFORMS=cpu python -m pytest tests/test_trace.py -q -m 'not slow' \
+    -p no:cacheprovider || exit 1
+env JAX_PLATFORMS=cpu python tools/smoke_trace.py || exit 1
+
+echo "=== [5/5] tier-1 tests (ROADMAP.md verify, one definition: make test-tier1)"
 exec make test-tier1

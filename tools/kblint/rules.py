@@ -197,6 +197,60 @@ class NoHostSyncInJit(Rule):
                     yield inner, f"block_until_ready() inside jitted {node.name!r}"
 
 
+_TIME_TIME_MODULES = re.compile(r"^_?time$")
+
+
+def _is_time_time(call: ast.Call) -> bool:
+    """``time.time()`` (including aliased imports like ``_time.time()``)."""
+    func = call.func
+    return (
+        isinstance(func, ast.Attribute)
+        and func.attr == "time"
+        and isinstance(func.value, ast.Name)
+        and bool(_TIME_TIME_MODULES.match(func.value.id))
+    )
+
+
+def _contains_time_time(expr: ast.expr) -> bool:
+    return any(
+        isinstance(n, ast.Call) and _is_time_time(n) for n in ast.walk(expr)
+    )
+
+
+@register
+class NoPrintOrRawLatency(Rule):
+    """Serving-path observability goes through the tracer/metrics facade:
+    ``print()`` writes to a stdout nobody scrapes (and blocks on a full
+    pipe), and hand-rolled ``time.time() - t0`` latency math measures wall
+    clock (jumps on NTP steps) and is invisible to /metrics and
+    /debug/traces. Use ``trace.TRACER.stage(...)``/``record_stage`` or
+    ``metrics.timed(...)``/``emit_histogram``."""
+
+    rule_id = "KB107"
+    summary = ("no print() and no raw time.time() latency measurement in "
+               "server/, sched/, endpoint/ — use trace/metrics helpers")
+
+    def applies(self, relpath: str) -> bool:
+        return relpath.replace("\\", "/").startswith(
+            ("kubebrain_tpu/server/", "kubebrain_tpu/sched/",
+             "kubebrain_tpu/endpoint/")
+        )
+
+    def check(self, tree: ast.Module, src: str) -> Iterable[tuple[ast.AST, str]]:
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call) and isinstance(node.func, ast.Name) \
+                    and node.func.id == "print":
+                yield node, ("print() on the serving path; use logging or "
+                             "the metrics/trace facade")
+            elif isinstance(node, ast.BinOp) and isinstance(node.op, ast.Sub):
+                if _contains_time_time(node.left) or _contains_time_time(node.right):
+                    yield node, (
+                        "raw time.time() latency measurement; use "
+                        "trace.TRACER.stage()/metrics.timed() (monotonic, "
+                        "lands on /metrics and /debug/traces)"
+                    )
+
+
 _REV_TOKENS = {"rev", "revision"}
 
 
