@@ -23,6 +23,17 @@ def etcd_compat():
     # huge ranges: one stream per storage partition, merged in key order
     for kv in c.parallel_list(b"/registry/demo/", b"/registry/demo0"):
         print("par:", kv.key)
+
+    # leases: grant + background keepalive (jittered, watchdog-fenced),
+    # attach a key, inspect, then revoke — the key is deleted as a normal
+    # watch-visible MVCC tombstone
+    h = c.lease(ttl=5)
+    ok, rev = c.create(b"/registry/demo/leased", b'{"held": true}', lease=h.id)
+    assert ok and h.alive
+    ttl, granted, keys = c.lease_time_to_live(h.id, keys=True)
+    print("lease:", h.id, "ttl:", ttl, "/", granted, "keys:", keys)
+    h.revoke()  # stops the keepalive thread, deletes /registry/demo/leased
+    assert c.get(b"/registry/demo/leased") is None
     c.close()
 
 

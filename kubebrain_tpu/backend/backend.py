@@ -186,16 +186,23 @@ class Backend:
         batch.put(LAST_REV_KEY, last_val)
         batch.commit()
 
-    def create(self, user_key: bytes, value: bytes, ttl: int | None = None) -> int:
+    def create(self, user_key: bytes, value: bytes, ttl: int | None = None,
+               lease: int = 0) -> int:
         """Insert; returns the new revision. KeyExistsError carries the live
         revision on conflict. Reference txn.go:33 + creator/naive.go:53.
-        ``ttl`` overrides the key-pattern TTL (etcd lease attachment)."""
+        ``ttl`` overrides the key-pattern TTL; ``lease`` attaches the key to
+        a lease (kubebrain_tpu/lease) — expiry then happens via the reaper's
+        revision-stamped delete, NOT an engine TTL, so it always wins over
+        both."""
+        if lease:
+            ttl = self._lease_ttl(lease)  # raises LeaseNotFoundError
         rev = self.tso.deal()
         event = WatchEvent(revision=rev, verb=Verb.CREATE, key=user_key, value=value, valid=False)
         revealed = 0
         try:
             creator.create(self._commit_write, user_key, value, rev, ttl=ttl)
             event.valid = True
+            self._lease_attach(user_key, lease)
             return rev
         except KeyExistsError as e:
             revealed = e.revision or -1  # rev-0 conflicts still fence
@@ -214,12 +221,16 @@ class Backend:
                 self._await_revealed(revealed)
 
     def update(
-        self, user_key: bytes, value: bytes, expected_revision: int, ttl: int | None = None
+        self, user_key: bytes, value: bytes, expected_revision: int,
+        ttl: int | None = None, lease: int = 0,
     ) -> int:
         """Conditional overwrite: CAS(revision_key, expected→new) + Put(object).
         Reference txn.go:193-265. On revision mismatch raises
         CASRevisionMismatchError carrying the latest (revision, value) —
-        re-read via the conflict fast path (txn.go:225-241)."""
+        re-read via the conflict fast path (txn.go:225-241). ``lease``
+        re-attaches the key (0 = detach, etcd put-without-lease)."""
+        if lease:
+            ttl = self._lease_ttl(lease)  # raises LeaseNotFoundError
         rev = self.tso.deal()
         event = WatchEvent(
             revision=rev, verb=Verb.PUT, key=user_key, value=value,
@@ -239,6 +250,7 @@ class Backend:
                 value, ttl,
             )
             event.valid = True
+            self._lease_reattach(user_key, lease)
             return rev
         except CASFailedError as e:
             observed = e.conflict.value if e.conflict else None
@@ -304,6 +316,7 @@ class Backend:
                 TOMBSTONE, 0,
             )
             event.valid = True
+            self._lease_detach(user_key)
             return rev, KeyValue(user_key, prev_value or b"", latest_rev)
         except CASFailedError as e:
             observed = e.conflict.value if e.conflict else None
@@ -352,6 +365,7 @@ class Backend:
             event.prev_revision = latest
             event.prev_value = prev
             event.valid = True
+            self._lease_detach(user_key)
             return rev, KeyValue(user_key, prev or b"", latest)
         except RevisionDriftBackError as e:
             # engine-level drift (a concurrent write drew >= our revision):
@@ -647,6 +661,48 @@ class Backend:
             self.watch_cache.add(e)
         self.watcher_hub.stream(batch)
 
+    # ============================================================ lease hooks
+    # (the lease subsystem attaches a registry as ``_kb_lease`` via
+    # lease.ensure_lease; without one, PutRequest.lease degrades to the
+    # legacy ID:=TTL interpretation for raw embedders)
+    def _lease_ttl(self, lease: int) -> int:
+        """Engine TTL for a write under ``lease``. With the registry armed
+        the answer is always 0: expiry must be the reaper's revision-stamped
+        MVCC delete, never a silent engine-level drop — an explicit lease
+        beats every key-pattern TTL (creator.ttl_for_key precedence,
+        docs/storage_engine.md)."""
+        reg = getattr(self, "_kb_lease", None)
+        if reg is None:
+            return int(lease)  # legacy stub semantics: the lease id IS its TTL
+        reg.require(lease)  # LeaseNotFoundError for unknown/expired leases
+        return 0
+
+    def _lease_attach(self, user_key: bytes, lease: int) -> None:
+        reg = getattr(self, "_kb_lease", None)
+        if reg is None or not lease:
+            return
+        try:
+            reg.attach(lease, user_key)
+        except Exception:
+            # the lease was revoked between require() and commit: the write
+            # stands (etcd's applier has the same window, serialized only
+            # by raft ordering) and the next put/delete re-binds the key
+            pass
+
+    def _lease_reattach(self, user_key: bytes, lease: int) -> None:
+        reg = getattr(self, "_kb_lease", None)
+        if reg is None:
+            return
+        try:
+            reg.reattach(user_key, lease)
+        except Exception:
+            pass  # same revoke race as _lease_attach
+
+    def _lease_detach(self, user_key: bytes) -> None:
+        reg = getattr(self, "_kb_lease", None)
+        if reg is not None:
+            reg.detach_key(user_key)
+
     # ============================================================ retry support
     def _read_rev_record(self, user_key: bytes) -> tuple[int, bool] | None:
         try:
@@ -713,6 +769,11 @@ class Backend:
         return read_rev
 
     def close(self) -> None:
+        # the lease reaper issues deletes through this backend: stop it (and
+        # checkpoint remaining TTLs) while the sequencer is still alive
+        reaper = getattr(self, "_kb_lease_reaper", None)
+        if reaper is not None:
+            reaper.close()
         # the request scheduler (sched.ensure_scheduler attaches it here)
         # must unblock queued readers before the scan pipeline goes away
         sched = getattr(self, "_kb_scheduler", None)

@@ -64,6 +64,9 @@ TOMBSTONE = b"\x00kb_tombstone\x00"
 META_PREFIX = b"!kb_meta/"
 COMPACT_KEY = META_PREFIX + b"compact"
 ELECTION_KEY = META_PREFIX + b"election"
+# The lease registry's checkpoint row (kubebrain_tpu/lease): ids, granted
+# TTLs, remaining-TTL-at-checkpoint, and key attachments, length-framed.
+LEASE_STATE_KEY = META_PREFIX + b"lease_state"
 # Highest successfully-committed revision, updated inside every write batch.
 # A new leader seeds its sequencer from this + the election record clock so
 # revision numbers are never re-dealt across terms (the reference gets this

@@ -29,9 +29,19 @@ from .errors import FutureRevisionError, KeyExistsError
 EVENTS_TTL_PREFIX = b"/events/"
 EVENTS_TTL_SECONDS = 3600
 
+#: The reference's key-pattern TTL (util.go:28-42, lease.go) — demoted to a
+#: flag-gated fallback now that real leases exist (kubebrain_tpu/lease).
+#: Precedence (docs/storage_engine.md): an explicit ``PutRequest.lease``
+#: always wins (Backend._lease_ttl returns 0 — reaper-owned expiry); the
+#: pattern applies only to lease-less writes, and only while this flag is
+#: on (``--legacy-ttl-patterns``, default on for kube-apiserver compat).
+LEGACY_TTL_PATTERNS = True
+
 
 def ttl_for_key(user_key: bytes) -> int:
-    """TTL is by key pattern, not lease (reference util.go:28-42, lease.go)."""
+    """Key-pattern TTL fallback for writes without an explicit lease."""
+    if not LEGACY_TTL_PATTERNS:
+        return 0
     return EVENTS_TTL_SECONDS if user_key.startswith(EVENTS_TTL_PREFIX) else 0
 
 

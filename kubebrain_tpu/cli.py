@@ -103,6 +103,22 @@ def build_parser() -> argparse.ArgumentParser:
                    help="native C++ gRPC/HTTP frontend (kbfront) on this port: "
                         "single-port h2+http demux (reference cmux) with the "
                         "protocol work in C++; 0 = off")
+    p.add_argument("--lease-reap-interval", type=float, default=1.0,
+                   help="lease subsystem: leader-only reaper cadence; expired "
+                        "leases' keys become revision-stamped deletes through "
+                        "the sequencer (watch-visible, compaction-safe)")
+    p.add_argument("--lease-checkpoint-interval", type=float, default=5.0,
+                   help="lease subsystem: cadence for persisting remaining "
+                        "TTLs + attachments through the storage engine "
+                        "(grant/revoke checkpoint synchronously; this covers "
+                        "keepalive-refreshed deadlines)")
+    p.add_argument("--legacy-ttl-patterns", action=argparse.BooleanOptionalAction,
+                   default=True,
+                   help="key-pattern TTL fallback (/events/ = 1h, the "
+                        "reference's lease.go behavior) for writes WITHOUT an "
+                        "explicit lease; an attached lease always wins. "
+                        "--no-legacy-ttl-patterns makes leases the only "
+                        "expiry mechanism")
     p.add_argument("--cluster-name", default="")
     p.add_argument("--compact-interval", type=float, default=60.0)
     p.add_argument("--jax-platform", default=os.environ.get("KB_JAX_PLATFORM", ""),
@@ -146,6 +162,10 @@ def validate_args(args) -> None:
         raise SystemExit("--sched-shed-ms must be > 0")
     if getattr(args, "trace_slow_ms", 0.0) < 0:
         raise SystemExit("--trace-slow-ms must be >= 0")
+    if getattr(args, "lease_reap_interval", 1.0) <= 0 or \
+            getattr(args, "lease_checkpoint_interval", 1.0) <= 0:
+        raise SystemExit("--lease-reap-interval and --lease-checkpoint-interval "
+                         "must be > 0")
     if args.data_dir and not (
         args.storage == "native" or (args.storage == "tpu" and args.inner_storage == "native")
     ):
@@ -243,6 +263,21 @@ def build_endpoint(args):
         peers = PeerService(
             backend, identity, args.client_port, enable_proxy=args.enable_etcd_proxy
         )
+
+    # lease subsystem: key-pattern TTLs demoted to a flag-gated fallback
+    # (explicit PutRequest.lease always wins); registry + leader-only reaper
+    # created here with the flag-derived cadences so every service surface
+    # shares one table (later ensure_lease calls adopt it)
+    from .backend import creator
+    from .lease import ensure_lease
+
+    creator.LEGACY_TTL_PATTERNS = bool(
+        getattr(args, "legacy_ttl_patterns", True))
+    ensure_lease(
+        backend, peers=peers, metrics=metrics,
+        reap_interval=args.lease_reap_interval,
+        checkpoint_interval=args.lease_checkpoint_interval,
+    )
     server = Server(
         backend, peers, metrics, identity,
         client_urls=[f"http://{identity.rsplit(':', 1)[0]}:{args.client_port}"],

@@ -67,6 +67,20 @@ class EtcdCompatClient:
             response_deserializer=p.WatchResponse.FromString,
         )
         self._watch = _traced_call(raw_watch)
+        self._lease_grant = self._unary(
+            "/etcdserverpb.Lease/LeaseGrant", p.LeaseGrantRequest, p.LeaseGrantResponse)
+        self._lease_revoke = self._unary(
+            "/etcdserverpb.Lease/LeaseRevoke", p.LeaseRevokeRequest, p.LeaseRevokeResponse)
+        self._lease_ttl = self._unary(
+            "/etcdserverpb.Lease/LeaseTimeToLive",
+            p.LeaseTimeToLiveRequest, p.LeaseTimeToLiveResponse)
+        self._lease_leases = self._unary(
+            "/etcdserverpb.Lease/LeaseLeases", p.LeaseLeasesRequest, p.LeaseLeasesResponse)
+        self._lease_keepalive = _traced_call(self.channel.stream_stream(
+            "/etcdserverpb.Lease/LeaseKeepAlive",
+            request_serializer=p.LeaseKeepAliveRequest.SerializeToString,
+            response_deserializer=p.LeaseKeepAliveResponse.FromString,
+        ))
 
     def _unary(self, method, req, resp):
         return _traced_call(self.channel.unary_unary(
@@ -76,15 +90,17 @@ class EtcdCompatClient:
         ))
 
     # --------------------------------------------------------------- writes
-    def create(self, key: bytes, value: bytes) -> tuple[bool, int]:
+    def create(self, key: bytes, value: bytes, lease: int = 0) -> tuple[bool, int]:
         """(succeeded, revision) — revision is the new mod revision on
-        success, the existing one on conflict."""
+        success, the existing one on conflict. ``lease`` attaches the key
+        to a granted lease (see :meth:`lease`)."""
         req = rpc_pb2.TxnRequest()
         c = req.compare.add()
         c.result, c.target, c.key, c.mod_revision = (
             rpc_pb2.Compare.EQUAL, rpc_pb2.Compare.MOD, key, 0,
         )
-        req.success.add().request_put.CopyFrom(rpc_pb2.PutRequest(key=key, value=value))
+        req.success.add().request_put.CopyFrom(
+            rpc_pb2.PutRequest(key=key, value=value, lease=lease))
         req.failure.add().request_range.CopyFrom(rpc_pb2.RangeRequest(key=key))
         r = self._txn(req)
         if r.succeeded:
@@ -92,13 +108,15 @@ class EtcdCompatClient:
         kvs = r.responses[0].response_range.kvs
         return False, kvs[0].mod_revision if kvs else 0
 
-    def update(self, key: bytes, value: bytes, mod_revision: int) -> tuple[bool, int]:
+    def update(self, key: bytes, value: bytes, mod_revision: int,
+               lease: int = 0) -> tuple[bool, int]:
         req = rpc_pb2.TxnRequest()
         c = req.compare.add()
         c.result, c.target, c.key, c.mod_revision = (
             rpc_pb2.Compare.EQUAL, rpc_pb2.Compare.MOD, key, mod_revision,
         )
-        req.success.add().request_put.CopyFrom(rpc_pb2.PutRequest(key=key, value=value))
+        req.success.add().request_put.CopyFrom(
+            rpc_pb2.PutRequest(key=key, value=value, lease=lease))
         req.failure.add().request_range.CopyFrom(rpc_pb2.RangeRequest(key=key))
         r = self._txn(req)
         if r.succeeded:
@@ -210,6 +228,42 @@ class EtcdCompatClient:
         finally:
             requests.put(None)
 
+    # ---------------------------------------------------------------- leases
+    def lease_grant(self, ttl: int, lease_id: int = 0) -> tuple[int, int]:
+        """Grant a lease; returns (id, granted_ttl_seconds)."""
+        r = self._lease_grant(rpc_pb2.LeaseGrantRequest(TTL=ttl, ID=lease_id))
+        return r.ID, r.TTL
+
+    def lease_revoke(self, lease_id: int) -> None:
+        """Revoke: every attached key is deleted (watch-visible tombstones)."""
+        self._lease_revoke(rpc_pb2.LeaseRevokeRequest(ID=lease_id))
+
+    def lease_time_to_live(self, lease_id: int, keys: bool = False
+                           ) -> tuple[int, int, list[bytes]]:
+        """(remaining_ttl, granted_ttl, attached_keys). remaining_ttl is -1
+        once the lease is expired or unknown."""
+        r = self._lease_ttl(rpc_pb2.LeaseTimeToLiveRequest(ID=lease_id, keys=keys))
+        return r.TTL, r.grantedTTL, list(r.keys)
+
+    def lease_leases(self) -> list[int]:
+        return [l.ID for l in self._lease_leases(rpc_pb2.LeaseLeasesRequest()).leases]
+
+    def lease(self, ttl: int, keepalive_interval: float | None = None,
+              ready_timeout: float = 30.0) -> "LeaseHandle":
+        """Grant a lease and keep it alive from a background thread.
+
+        The thread pings on a jittered cadence (default TTL/3 ±20% — a
+        fleet of clients granted in the same instant must not land their
+        keepalives in the same instant forever). Like :meth:`watch`, the
+        first keepalive ack is fenced by a stack-dumping watchdog: if the
+        server doesn't ack within ``ready_timeout`` every thread's stack is
+        dumped and the stream cancelled, instead of a silent wedge that
+        surfaces minutes later as an expired lease."""
+        lease_id, granted = self.lease_grant(ttl)
+        interval = keepalive_interval if keepalive_interval is not None \
+            else max(granted / 3.0, 0.5)
+        return LeaseHandle(self, lease_id, granted, interval, ready_timeout)
+
     # ---------------------------------------------------------------- watch
     def watch(
         self, key: bytes, range_end: bytes = b"", start_revision: int = 0,
@@ -306,6 +360,100 @@ class EtcdCompatClient:
 
     def close(self) -> None:
         self.channel.close()
+
+
+class LeaseHandle:
+    """A granted lease plus its background keepalive thread (see
+    EtcdCompatClient.lease). ``alive`` flips False once the server reports
+    the lease gone (TTL<=0 on the keepalive stream) or the stream dies."""
+
+    def __init__(self, client: EtcdCompatClient, lease_id: int, ttl: int,
+                 interval: float, ready_timeout: float):
+        self.id = lease_id
+        self.ttl = ttl
+        self._interval = interval
+        self._stop = threading.Event()
+        self._expired = threading.Event()
+        self._requests: queue.Queue = queue.Queue()
+        self._responses = client._lease_keepalive(iter(self._requests.get, None))
+        self._client = client
+        self._rpc_error = grpc.RpcError  # closure-bound, survives teardown
+
+        # first ping under the watchdog: prove the stream is live before
+        # handing back a handle the caller will trust for TTL seconds
+        fired = [False]
+        done = [False]
+        lock = threading.Lock()
+
+        def _ack_watchdog():
+            import faulthandler
+            import sys
+
+            with lock:
+                if done[0]:
+                    return
+                fired[0] = True
+            sys.__stderr__.write(
+                f"[client.lease] no keepalive ack within {ready_timeout}s; "
+                "dumping all thread stacks and cancelling the stream\n")
+            faulthandler.dump_traceback(file=sys.__stderr__)
+            sys.__stderr__.flush()
+            self._responses.cancel()
+
+        watchdog = threading.Timer(ready_timeout, _ack_watchdog)
+        watchdog.daemon = True
+        watchdog.start()
+        try:
+            self._ping()
+        except (self._rpc_error, StopIteration) as e:
+            raise TimeoutError(
+                f"lease keepalive stream not acked by server: {e}") from e
+        finally:
+            with lock:
+                done[0] = True
+            watchdog.cancel()
+        if fired[0]:
+            raise TimeoutError(
+                "lease keepalive stream cancelled by the registration watchdog")
+
+        self._thread = threading.Thread(
+            target=self._keepalive_loop, name="kb-lease-keepalive", daemon=True)
+        self._thread.start()
+
+    def _ping(self) -> int:
+        self._requests.put(rpc_pb2.LeaseKeepAliveRequest(ID=self.id))
+        resp = next(self._responses)
+        if resp.TTL <= 0:
+            self._expired.set()
+        return resp.TTL
+
+    def _keepalive_loop(self) -> None:
+        import random
+
+        while not self._stop.wait(self._interval * random.uniform(0.8, 1.2)):
+            try:
+                if self._ping() <= 0:
+                    return  # lease gone server-side; don't spin on a corpse
+            except (self._rpc_error, StopIteration):
+                if not self._stop.is_set():
+                    self._expired.set()
+                return
+
+    @property
+    def alive(self) -> bool:
+        return not self._expired.is_set()
+
+    def revoke(self) -> None:
+        """Stop keepalives and revoke: attached keys are deleted now."""
+        self.close()
+        self._client.lease_revoke(self.id)
+
+    def close(self) -> None:
+        """Stop keepalives; the lease then expires naturally server-side."""
+        self._stop.set()
+        self._requests.put(None)
+        if self._thread.is_alive():
+            self._thread.join(timeout=5.0)
 
 
 class BrainClient:

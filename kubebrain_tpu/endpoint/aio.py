@@ -348,20 +348,26 @@ class AioWatchService:
                 self.backend.unwatch(wid)
 
 
-def _aio_lease_keepalive(backend):
+def _aio_lease_keepalive(lease):
+    """Coroutine keepalive stream over the shared LeaseService: the refresh
+    goes through the scheduler's SYSTEM lane (a blocking submit), so it runs
+    in the executor — the loop thread must never block on admission."""
+    from ..server.etcd.misc import ERR_NOT_LEADER, LeaseNotLeaderError
+
     async def handler(request_iterator, context):
+        loop = asyncio.get_running_loop()
         async for req in request_iterator:
-            yield rpc_pb2.LeaseKeepAliveResponse(
-                header=shim.header(backend.current_revision()),
-                ID=req.ID, TTL=req.ID,
-            )
+            try:
+                yield await loop.run_in_executor(None, lease.keepalive_one, req)
+            except LeaseNotLeaderError:
+                await context.abort(grpc.StatusCode.UNAVAILABLE, ERR_NOT_LEADER)
 
     return handler
 
 
 def make_aio_handlers(backend, peers=None, identity="kubebrain-tpu"):
     kv = KVService(backend, peers)
-    lease = LeaseService(backend)
+    lease = LeaseService(backend, peers)
     cluster = ClusterService(backend, identity)
     maint = MaintenanceService(backend)
     watch = AioWatchService(backend, peers)
@@ -393,10 +399,12 @@ def make_aio_handlers(backend, peers=None, identity="kubebrain-tpu"):
             "LeaseGrant": unary(lease.LeaseGrant, p.LeaseGrantRequest, p.LeaseGrantResponse),
             "LeaseRevoke": unary(lease.LeaseRevoke, p.LeaseRevokeRequest, p.LeaseRevokeResponse),
             "LeaseKeepAlive": grpc.stream_stream_rpc_method_handler(
-                _aio_lease_keepalive(backend),
+                _aio_lease_keepalive(lease),
                 request_deserializer=p.LeaseKeepAliveRequest.FromString,
                 response_serializer=p.LeaseKeepAliveResponse.SerializeToString,
             ),
+            "LeaseTimeToLive": unary(lease.LeaseTimeToLive, p.LeaseTimeToLiveRequest, p.LeaseTimeToLiveResponse),
+            "LeaseLeases": unary(lease.LeaseLeases, p.LeaseLeasesRequest, p.LeaseLeasesResponse),
         }),
         grpc.method_handlers_generic_handler("etcdserverpb.Cluster", {
             "MemberList": unary(cluster.MemberList, p.MemberListRequest, p.MemberListResponse),

@@ -80,7 +80,7 @@ class FrontServer:
         self.identity = identity
         self.metrics = metrics
         self.kv = KVService(backend, peers)
-        self.lease = LeaseService(backend)
+        self.lease = LeaseService(backend, peers)
         self.cluster = ClusterService(backend, identity)
         self.maint = MaintenanceService(backend)
         self.watch = AioWatchService(backend, peers)
@@ -123,6 +123,8 @@ class FrontServer:
         u("/etcdserverpb.KV/DeleteRange", p.DeleteRangeRequest, self.kv.DeleteRange)
         u("/etcdserverpb.Lease/LeaseGrant", p.LeaseGrantRequest, self.lease.LeaseGrant)
         u("/etcdserverpb.Lease/LeaseRevoke", p.LeaseRevokeRequest, self.lease.LeaseRevoke)
+        u("/etcdserverpb.Lease/LeaseTimeToLive", p.LeaseTimeToLiveRequest, self.lease.LeaseTimeToLive)
+        u("/etcdserverpb.Lease/LeaseLeases", p.LeaseLeasesRequest, self.lease.LeaseLeases)
         u("/etcdserverpb.Cluster/MemberList", p.MemberListRequest, self.cluster.MemberList)
         u("/etcdserverpb.Maintenance/Status", p.StatusRequest, self.maint.Status)
         u("/etcdserverpb.Maintenance/Defragment", p.DefragmentRequest, self.maint.Defragment)
@@ -431,13 +433,25 @@ class FrontServer:
             if path == "/etcdserverpb.Watch/Watch":
                 await self._run_watch(cid, sid, st)
             elif path == "/etcdserverpb.Lease/LeaseKeepAlive":
+                from ..server.etcd.misc import ERR_NOT_LEADER, LeaseNotLeaderError
+
+                loop = asyncio.get_running_loop()
                 while True:
                     raw = await st.queue.get()
                     if raw is None:
                         break
                     req = rpc_pb2.LeaseKeepAliveRequest.FromString(raw)
-                    resp = rpc_pb2.LeaseKeepAliveResponse(
-                        header=self._header(), ID=req.ID, TTL=req.ID)
+                    # real refresh via the shared registry; the scheduler
+                    # SYSTEM-lane submit blocks, so keep it off the loop
+                    try:
+                        resp = await loop.run_in_executor(
+                            None, self.lease.keepalive_one, req)
+                    except LeaseNotLeaderError:
+                        self._send_end(
+                            cid, sid,
+                            _status_num(grpc.StatusCode.UNAVAILABLE),
+                            ERR_NOT_LEADER)
+                        return
                     self._send(cid, sid, K_MSG, resp.SerializeToString())
                 self._send_end(cid, sid, 0)
             elif path in self.sstream:

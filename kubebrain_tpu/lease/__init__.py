@@ -1,0 +1,64 @@
+"""The lease subsystem: real etcd lease semantics over the MVCC core.
+
+Grant/Revoke/KeepAlive/TimeToLive/Leases are served from a monotonic-clock
+TTL state machine (registry.py over clock.py), key↔lease attachment is
+driven by ``PutRequest.lease`` in the backend write path, and expiry is a
+leader-only reaper (reaper.py) that turns each expired lease's keys into
+revision-stamped deletes through the sequencer — MVCC-visible,
+compaction-safe, and emitting normal WatchEvents.
+
+``ensure_lease`` mirrors ``sched.ensure_scheduler``: one registry + reaper
+per backend, first caller wins (cli.build_endpoint calls it early with the
+flag-derived intervals, peers, and real metrics).
+
+See docs/leases.md for the state machine, reaper design, and metrics.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from .reaper import DEFAULT_CHECKPOINT_INTERVAL, DEFAULT_REAP_INTERVAL, LeaseReaper
+from .registry import Lease, LeaseExistsError, LeaseNotFoundError, LeaseRegistry
+
+__all__ = [
+    "Lease",
+    "LeaseExistsError",
+    "LeaseNotFoundError",
+    "LeaseRegistry",
+    "LeaseReaper",
+    "ensure_lease",
+    "DEFAULT_REAP_INTERVAL",
+    "DEFAULT_CHECKPOINT_INTERVAL",
+]
+
+_ENSURE_LOCK = threading.Lock()
+
+
+def ensure_lease(backend, peers=None, metrics=None,
+                 reap_interval: float = DEFAULT_REAP_INTERVAL,
+                 checkpoint_interval: float = DEFAULT_CHECKPOINT_INTERVAL,
+                 ) -> LeaseRegistry:
+    """The process-wide lease registry for ``backend``: every service
+    surface (sync etcd, aio, native front) must share one table or
+    attachments and expiry drift apart. Creates + starts the reaper on
+    first call; ``Backend.close`` closes it (final checkpoint included)."""
+    reg = getattr(backend, "_kb_lease", None)
+    if reg is not None:
+        return reg
+    with _ENSURE_LOCK:
+        reg = getattr(backend, "_kb_lease", None)
+        if reg is None:
+            reg = LeaseRegistry(backend.store, metrics=metrics)
+            reaper = LeaseReaper(
+                backend, reg, peers=peers,
+                reap_interval=reap_interval,
+                checkpoint_interval=checkpoint_interval,
+            )
+            # reaper first: the lock-free fast path returns as soon as
+            # _kb_lease is visible, and LeaseService reads _kb_lease_reaper
+            # right after — publishing in the other order races it
+            backend._kb_lease_reaper = reaper
+            backend._kb_lease = reg
+            reaper.start()
+    return reg

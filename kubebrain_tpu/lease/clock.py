@@ -1,0 +1,38 @@
+"""The lease clock — the ONLY serving-path module allowed to do TTL /
+deadline arithmetic (kblint KB108).
+
+Lease TTLs are *durations*, not wall-clock instants: an NTP step (or a VM
+suspend/resume wall-clock jump) must neither mass-expire every lease nor
+grant them hours of free life. etcd's lessor learned this the hard way
+(leases keyed on ``time.Now()`` revoked en masse on clock steps); the fix
+there and here is the same — all live deadlines are points on the
+**monotonic** clock, and wall time never enters the arithmetic.
+
+Persistence converts deadlines to *remaining seconds* (a duration survives
+a reboot; a monotonic instant does not) and back through
+:func:`deadline_for` on rehydration.
+"""
+
+from __future__ import annotations
+
+import time
+
+
+def now() -> float:
+    """Monotonic seconds. Comparable only against values from this module,
+    never against wall clock."""
+    return time.monotonic()
+
+
+def deadline_for(ttl_seconds: float) -> float:
+    """The monotonic instant ``ttl_seconds`` from now."""
+    return now() + ttl_seconds
+
+
+def remaining(deadline: float) -> float:
+    """Seconds until ``deadline``; negative once it has passed."""
+    return deadline - now()
+
+
+def expired(deadline: float) -> bool:
+    return remaining(deadline) <= 0.0

@@ -1,0 +1,154 @@
+"""Leader-only expiry reaper: expired leases become revision-stamped
+deletes through the sequencer.
+
+The naive alternative — engine-level TTLs on leased keys — creates a second,
+unversioned deletion path: keys vanish without a revision, watchers never
+hear about it, and compaction cannot reason about the hole. Following the
+multiversion-delete discipline (PAPERS: MVCC B-trees), every expiry here is
+an ordinary ``Backend.delete``: it deals a revision, writes a tombstone,
+flows through the single sequencer, lands in the watch cache and fan-out
+hub, and inherits the ``kb_watch_lag_seconds`` instrumentation for free.
+
+Leadership: only the leader reaps (followers would race it and double-
+delete); on a follower→leader transition the registry rehydrates from the
+persisted checkpoint so the new leader adopts the old leader's table
+instead of its own stale copy. The same thread drives the checkpoint
+cadence (``--lease-checkpoint-interval``) that persists keepalive-refreshed
+remaining TTLs.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+
+from ..backend.errors import FutureRevisionError
+from ..storage.errors import KeyNotFoundError
+from . import clock
+from .registry import LeaseNotFoundError, LeaseRegistry
+
+logger = logging.getLogger(__name__)
+
+DEFAULT_REAP_INTERVAL = 1.0
+DEFAULT_CHECKPOINT_INTERVAL = 5.0
+
+
+class LeaseReaper:
+    def __init__(self, backend, registry: LeaseRegistry, peers=None,
+                 reap_interval: float = DEFAULT_REAP_INTERVAL,
+                 checkpoint_interval: float = DEFAULT_CHECKPOINT_INTERVAL):
+        self.backend = backend
+        self.registry = registry
+        self.peers = peers
+        self.reap_interval = reap_interval
+        self.checkpoint_interval = checkpoint_interval
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self._was_leader: bool | None = None  # None until the first tick
+
+    # ------------------------------------------------------------- lifecycle
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        from ..util.env import crash_guard
+
+        self._thread = threading.Thread(
+            target=crash_guard(self._loop), name="kb-lease-reaper", daemon=True
+        )
+        self._thread.start()
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+            self._thread = None
+        # persist remaining TTLs one last time so a restart resumes the
+        # countdown instead of granting expired leases a fresh life
+        self.registry.close()
+
+    def _loop(self) -> None:
+        # first pass runs immediately: leases that expired while the
+        # process was down are reaped at boot, not after one interval
+        next_ckpt = clock.deadline_for(self.checkpoint_interval)
+        while True:
+            if self._leader():
+                self.reap()
+            # attach/detach changes persist every tick (a crash must not
+            # leak never-expiring keys for more than one reap interval);
+            # keepalive-refreshed deadlines ride the cheaper cadence below
+            self.registry.checkpoint(structural_only=True)
+            if clock.expired(next_ckpt):
+                self.registry.checkpoint()
+                next_ckpt = clock.deadline_for(self.checkpoint_interval)
+            if self._stop.wait(self.reap_interval):
+                return
+
+    def _leader(self) -> bool:
+        leader = self.peers is None or self.peers.is_leader()
+        if leader and self._was_leader is False:
+            # promotion mid-life: adopt the persisted table (the old
+            # leader's checkpoint) over this node's stale in-memory copy.
+            # Boot-time leadership is NOT a transition — the registry
+            # already rehydrated at construction, and re-reading here would
+            # roll back keepalives that arrived since.
+            self.registry.rehydrate()
+        self._was_leader = leader
+        return leader
+
+    # ----------------------------------------------------------------- reaps
+    def reap(self) -> int:
+        """Delete every expired lease's keys through the MVCC write path,
+        then drop the lease. Returns the number of leases reaped. A lease
+        whose keys could not all be deleted is kept for the next tick —
+        dropping it early would leak undeletable keys forever."""
+        reaped = 0
+        for lease_id, keys in self.registry.expired_leases():
+            if self._stop.is_set():
+                break
+            if self._delete_range(keys, lease_id):
+                self.registry.drop(lease_id, reason="expired")
+                reaped += 1
+        return reaped
+
+    def revoke(self, lease_id: int) -> int:
+        """Explicit LeaseRevoke: same delete discipline as expiry, ordered
+        keys-first so a crash mid-revoke leaves a still-expiring lease
+        rather than orphaned keys. Returns the number of keys deleted."""
+        lease = self.registry.peek(lease_id)
+        if lease is None:
+            raise LeaseNotFoundError(lease_id)
+        keys = tuple(sorted(lease.keys))
+        if not self._delete_range(keys, lease_id):
+            raise RuntimeError(f"lease {lease_id}: attached keys not fully deleted")
+        self.registry.drop(lease_id, reason="revoked")
+        return len(keys)
+
+    def _delete_range(self, keys: tuple[bytes, ...], lease_id: int) -> bool:
+        """Batch the lease's keys into revision-stamped deletes submitted
+        through the sequencer (each Backend.delete deals a revision, posts
+        its WatchEvent, and commits in order). Each delete re-checks the
+        key's CURRENT owner first: the snapshot in ``keys`` is stale by the
+        time the loop runs, and a key the user detached (put with lease=0)
+        or moved to a fresh lease since must not be deleted — that would be
+        data loss of a write etcd preserves. Missing keys are fine (the
+        user deleted them first); a drift-back race retries once with a
+        fresh revision."""
+        ok = True
+        for key in keys:
+            if self.registry.owner_of(key) != lease_id:
+                continue  # detached or re-leased since the snapshot
+            for _attempt in range(2):
+                try:
+                    self.backend.delete(key)
+                    break
+                except KeyNotFoundError:
+                    break
+                except FutureRevisionError:
+                    continue  # concurrent writer drew a higher revision
+                except Exception:
+                    logger.exception("lease reap: delete %r failed", key)
+                    ok = False
+                    break
+            else:
+                ok = False
+        return ok

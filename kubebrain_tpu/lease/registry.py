@@ -1,0 +1,341 @@
+"""Lease registry: the TTL state machine.
+
+etcd semantics (server/lease/lessor.go), adapted to this store's MVCC
+discipline:
+
+- ``grant`` mints a lease (caller-chosen or random positive int64 id) with
+  a TTL measured on the monotonic clock (clock.py — kblint KB108);
+- ``attach``/``reattach`` bind keys to a lease from the backend write path
+  (``PutRequest.lease``); a put without a lease detaches;
+- ``keepalive`` refreshes the deadline to ``now + granted_ttl``; an expired
+  or unknown lease returns 0 and is never resurrected (etcd
+  ErrLeaseNotFound maps to TTL=0 on the keepalive stream);
+- ``time_to_live`` reports remaining seconds, or -1 once the lease is
+  expired or gone (etcd LeaseTimeToLive contract);
+- expiry itself is NOT enforced here: the reaper (reaper.py) turns expired
+  leases into revision-stamped deletes through the sequencer, so watchers
+  and compaction see normal MVCC events rather than keys silently
+  vanishing.
+
+Persistence: the whole table (ids, granted TTLs, *remaining* TTL as of the
+checkpoint, attached keys) is length-framed into one metadata row
+(``LEASE_STATE_KEY``, outside the MVCC keyspace like the compact/election
+records) — written synchronously on structural changes (grant/drop) and on
+a cadence for keepalive-refreshed deadlines. Rehydration converts remaining
+seconds back into monotonic deadlines; a lease that was already expired at
+checkpoint time comes back expired, so the boot reap deletes its keys
+instead of resurrecting them.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import struct
+import threading
+from dataclasses import dataclass, field
+
+from ..backend.common import LEASE_STATE_KEY
+from ..storage.errors import KeyNotFoundError
+from . import clock
+
+logger = logging.getLogger(__name__)
+
+_MAGIC = b"KBLEASE1"
+_INT64_MAX = (1 << 63) - 1
+
+
+class LeaseNotFoundError(Exception):
+    """etcd ErrLeaseNotFound: the lease does not exist (or has expired)."""
+
+    def __init__(self, lease_id: int):
+        super().__init__(f"lease {lease_id} not found")
+        self.lease_id = lease_id
+
+
+class LeaseExistsError(Exception):
+    """etcd ErrLeaseExist: grant with an explicit id that is already live."""
+
+    def __init__(self, lease_id: int):
+        super().__init__(f"lease {lease_id} already exists")
+        self.lease_id = lease_id
+
+
+@dataclass
+class Lease:
+    id: int
+    granted_ttl: float          # seconds, as granted (keepalive resets to this)
+    deadline: float             # monotonic expiry instant (clock.py domain)
+    keys: set[bytes] = field(default_factory=set)
+
+    def remaining(self) -> float:
+        return clock.remaining(self.deadline)
+
+
+class LeaseRegistry:
+    def __init__(self, store=None, metrics=None):
+        self._store = store
+        self._metrics = metrics
+        self._lock = threading.Lock()       # protects _leases/_key_owner/_dirty
+        self._ckpt_lock = threading.Lock()  # serializes encode+commit pairs
+        self._leases: dict[int, Lease] = {}
+        self._key_owner: dict[bytes, int] = {}
+        self._dirty = False         # any unpersisted change (incl. keepalives)
+        self._dirty_struct = False  # unpersisted attach/detach changes
+        if store is not None:
+            self.rehydrate()
+        if metrics is not None:
+            metrics.register_gauge_fn("kb.lease.active", self.count)
+            metrics.register_gauge_fn("kb.lease.attached.keys", self.attached_count)
+
+    # ------------------------------------------------------------- lifecycle
+    def grant(self, ttl: float, lease_id: int = 0) -> Lease:
+        """Mint a lease. ``lease_id`` 0 = server-chosen (random positive
+        int64, the etcd contract); an explicit id that is already live
+        raises LeaseExistsError. Synchronously checkpointed — a granted
+        lease must survive an immediate restart."""
+        ttl = max(float(ttl), 0.0)
+        with self._lock:
+            if lease_id:
+                if lease_id in self._leases:
+                    raise LeaseExistsError(lease_id)
+            else:
+                while True:
+                    lease_id = int.from_bytes(os.urandom(8), "big") & _INT64_MAX
+                    if lease_id and lease_id not in self._leases:
+                        break
+            lease = Lease(lease_id, ttl, clock.deadline_for(ttl))
+            self._leases[lease_id] = lease
+            self._dirty = True
+        if self._metrics is not None:
+            self._metrics.emit_counter("kb.lease.granted.total", 1)
+        self.checkpoint()
+        return Lease(lease.id, lease.granted_ttl, lease.deadline, set(lease.keys))
+
+    def drop(self, lease_id: int, reason: str = "revoked") -> None:
+        """Remove the lease record (the caller has already dealt with its
+        keys — reaper.revoke/reap own that ordering)."""
+        with self._lock:
+            lease = self._leases.pop(lease_id, None)
+            if lease is None:
+                return
+            for key in lease.keys:
+                if self._key_owner.get(key) == lease_id:
+                    del self._key_owner[key]
+            self._dirty = True
+        if self._metrics is not None:
+            self._metrics.emit_counter(f"kb.lease.{reason}.total", 1)
+        self.checkpoint()
+
+    def keepalive(self, lease_id: int) -> int:
+        """Refresh the deadline to now + granted TTL. Returns the new TTL in
+        whole seconds, or 0 when the lease is unknown/expired (the etcd
+        keepalive-stream encoding of ErrLeaseNotFound); an expired lease is
+        left for the reaper, never revived."""
+        with self._lock:
+            lease = self._leases.get(lease_id)
+            if lease is None or clock.expired(lease.deadline):
+                return 0
+            lease.deadline = clock.deadline_for(lease.granted_ttl)
+            self._dirty = True
+            return max(1, int(lease.granted_ttl))
+
+    # ------------------------------------------------------------ attachment
+    def require(self, lease_id: int) -> None:
+        """Gate for the write path: putting under an unknown or expired
+        lease is etcd ErrLeaseNotFound."""
+        with self._lock:
+            lease = self._leases.get(lease_id)
+            if lease is None or clock.expired(lease.deadline):
+                raise LeaseNotFoundError(lease_id)
+
+    def attach(self, lease_id: int, key: bytes) -> None:
+        """Bind ``key`` to the lease (after its write committed). A key
+        belongs to at most one lease; re-attaching moves it. An expired but
+        not-yet-reaped lease still accepts the attachment — the reaper
+        deletes the key moments later, which is strictly safer than leaking
+        an unexpirable key."""
+        with self._lock:
+            lease = self._leases.get(lease_id)
+            if lease is None:
+                raise LeaseNotFoundError(lease_id)
+            old = self._key_owner.get(key)
+            if old is not None and old != lease_id:
+                prev = self._leases.get(old)
+                if prev is not None:
+                    prev.keys.discard(key)
+            lease.keys.add(key)
+            self._key_owner[key] = lease_id
+            self._dirty = self._dirty_struct = True
+
+    def reattach(self, key: bytes, lease_id: int) -> None:
+        """Write-path update hook: lease 0 detaches (an etcd put without a
+        lease clears the attachment), nonzero moves the key."""
+        if lease_id:
+            self.attach(lease_id, key)
+        else:
+            self.detach_key(key)
+
+    def detach_key(self, key: bytes) -> None:
+        """The key was deleted (or re-put without a lease): forget it."""
+        with self._lock:
+            owner = self._key_owner.pop(key, None)
+            if owner is None:
+                return
+            lease = self._leases.get(owner)
+            if lease is not None:
+                lease.keys.discard(key)
+            self._dirty = self._dirty_struct = True
+
+    # ----------------------------------------------------------------- reads
+    def peek(self, lease_id: int) -> Lease | None:
+        with self._lock:
+            lease = self._leases.get(lease_id)
+            if lease is None:
+                return None
+            return Lease(lease.id, lease.granted_ttl, lease.deadline, set(lease.keys))
+
+    def time_to_live(self, lease_id: int) -> tuple[int, int, tuple[bytes, ...]]:
+        """(remaining_ttl, granted_ttl, keys). remaining_ttl is -1 once the
+        lease is gone OR past its deadline (even if the reaper has not run
+        yet) — the etcd LeaseTimeToLive contract."""
+        with self._lock:
+            lease = self._leases.get(lease_id)
+            if lease is None or clock.expired(lease.deadline):
+                return -1, 0, ()
+            rem = max(1, int(clock.remaining(lease.deadline)))
+            return rem, int(lease.granted_ttl), tuple(sorted(lease.keys))
+
+    def owner_of(self, key: bytes) -> int:
+        """The lease currently owning ``key`` (0 = unattached) — the
+        reaper's pre-delete re-check against its earlier snapshot."""
+        with self._lock:
+            return self._key_owner.get(key, 0)
+
+    def ids(self) -> list[int]:
+        with self._lock:
+            return sorted(self._leases)
+
+    def count(self) -> int:
+        with self._lock:
+            return len(self._leases)
+
+    def attached_count(self) -> int:
+        with self._lock:
+            return len(self._key_owner)
+
+    def expired_leases(self) -> list[tuple[int, tuple[bytes, ...]]]:
+        """Snapshot of (id, keys) for every lease past its deadline — the
+        reaper's work list, taken under the lock so the subsequent deletes
+        run without it (KB102: no RPC/engine work under a lock)."""
+        with self._lock:
+            return [
+                (lease.id, tuple(sorted(lease.keys)))
+                for lease in self._leases.values()
+                if clock.expired(lease.deadline)
+            ]
+
+    # ----------------------------------------------------------- persistence
+    def checkpoint(self, force: bool = False, structural_only: bool = False
+                   ) -> bool:
+        """Persist the table through the storage engine. Best-effort: a
+        failed write leaves the state dirty for the next cadence tick.
+        ``structural_only`` writes only when an attach/detach is pending —
+        the reaper calls it every reap tick so attachment loss is bounded
+        by ``--lease-reap-interval``, while keepalive-refreshed deadlines
+        ride the cheaper ``--lease-checkpoint-interval`` cadence.
+
+        The encode and the engine write happen under one ``_ckpt_lock``
+        hold: two concurrent checkpointers must not commit their blobs in
+        the opposite order they encoded them, or the older table would
+        overwrite the newer one with ``_dirty`` already cleared."""
+        if self._store is None:
+            return False
+        with self._ckpt_lock:
+            with self._lock:
+                if structural_only and not self._dirty_struct and not force:
+                    return False
+                if not self._dirty and not force:
+                    return False
+                blob = self._encode_locked()
+                self._dirty = self._dirty_struct = False
+            try:
+                batch = self._store.begin_batch_write()
+                batch.put(LEASE_STATE_KEY, blob)
+                batch.commit()
+                return True
+            except Exception:
+                logger.exception("lease checkpoint failed; state stays dirty")
+                with self._lock:
+                    self._dirty = self._dirty_struct = True
+                return False
+
+    def rehydrate(self) -> int:
+        """Replace in-memory state with the persisted checkpoint (boot, or
+        a follower adopting the table on promotion). Remaining TTLs become
+        fresh monotonic deadlines; already-expired leases come back expired
+        so the next reap deletes their keys instead of resurrecting them.
+        Returns the number of leases loaded."""
+        if self._store is None:
+            return 0
+        try:
+            raw = self._store.get(LEASE_STATE_KEY)
+        except KeyNotFoundError:
+            return 0
+        try:
+            leases = _decode(raw)
+        except (ValueError, struct.error):
+            logger.exception("corrupt lease checkpoint; starting empty")
+            return 0
+        with self._lock:
+            self._leases = {l.id: l for l in leases}
+            self._key_owner = {
+                key: l.id for l in leases for key in l.keys
+            }
+            self._dirty = False
+        return len(leases)
+
+    def _encode_locked(self) -> bytes:
+        frames = [_MAGIC, struct.pack(">I", len(self._leases))]
+        for lease in self._leases.values():
+            # both TTLs in milliseconds: the registry API accepts fractional
+            # TTLs (sub-second leases in tests), and integer-second encoding
+            # would round a 0.3s grant down to an instantly-expired 0
+            rem_ms = int(clock.remaining(lease.deadline) * 1000.0)
+            granted_ms = int(lease.granted_ttl * 1000.0)
+            frames.append(struct.pack(
+                ">QQqI", lease.id, granted_ms, rem_ms, len(lease.keys),
+            ))
+            for key in sorted(lease.keys):
+                frames.append(struct.pack(">I", len(key)))
+                frames.append(key)
+        return b"".join(frames)
+
+    def close(self) -> None:
+        self.checkpoint(force=True)
+        if self._metrics is not None:
+            self._metrics.unregister_gauge_fn("kb.lease.active")
+            self._metrics.unregister_gauge_fn("kb.lease.attached.keys")
+
+
+def _decode(raw: bytes) -> list[Lease]:
+    if raw[: len(_MAGIC)] != _MAGIC:
+        raise ValueError("bad lease checkpoint magic")
+    off = len(_MAGIC)
+    (count,) = struct.unpack_from(">I", raw, off)
+    off += 4
+    out: list[Lease] = []
+    for _ in range(count):
+        lease_id, granted_ms, rem_ms, nkeys = struct.unpack_from(">QQqI", raw, off)
+        off += struct.calcsize(">QQqI")
+        keys: set[bytes] = set()
+        for _ in range(nkeys):
+            (klen,) = struct.unpack_from(">I", raw, off)
+            off += 4
+            keys.add(raw[off:off + klen])
+            off += klen
+        out.append(Lease(
+            lease_id, granted_ms / 1000.0,
+            clock.deadline_for(rem_ms / 1000.0), keys,
+        ))
+    return out

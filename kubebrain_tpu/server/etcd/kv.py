@@ -30,11 +30,13 @@ from ...backend import (
     FutureRevisionError,
     KeyExistsError,
 )
+from ...lease import LeaseNotFoundError
 from ...sched import SchedOverloadError, client_of, ensure_scheduler
 from ...storage.errors import KeyNotFoundError
 from ...proto import rpc_pb2
 from ...trace import TRACER, traceparent_of
 from . import shim
+from .misc import ERR_LEASE_NOT_FOUND
 
 PARTITION_MAGIC_REVISION = 1888  # reference kv.go:33
 COMPACT_REV_KEY = b"compact_rev_key"  # the apiserver compactor's coordination key
@@ -213,17 +215,21 @@ class KVService:
                     return fwd
                 context.abort(grpc.StatusCode.UNAVAILABLE, "etcdserver: not leader")
             m = self._match(request, context)
-        kind, key, guard_rev, value, ttl = m
+        kind, key, guard_rev, value, lease = m
         try:
             with TRACER.stage("backend_write"):
                 if kind == "create":
-                    rev = self.backend.create(key, value, ttl=ttl)
+                    rev = self.backend.create(key, value, lease=lease)
                 elif kind == "update":
-                    rev = self.backend.update(key, value, guard_rev, ttl=ttl)
+                    rev = self.backend.update(key, value, guard_rev, lease=lease)
                 else:  # delete
                     rev, _prev = self.backend.delete(key, guard_rev)
             with TRACER.stage("response_encode"):
                 return self._txn_ok(rev, put=kind != "delete")
+        except LeaseNotFoundError:
+            # a put under an unknown/expired lease is a definite failure
+            # (etcd ErrLeaseNotFound) — the apiserver re-grants and retries
+            context.abort(grpc.StatusCode.NOT_FOUND, ERR_LEASE_NOT_FOUND)
         except KeyExistsError as e:
             return self._txn_failed(request, e.revision)
         except (CASRevisionMismatchError,) as e:
@@ -241,7 +247,7 @@ class KVService:
 
     def _match(self, request, context):
         """Classify the txn (reference kv.go:160-230). Returns
-        (kind, key, guard_revision, value)."""
+        (kind, key, guard_revision, value, lease_id)."""
         if len(request.compare) != 1 or len(request.success) != 1:
             context.abort(
                 grpc.StatusCode.UNIMPLEMENTED,
@@ -267,16 +273,16 @@ class KVService:
             if op.request_put.key != cmp.key:
                 context.abort(grpc.StatusCode.UNIMPLEMENTED, "etcdserver: key mismatch")
             kind = "create" if guard == 0 else "update"
-            # lease attachment: our LeaseGrant returns ID := TTL, so the lease
-            # id on a put IS its TTL in seconds (covers apiserver masterleases
-            # and events uniformly — broader than the reference's /events/
-            # key-pattern TTL, lease.go:24-31)
-            ttl = int(op.request_put.lease) if op.request_put.lease > 0 else None
-            return kind, bytes(op.request_put.key), int(guard), bytes(op.request_put.value), ttl
+            # real lease attachment: PutRequest.lease names a lease granted
+            # by LeaseService; the backend write path binds the key to it
+            # and the reaper owns expiry (an explicit lease always beats the
+            # legacy key-pattern TTL — docs/storage_engine.md precedence)
+            lease = int(op.request_put.lease) if op.request_put.lease > 0 else 0
+            return kind, bytes(op.request_put.key), int(guard), bytes(op.request_put.value), lease
         if which == "request_delete_range":
             if op.request_delete_range.key != cmp.key:
                 context.abort(grpc.StatusCode.UNIMPLEMENTED, "etcdserver: key mismatch")
-            return "delete", bytes(op.request_delete_range.key), int(guard), b"", None
+            return "delete", bytes(op.request_delete_range.key), int(guard), b"", 0
         context.abort(
             grpc.StatusCode.UNIMPLEMENTED, "etcdserver: unsupported transaction op"
         )

@@ -1,8 +1,13 @@
 """Lease / Cluster / Maintenance terminals.
 
-Reference: pkg/server/etcd/lease.go (LeaseGrant returns the TTL as the lease
-ID — "fake but truthy"; TTL is enforced by key pattern, not lease state,
-lease.go:24-31) and cluster.go (MemberList stub, :25-33).
+Reference: pkg/server/etcd/lease.go — which ships the "fake but truthy"
+stub (LeaseGrant returns the TTL as the lease ID, TTL enforced by key
+pattern, lease.go:24-31). This LeaseService is the real thing instead: a
+monotonic-clock TTL state machine (kubebrain_tpu/lease) whose expiry path
+is the leader-only reaper issuing revision-stamped deletes through the
+sequencer, so kube-apiserver workloads that depend on real lease semantics
+(event TTLs, masterleases, lock/election keys) behave as against etcd.
+ClusterService mirrors cluster.go (MemberList stub, :25-33).
 """
 
 from __future__ import annotations
@@ -10,39 +15,143 @@ from __future__ import annotations
 import grpc
 
 from ... import __version__
+from ...lease import LeaseExistsError, LeaseNotFoundError, ensure_lease
 from ...proto import rpc_pb2
+from ...sched import Lane, ensure_scheduler
+from ...trace import TRACER, traceparent_of
 from . import shim
+
+ERR_LEASE_NOT_FOUND = "etcdserver: requested lease not found"
+ERR_LEASE_EXISTS = "etcdserver: lease already exists"
+ERR_NOT_LEADER = "etcdserver: not leader"
+
+
+class LeaseNotLeaderError(Exception):
+    """Lease RPC reached a follower. Lease state lives on the leader (the
+    reaper is leader-only); answering from a follower's stale registry
+    would either kill a healthy client's lease (TTL=0) or refresh a shadow
+    copy the leader never sees. Transports map this to UNAVAILABLE so
+    clients retry the leader."""
+
+#: etcd's minLeaseTTL: sub-second grants flap under keepalive jitter
+MIN_LEASE_TTL = 1
 
 
 class LeaseService:
-    def __init__(self, backend):
+    """etcd Lease terminal over the shared registry + reaper.
+
+    Keepalives are submitted on the request scheduler's SYSTEM lane: under
+    overload the background/normal lanes shed, but a shed keepalive would
+    expire a healthy client's lease and delete its keys — exactly the
+    cascading failure admission control exists to prevent.
+    """
+
+    def __init__(self, backend, peers=None):
         self.backend = backend
+        self.peers = peers
+        self.registry = ensure_lease(backend, peers=peers)
+        self.reaper = backend._kb_lease_reaper
+        self.limiter = ensure_scheduler(backend)
+
+    def _check_leader(self, context) -> None:
+        # lease state lives on the leader (the reaper is leader-only);
+        # followers don't forward lease RPCs — clients retry the leader
+        if self.peers is not None and not self.peers.is_leader():
+            context.abort(grpc.StatusCode.UNAVAILABLE, ERR_NOT_LEADER)
 
     def LeaseGrant(self, request, context) -> rpc_pb2.LeaseGrantResponse:
-        # kube-apiserver attaches leases to /events/ keys; TTL is honored by
-        # key pattern in the write path (creator.ttl_for_key), so the lease
-        # object itself is a polite fiction: ID := TTL.
-        return rpc_pb2.LeaseGrantResponse(
-            header=shim.header(self.backend.current_revision()),
-            ID=request.TTL,
-            TTL=request.TTL,
-        )
+        with TRACER.span("etcd.Lease/LeaseGrant",
+                         traceparent=traceparent_of(context)):
+            with TRACER.stage("endpoint_recv"):
+                self._check_leader(context)
+                ttl = max(int(request.TTL), MIN_LEASE_TTL)
+            try:
+                with TRACER.stage("backend_write"):
+                    lease = self.registry.grant(ttl, int(request.ID))
+            except LeaseExistsError:
+                context.abort(grpc.StatusCode.FAILED_PRECONDITION, ERR_LEASE_EXISTS)
+            with TRACER.stage("response_encode"):
+                return rpc_pb2.LeaseGrantResponse(
+                    header=shim.header(self.backend.current_revision()),
+                    ID=lease.id,
+                    TTL=int(lease.granted_ttl),
+                )
 
     def LeaseRevoke(self, request, context) -> rpc_pb2.LeaseRevokeResponse:
-        # nothing to revoke: TTLs live on the keys, not on lease state
-        return rpc_pb2.LeaseRevokeResponse(
-            header=shim.header(self.backend.current_revision())
-        )
+        with TRACER.span("etcd.Lease/LeaseRevoke",
+                         traceparent=traceparent_of(context)):
+            with TRACER.stage("endpoint_recv"):
+                self._check_leader(context)
+            try:
+                # keys-first delete discipline (reaper.revoke): every
+                # attached key dies as a normal MVCC tombstone through the
+                # sequencer before the lease record goes away
+                with TRACER.stage("backend_write"):
+                    self.reaper.revoke(int(request.ID))
+            except LeaseNotFoundError:
+                context.abort(grpc.StatusCode.NOT_FOUND, ERR_LEASE_NOT_FOUND)
+            with TRACER.stage("response_encode"):
+                return rpc_pb2.LeaseRevokeResponse(
+                    header=shim.header(self.backend.current_revision())
+                )
 
     def LeaseKeepAlive(self, request_iterator, context):
-        # keepalives are acknowledged verbatim (TTL enforcement is by key
-        # pattern; the stream exists so lease-holding clients don't error)
-        for req in request_iterator:
-            yield rpc_pb2.LeaseKeepAliveResponse(
-                header=shim.header(self.backend.current_revision()),
-                ID=req.ID,
-                TTL=req.ID,
+        tp = traceparent_of(context)
+        try:
+            for req in request_iterator:
+                yield self.keepalive_one(req, traceparent=tp)
+        except LeaseNotLeaderError:
+            context.abort(grpc.StatusCode.UNAVAILABLE, ERR_NOT_LEADER)
+
+    def keepalive_one(self, req, traceparent=None) -> rpc_pb2.LeaseKeepAliveResponse:
+        """One keepalive refresh, admitted on the SYSTEM lane. TTL=0 in the
+        response is the etcd encoding of "lease not found/expired" — the
+        registry never revives an expired lease. Shared by the sync, aio,
+        and native-front keepalive streams; raises LeaseNotLeaderError on
+        followers (a follower answering TTL=0 from its stale table would
+        make the client abandon a lease that is alive on the leader)."""
+        with TRACER.span("etcd.Lease/LeaseKeepAlive", traceparent=traceparent):
+            if self.peers is not None and not self.peers.is_leader():
+                raise LeaseNotLeaderError(ERR_NOT_LEADER)
+            registry = self.registry
+            lease_id = int(req.ID)
+            ttl = self.limiter.submit(
+                lambda: registry.keepalive(lease_id),
+                lane=Lane.SYSTEM, client="lease-keepalive",
             )
+            with TRACER.stage("response_encode"):
+                return rpc_pb2.LeaseKeepAliveResponse(
+                    header=shim.header(self.backend.current_revision()),
+                    ID=req.ID,
+                    TTL=ttl,
+                )
+
+    def LeaseTimeToLive(self, request, context) -> rpc_pb2.LeaseTimeToLiveResponse:
+        with TRACER.span("etcd.Lease/LeaseTimeToLive",
+                         traceparent=traceparent_of(context)):
+            self._check_leader(context)  # a follower's table is stale
+            ttl, granted, keys = self.registry.time_to_live(int(request.ID))
+            with TRACER.stage("response_encode"):
+                resp = rpc_pb2.LeaseTimeToLiveResponse(
+                    header=shim.header(self.backend.current_revision()),
+                    ID=request.ID,
+                    TTL=ttl,          # -1 = missing or expired (etcd contract)
+                    grantedTTL=granted,
+                )
+                if request.keys and ttl >= 0:
+                    resp.keys.extend(keys)
+                return resp
+
+    def LeaseLeases(self, request, context) -> rpc_pb2.LeaseLeasesResponse:
+        with TRACER.span("etcd.Lease/LeaseLeases",
+                         traceparent=traceparent_of(context)):
+            self._check_leader(context)  # a follower's table is stale
+            resp = rpc_pb2.LeaseLeasesResponse(
+                header=shim.header(self.backend.current_revision())
+            )
+            for lease_id in self.registry.ids():
+                resp.leases.add(ID=lease_id)
+            return resp
 
 
 class ClusterService:

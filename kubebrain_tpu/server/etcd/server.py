@@ -35,7 +35,7 @@ def make_etcd_handlers(backend, peers=None, identity="kubebrain-tpu", client_url
     ``server.add_generic_rpc_handlers``."""
     kv = KVService(backend, peers)
     watch = WatchService(backend, peers)
-    lease = LeaseService(backend)
+    lease = LeaseService(backend, peers)
     cluster = ClusterService(backend, identity, client_urls)
     maint = MaintenanceService(backend)
     p = rpc_pb2
@@ -54,6 +54,8 @@ def make_etcd_handlers(backend, peers=None, identity="kubebrain-tpu", client_url
             "LeaseGrant": _unary(lease.LeaseGrant, p.LeaseGrantRequest, p.LeaseGrantResponse),
             "LeaseRevoke": _unary(lease.LeaseRevoke, p.LeaseRevokeRequest, p.LeaseRevokeResponse),
             "LeaseKeepAlive": _bidi(lease.LeaseKeepAlive, p.LeaseKeepAliveRequest, p.LeaseKeepAliveResponse),
+            "LeaseTimeToLive": _unary(lease.LeaseTimeToLive, p.LeaseTimeToLiveRequest, p.LeaseTimeToLiveResponse),
+            "LeaseLeases": _unary(lease.LeaseLeases, p.LeaseLeasesRequest, p.LeaseLeasesResponse),
         }),
         grpc.method_handlers_generic_handler("etcdserverpb.Cluster", {
             "MemberList": _unary(cluster.MemberList, p.MemberListRequest, p.MemberListResponse),

@@ -138,10 +138,14 @@ def test_aio_list_over_watch_and_keepalive(aio_server):
     assert len(got) == 7 and all(e.kv.value.startswith(b"v") for e in got)
     requests.put(None)
 
+    lg = client.lease_grant(rpc_pb2.LeaseGrantRequest(TTL=600))
     ka = client.ch.stream_stream(
         "/etcdserverpb.Lease/LeaseKeepAlive",
         request_serializer=rpc_pb2.LeaseKeepAliveRequest.SerializeToString,
         response_deserializer=rpc_pb2.LeaseKeepAliveResponse.FromString,
     )
-    resp = next(ka(iter([rpc_pb2.LeaseKeepAliveRequest(ID=600)])))
-    assert resp.ID == 600 and resp.TTL == 600
+    # the aio keepalive path shares the real registry (SYSTEM-lane refresh)
+    resp = next(ka(iter([rpc_pb2.LeaseKeepAliveRequest(ID=lg.ID)])))
+    assert resp.ID == lg.ID and resp.TTL == 600
+    resp = next(ka(iter([rpc_pb2.LeaseKeepAliveRequest(ID=999999)])))
+    assert resp.TTL == 0  # unknown lease: etcd's not-found encoding

@@ -164,6 +164,58 @@ def test_ttl_expiry_via_compaction(monkeypatch):
     store.close()
 
 
+def test_lease_expiry_deletes_compact_correctly():
+    """Lease-deleted revisions are ordinary MVCC tombstones: compacting past
+    the reaper's delete GCs the key's whole version chain (record + object
+    rows) exactly like a user delete — no special-cased second deletion
+    path (the lease subsystem's design invariant, docs/leases.md)."""
+    from kubebrain_tpu.lease import ensure_lease
+
+    store = new_storage("memkv")
+    b = Backend(store, BackendConfig(event_ring_capacity=2048))
+    reg = ensure_lease(b, reap_interval=0.05, checkpoint_interval=60.0)
+    K = b"/registry/pods/leased-compact"
+    try:
+        lease = reg.grant(0.3)
+        r1 = b.create(K, b"v1", lease=lease.id)
+        r2 = b.create(b"/registry/pods/other", b"x")
+        assert wait_for_revision(b, r2)
+
+        # wait for the reaper's revision-stamped delete
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            try:
+                b.get(K)
+                time.sleep(0.05)
+            except KeyNotFoundError:
+                break
+        with pytest.raises(KeyNotFoundError):
+            b.get(K)
+        r_del = b.current_revision()
+        assert r_del > r2  # the expiry consumed a real revision
+
+        # advance and compact past the delete
+        r3 = b.create(b"/registry/pods/after", b"y")
+        assert wait_for_revision(b, r3)
+        assert b.compact(r3) == r3
+
+        # pre-compaction revisions are fenced like any compacted history
+        from kubebrain_tpu.backend import CompactedError
+
+        with pytest.raises(CompactedError):
+            b.get(K, revision=r1)
+        # the version chain is GC'd: record and object rows both gone
+        with pytest.raises(KeyNotFoundError):
+            store.get(coder.encode_revision_key(K))
+        with pytest.raises(KeyNotFoundError):
+            store.get(coder.encode_object_key(K, r1))
+        # live data untouched
+        assert b.get(b"/registry/pods/other").value == b"x"
+    finally:
+        b.close()
+        store.close()
+
+
 def test_skip_prefixes_excluded_from_compaction():
     """--skip-prefixes punch holes in the compact borders
     (compact.go:107-126, TestConstructCompactBordersWithSkippedPrefixOption)."""

@@ -324,7 +324,8 @@ def test_compactor_coordination_protocol(server):
 def test_lease_and_memberlist_and_status(server):
     client, _, _ = server
     lg = client.lease_grant(rpc_pb2.LeaseGrantRequest(TTL=3600))
-    assert lg.ID == 3600 and lg.TTL == 3600
+    # real lease subsystem: a server-chosen id, not the old ID:=TTL stub
+    assert lg.ID > 0 and lg.TTL == 3600
     ml = client.member_list(rpc_pb2.MemberListRequest())
     assert len(ml.members) == 1
     st = client.status(rpc_pb2.StatusRequest())
@@ -395,19 +396,27 @@ def test_maintenance_snapshot_and_defrag(server):
 
 def test_lease_keepalive_and_revoke(server):
     client, _, _ = server
+    lg = client.lease_grant(rpc_pb2.LeaseGrantRequest(TTL=60))
     ka = client.ch.stream_stream(
         "/etcdserverpb.Lease/LeaseKeepAlive",
         request_serializer=rpc_pb2.LeaseKeepAliveRequest.SerializeToString,
         response_deserializer=rpc_pb2.LeaseKeepAliveResponse.FromString,
     )
-    resp = next(ka(iter([rpc_pb2.LeaseKeepAliveRequest(ID=3600)])))
-    assert resp.ID == 3600 and resp.TTL == 3600
+    # a live lease refreshes to its granted TTL; an unknown one gets the
+    # etcd TTL=0 encoding of "lease not found"
+    resp = next(ka(iter([rpc_pb2.LeaseKeepAliveRequest(ID=lg.ID)])))
+    assert resp.ID == lg.ID and resp.TTL == 60
+    resp = next(ka(iter([rpc_pb2.LeaseKeepAliveRequest(ID=999999)])))
+    assert resp.TTL == 0
     revoke = client.ch.unary_unary(
         "/etcdserverpb.Lease/LeaseRevoke",
         request_serializer=rpc_pb2.LeaseRevokeRequest.SerializeToString,
         response_deserializer=rpc_pb2.LeaseRevokeResponse.FromString,
     )
-    assert revoke(rpc_pb2.LeaseRevokeRequest(ID=3600)).header.revision > 0
+    assert revoke(rpc_pb2.LeaseRevokeRequest(ID=lg.ID)).header.revision > 0
+    with pytest.raises(grpc.RpcError) as ei:
+        revoke(rpc_pb2.LeaseRevokeRequest(ID=lg.ID))  # already gone
+    assert ei.value.code() == grpc.StatusCode.NOT_FOUND
 
 
 def test_snapshot_save_restore_roundtrip(server, tmp_path):
@@ -462,10 +471,11 @@ def test_snapshot_save_restore_roundtrip(server, tmp_path):
         st2.close()
 
 
-def test_lease_attached_put_gets_ttl():
-    """A put with a lease expires: our LeaseGrant contract makes the lease
-    id the TTL, so lease-attached keys (apiserver masterleases, events) age
-    out — broader than the reference's /events/-pattern TTL."""
+def test_lease_attached_put_expires():
+    """A put with a lease expires via the lease subsystem: the reaper turns
+    the expired lease's keys into revision-stamped MVCC deletes (covers
+    apiserver masterleases and events uniformly — broader than the
+    reference's /events/-pattern TTL; docs/leases.md)."""
     import time as _time
 
     port = free_port()
@@ -473,6 +483,7 @@ def test_lease_attached_put_gets_ttl():
         "--single-node", "--storage", "native", "--host", "127.0.0.1",
         "--client-port", str(port),
         "--peer-port", str(free_port()), "--info-port", str(free_port()),
+        "--lease-reap-interval", "0.1",
     ])
     endpoint, backend, store = build_endpoint(args)
     endpoint.run()
@@ -490,8 +501,12 @@ def test_lease_attached_put_gets_ttl():
         assert client.txn(req).succeeded
         r = client.range_(rpc_pb2.RangeRequest(key=b"/registry/masterleases/1.2.3.4"))
         assert r.count == 1
-        _time.sleep(1.2)
-        r = client.range_(rpc_pb2.RangeRequest(key=b"/registry/masterleases/1.2.3.4"))
+        deadline = _time.time() + 5.0
+        while _time.time() < deadline:
+            r = client.range_(rpc_pb2.RangeRequest(key=b"/registry/masterleases/1.2.3.4"))
+            if r.count == 0:
+                break
+            _time.sleep(0.1)
         assert r.count == 0  # expired with the lease TTL
     finally:
         client.close()

@@ -321,10 +321,60 @@ def test_kb107_scoped_and_suppressible():
     assert ids(sup, SRV_ETCD) == []
 
 
+# ------------------------------------------------------------------- KB108
+def test_kb108_flags_wall_clock_ttl_add():
+    src = "import time\ndef f(ttl):\n    return time.time() + ttl\n"
+    assert ids(src, ANY) == ["KB108"]  # backend/ is serving path
+    assert ids(src, "kubebrain_tpu/lease/registry.py") == ["KB108"]
+
+
+def test_kb108_flags_wall_clock_deadline_sub():
+    # remaining-TTL math against wall clock (backend/ avoids KB107 overlap)
+    src = "import time\ndef f(lease):\n    return lease.expires_at - time.time()\n"
+    assert ids(src, ANY) == ["KB108"]
+
+
+def test_kb108_flags_deadline_comparison():
+    src = "import time\ndef f(deadline):\n    return time.time() > deadline\n"
+    assert ids(src, ANY) == ["KB108"]
+
+
+def test_kb108_flags_ttlish_assignment_target():
+    # no ttl-ish name in the expression, but the target is one
+    src = "import time\ndef f(self):\n    self.deadline = time.time() + 30\n"
+    assert ids(src, ANY) == ["KB108"]
+    # ...and it is reported exactly once when BOTH sides are ttl-ish
+    src2 = "import time\ndef f(self, ttl):\n    self.deadline = time.time() + ttl\n"
+    assert ids(src2, ANY) == ["KB108"]
+
+
+def test_kb108_allows_lease_clock_and_non_ttl_uses():
+    # lease/clock.py is the one module allowed to do the conversion
+    src = "import time\ndef f(ttl):\n    return time.time() + ttl\n"
+    assert ids(src, "kubebrain_tpu/lease/clock.py") == []
+    # arithmetic without a TTL-ish name is not deadline math
+    assert ids("import time\ndef f():\n    return time.time() + 1\n", ANY) == []
+    # monotonic deadline math is the correct form
+    assert ids("import time\ndef f(ttl):\n    return time.monotonic() + ttl\n",
+               ANY) == []
+    # wall clock passed as a plain argument (election records) is fine
+    assert ids("import time\ndef f(rec):\n    return rec.expired(time.time())\n",
+               ANY) == []
+
+
+def test_kb108_scoped_and_suppressible():
+    src = "import time\ndef f(ttl):\n    return time.time() + ttl\n"
+    assert ids(src, "kubebrain_tpu/client.py") == []  # client is off-path
+    assert ids(src, OPS) == []
+    sup = ("import time\ndef f(ttl):\n"
+           "    return time.time() + ttl  # kblint: disable=KB108\n")
+    assert ids(sup, ANY) == []
+
+
 # ------------------------------------------------------------ registry/CLI
 def test_registry_has_all_rules():
     assert set(RULES) == {"KB101", "KB102", "KB103", "KB104", "KB105", "KB106",
-                          "KB107"}
+                          "KB107", "KB108"}
     for rule in RULES.values():
         assert rule.summary
 

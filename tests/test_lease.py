@@ -1,0 +1,539 @@
+"""Lease subsystem tests: the TTL state machine, revision-stamped expiry
+through the sequencer, persistence across restart, keepalive survival under
+overload, and the etcd3 wire surface (LeaseGrant/Revoke/KeepAlive/
+TimeToLive/Leases + PutRequest.lease attachment)."""
+
+import queue
+import threading
+import time
+
+import pytest
+
+from kubebrain_tpu.backend import Backend, BackendConfig
+from kubebrain_tpu.backend import creator
+from kubebrain_tpu.backend.backend import wait_for_revision
+from kubebrain_tpu.backend.common import Verb
+from kubebrain_tpu.lease import (
+    LeaseExistsError,
+    LeaseNotFoundError,
+    LeaseReaper,
+    LeaseRegistry,
+    clock,
+    ensure_lease,
+)
+from kubebrain_tpu.storage import new_storage
+from kubebrain_tpu.storage.errors import KeyNotFoundError
+
+
+def make_backend(store=None):
+    store = store or new_storage("memkv")
+    return Backend(store, BackendConfig(event_ring_capacity=4096)), store
+
+
+def drain_events(q, timeout=5.0, until=None):
+    """Collect watch events until ``until(events)`` is true (or timeout)."""
+    events = []
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            batch = q.get(timeout=0.1)
+        except queue.Empty:
+            continue
+        if batch is None:
+            break
+        events.extend(batch)
+        if until is not None and until(events):
+            break
+    return events
+
+
+# ===================================================================== unit
+def test_registry_state_machine(monkeypatch):
+    """grant → keepalive refresh → expiry; expired leases are dead, not
+    resurrectable. Driven on a fake monotonic clock for determinism."""
+    fake = [1000.0]
+    monkeypatch.setattr(clock, "now", lambda: fake[0])
+
+    reg = LeaseRegistry()
+    lease = reg.grant(10)
+    assert lease.id > 0
+    ttl, granted, keys = reg.time_to_live(lease.id)
+    assert (ttl, granted, keys) == (10, 10, ())
+
+    fake[0] += 8.0
+    ttl, _, _ = reg.time_to_live(lease.id)
+    assert ttl == 2
+    assert reg.keepalive(lease.id) == 10       # refreshed to granted TTL
+    ttl, _, _ = reg.time_to_live(lease.id)
+    assert ttl == 10
+
+    fake[0] += 11.0                            # past the refreshed deadline
+    assert reg.time_to_live(lease.id)[0] == -1  # expired == gone (etcd)
+    assert reg.keepalive(lease.id) == 0        # never revived
+    with pytest.raises(LeaseNotFoundError):
+        reg.require(lease.id)
+    # the record itself still exists for the reaper's work list
+    assert reg.expired_leases() == [(lease.id, ())]
+
+    # explicit ids: duplicates refused, unknown ids refused everywhere
+    reg.grant(5, lease_id=42)
+    with pytest.raises(LeaseExistsError):
+        reg.grant(5, lease_id=42)
+    with pytest.raises(LeaseNotFoundError):
+        reg.require(999)
+    assert reg.keepalive(999) == 0
+    assert reg.time_to_live(999)[0] == -1
+
+
+def test_write_path_attachment_semantics():
+    """PutRequest.lease drives attachment in backend.create/update; a put
+    without a lease detaches; delete detaches; an unknown lease is a
+    definite pre-write failure."""
+    b, store = make_backend()
+    reg = LeaseRegistry()
+    b._kb_lease = reg  # registry without a reaper: attachment only
+    try:
+        lease = reg.grant(60)
+        r1 = b.create(b"/registry/pods/a", b"v1", lease=lease.id)
+        b.create(b"/registry/pods/b", b"v1", lease=lease.id)
+        assert reg.time_to_live(lease.id)[2] == (
+            b"/registry/pods/a", b"/registry/pods/b")
+
+        # update without a lease detaches (etcd put-without-lease semantics)
+        b.update(b"/registry/pods/a", b"v2", r1)
+        assert reg.time_to_live(lease.id)[2] == (b"/registry/pods/b",)
+
+        # delete detaches
+        b.delete(b"/registry/pods/b")
+        assert reg.time_to_live(lease.id)[2] == ()
+        assert reg.attached_count() == 0
+
+        # unknown lease: the write must not happen at all
+        with pytest.raises(LeaseNotFoundError):
+            b.create(b"/registry/pods/c", b"v", lease=123456)
+        with pytest.raises(KeyNotFoundError):
+            b.get(b"/registry/pods/c")
+    finally:
+        b.close()
+        store.close()
+
+
+def test_explicit_lease_wins_over_key_pattern(monkeypatch):
+    """Precedence (docs/storage_engine.md): an explicit lease always wins;
+    the /events/ key-pattern TTL is a flag-gated fallback for lease-less
+    writes only."""
+    assert creator.ttl_for_key(b"/events/x") == creator.EVENTS_TTL_SECONDS
+    assert creator.ttl_for_key(b"/registry/pods/x") == 0
+    monkeypatch.setattr(creator, "LEGACY_TTL_PATTERNS", False)
+    assert creator.ttl_for_key(b"/events/x") == 0
+
+    monkeypatch.setattr(creator, "LEGACY_TTL_PATTERNS", True)
+    captured = {}
+    b, store = make_backend()
+    reg = LeaseRegistry()
+    b._kb_lease = reg
+    orig = b._commit_write
+
+    def spy(user_key, revision, new_record, expected_record, obj_value, ttl):
+        captured[bytes(user_key)] = ttl
+        return orig(user_key, revision, new_record, expected_record, obj_value, ttl)
+
+    b._commit_write = spy
+    try:
+        lease = reg.grant(60)
+        # leased /events/ key: engine TTL must be 0 — expiry belongs to the
+        # reaper's revision-stamped delete, not a silent engine drop
+        b.create(b"/events/leased", b"v", lease=lease.id)
+        assert captured[b"/events/leased"] == 0
+        # lease-less /events/ key: the legacy pattern still applies
+        b.create(b"/events/plain", b"v")
+        assert captured[b"/events/plain"] == creator.EVENTS_TTL_SECONDS
+    finally:
+        b.close()
+        store.close()
+
+
+def test_reaper_skips_keys_detached_after_expiry_snapshot():
+    """A key detached (or moved to a fresh lease) between the reaper's
+    expired-lease snapshot and its delete loop must NOT be deleted — that
+    would be data loss of a write etcd preserves."""
+    b, store = make_backend()
+    reg = ensure_lease(b, reap_interval=3600.0, checkpoint_interval=3600.0)
+    reaper = b._kb_lease_reaper
+    try:
+        doomed = reg.grant(0.1)
+        fresh = reg.grant(60)
+        r1 = b.create(b"/registry/pods/detached", b"v", lease=doomed.id)
+        b.create(b"/registry/pods/releases", b"v", lease=doomed.id)
+        b.create(b"/registry/pods/gone", b"v", lease=doomed.id)
+        time.sleep(0.25)  # doomed is now expired, but the reaper is idle
+
+        # after expiry, before the reap: detach one key, move another
+        b.update(b"/registry/pods/detached", b"v2", r1)  # put w/o lease detaches
+        reg.attach(fresh.id, b"/registry/pods/releases")
+
+        assert reaper.reap() == 1  # doomed reaped
+        assert b.get(b"/registry/pods/detached").value == b"v2"
+        assert b.get(b"/registry/pods/releases").value == b"v"
+        with pytest.raises(KeyNotFoundError):
+            b.get(b"/registry/pods/gone")  # still-owned key was deleted
+        assert reg.time_to_live(fresh.id)[2] == (b"/registry/pods/releases",)
+    finally:
+        b.close()
+        store.close()
+
+
+def test_attachments_checkpoint_on_reap_cadence():
+    """Attach/detach changes persist every reap tick (structural_only), not
+    just on the slower checkpoint cadence — a crash right after a leased
+    put must not leak a never-expiring key."""
+    store = new_storage("memkv")
+    b1 = Backend(store, BackendConfig(event_ring_capacity=4096))
+    reg1 = ensure_lease(b1, reap_interval=0.05, checkpoint_interval=3600.0)
+    lease = reg1.grant(0.5)
+    rev = b1.create(b"/registry/pods/attach-crash", b"v", lease=lease.id)
+    assert wait_for_revision(b1, rev)
+    time.sleep(0.2)  # > one reap tick: the attachment must be on disk now
+
+    # simulate a crash: bypass the reaper's final checkpoint entirely
+    b1._kb_lease_reaper._stop.set()
+    b1._kb_lease_reaper._thread.join(timeout=5)
+    del b1._kb_lease_reaper, b1._kb_lease
+    b1.close()
+
+    b2 = Backend(store, BackendConfig(event_ring_capacity=4096))
+    reg2 = ensure_lease(b2, reap_interval=0.05, checkpoint_interval=3600.0)
+    try:
+        assert reg2.time_to_live(lease.id)[2] == (b"/registry/pods/attach-crash",)
+        # ...and the fractional granted TTL survived the ms encoding
+        assert reg2.peek(lease.id).granted_ttl == pytest.approx(0.5)
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            try:
+                b2.get(b"/registry/pods/attach-crash")
+                time.sleep(0.05)
+            except KeyNotFoundError:
+                break
+        with pytest.raises(KeyNotFoundError):
+            b2.get(b"/registry/pods/attach-crash")  # reaped, not leaked
+    finally:
+        b2.close()
+        store.close()
+
+
+def test_followers_refuse_lease_rpcs():
+    """Lease state lives on the leader: a follower must refuse keepalive /
+    TimeToLive / Leases (UNAVAILABLE → client retries the leader) instead
+    of answering from its stale local table."""
+    import grpc
+
+    from kubebrain_tpu.proto import rpc_pb2
+    from kubebrain_tpu.server.etcd.misc import LeaseNotLeaderError, LeaseService
+
+    class FollowerPeers:
+        def is_leader(self):
+            return False
+
+    class AbortCalled(Exception):
+        pass
+
+    class Ctx:
+        code = None
+
+        def abort(self, code, details):
+            self.code = code
+            raise AbortCalled(details)
+
+        def invocation_metadata(self):
+            return ()
+
+    b, store = make_backend()
+    try:
+        svc = LeaseService(b, peers=FollowerPeers())
+        with pytest.raises(LeaseNotLeaderError):
+            svc.keepalive_one(rpc_pb2.LeaseKeepAliveRequest(ID=1))
+        for call, req in (
+            (svc.LeaseGrant, rpc_pb2.LeaseGrantRequest(TTL=5)),
+            (svc.LeaseRevoke, rpc_pb2.LeaseRevokeRequest(ID=1)),
+            (svc.LeaseTimeToLive, rpc_pb2.LeaseTimeToLiveRequest(ID=1)),
+            (svc.LeaseLeases, rpc_pb2.LeaseLeasesRequest()),
+        ):
+            ctx = Ctx()
+            with pytest.raises(AbortCalled):
+                call(req, ctx)
+            assert ctx.code == grpc.StatusCode.UNAVAILABLE
+    finally:
+        b.close()
+        store.close()
+
+
+# =============================================================== expiry path
+def test_expiry_deletes_visible_to_watchers_before_and_after():
+    """The acceptance scenario: a granted-then-expired lease deletes its
+    attached keys via normal revision-stamped events — a watcher started
+    BEFORE expiry sees the DELETE live, and one started AFTER expiry sees
+    it in replay at a real mod_revision."""
+    b, store = make_backend()
+    reg = ensure_lease(b, reap_interval=0.05, checkpoint_interval=60.0)
+    try:
+        wid_a, q_a = b.watch(b"/")
+        lease = reg.grant(0.4)
+        r1 = b.create(b"/registry/pods/leased", b"v", lease=lease.id)
+        r2 = b.create(b"/events/leased-event", b"e", lease=lease.id)
+        assert wait_for_revision(b, r2)
+        assert reg.time_to_live(lease.id)[2] == (
+            b"/events/leased-event", b"/registry/pods/leased")
+
+        def has_deletes(evs):
+            return sum(e.verb == Verb.DELETE for e in evs) >= 2
+
+        events = drain_events(q_a, until=has_deletes)
+        deletes = [e for e in events if e.verb == Verb.DELETE]
+        assert {e.key for e in deletes} == {
+            b"/registry/pods/leased", b"/events/leased-event"}
+        # revision-stamped: real revisions dealt after the creates
+        assert all(e.revision > r2 for e in deletes)
+
+        # lease is gone: TTL=-1, enumeration empty, keys deleted
+        assert reg.time_to_live(lease.id)[0] == -1
+        assert reg.ids() == []
+        with pytest.raises(KeyNotFoundError):
+            b.get(b"/registry/pods/leased")
+
+        # a watcher started after expiry replays the full history
+        wid_b, q_b = b.watch(b"/registry/", revision=r1)
+        replay = drain_events(
+            q_b, until=lambda evs: any(e.verb == Verb.DELETE for e in evs))
+        seen = [(e.verb, e.revision) for e in replay
+                if e.key == b"/registry/pods/leased"]
+        assert seen and seen[-1][0] == Verb.DELETE
+        assert seen[-1][1] > r1  # the delete carries a real, later revision
+        b.unwatch(wid_a)
+        b.unwatch(wid_b)
+    finally:
+        b.close()
+        store.close()
+
+
+def test_revoke_deletes_attached_keys():
+    b, store = make_backend()
+    reg = ensure_lease(b, reap_interval=60.0, checkpoint_interval=60.0)
+    reaper = b._kb_lease_reaper
+    try:
+        lease = reg.grant(60)
+        rev = b.create(b"/registry/locks/l1", b"holder", lease=lease.id)
+        assert wait_for_revision(b, rev)
+        assert reaper.revoke(lease.id) == 1
+        with pytest.raises(KeyNotFoundError):
+            b.get(b"/registry/locks/l1")
+        assert reg.time_to_live(lease.id)[0] == -1
+        with pytest.raises(LeaseNotFoundError):
+            reaper.revoke(lease.id)  # second revoke: lease unknown
+    finally:
+        b.close()
+        store.close()
+
+
+# ============================================================== persistence
+def test_lease_state_survives_restart():
+    """Remaining TTL + attachments checkpoint through the storage engine
+    and rehydrate on restart."""
+    store = new_storage("memkv")
+    b1 = Backend(store, BackendConfig(event_ring_capacity=4096))
+    reg1 = ensure_lease(b1, reap_interval=60.0, checkpoint_interval=60.0)
+    lease = reg1.grant(30)
+    rev = b1.create(b"/registry/pods/persist", b"v", lease=lease.id)
+    assert wait_for_revision(b1, rev)
+    b1.close()  # reaper close → final checkpoint (remaining TTL persisted)
+
+    b2 = Backend(store, BackendConfig(event_ring_capacity=4096))
+    reg2 = ensure_lease(b2, reap_interval=60.0, checkpoint_interval=60.0)
+    try:
+        ttl, granted, keys = reg2.time_to_live(lease.id)
+        assert granted == 30
+        assert 0 < ttl <= 30  # the countdown resumed, not restarted
+        assert keys == (b"/registry/pods/persist",)
+        assert b2.get(b"/registry/pods/persist").value == b"v"
+    finally:
+        b2.close()
+        store.close()
+
+
+def test_restart_reaps_expired_leases_instead_of_resurrecting():
+    """A lease that expired while the server was down is reaped at boot:
+    its keys get revision-stamped deletes, never a fresh TTL."""
+    store = new_storage("memkv")
+    b1 = Backend(store, BackendConfig(event_ring_capacity=4096))
+    reg1 = ensure_lease(b1, reap_interval=60.0, checkpoint_interval=60.0)
+    lease = reg1.grant(0.2)
+    rev = b1.create(b"/registry/pods/doomed", b"v", lease=lease.id)
+    assert wait_for_revision(b1, rev)
+    time.sleep(0.4)  # expire while "down" (reaper idle at 60s cadence)
+    b1.close()
+
+    b2 = Backend(store, BackendConfig(event_ring_capacity=4096))
+    ensure_lease(b2, reap_interval=0.05, checkpoint_interval=60.0)
+    try:
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            try:
+                b2.get(b"/registry/pods/doomed")
+                time.sleep(0.05)
+            except KeyNotFoundError:
+                break
+        with pytest.raises(KeyNotFoundError):
+            b2.get(b"/registry/pods/doomed")
+        assert b2._kb_lease.time_to_live(lease.id)[0] == -1
+        # the delete was sequenced (revision-stamped), not a silent wipe
+        assert b2.current_revision() > rev
+    finally:
+        b2.close()
+        store.close()
+
+
+# ================================================================= overload
+def test_keepalive_not_shed_at_10x_overload():
+    """Keepalives ride the scheduler's SYSTEM lane: with the background
+    lane 10x oversubscribed (test_sched pattern), every keepalive must
+    still succeed — a shed keepalive would expire a healthy client's lease
+    and cascade into key deletion."""
+    from kubebrain_tpu.sched import (
+        Lane, SchedConfig, SchedOverloadError, ensure_scheduler,
+    )
+    from kubebrain_tpu.server.etcd.misc import LeaseService
+    from kubebrain_tpu.proto import rpc_pb2
+
+    b, store = make_backend()
+    sched = ensure_scheduler(b, SchedConfig(depth=1, queue_limit=16,
+                                            shed_ms=30_000.0))
+    ensure_lease(b, reap_interval=60.0, checkpoint_interval=60.0)
+    svc = LeaseService(b)
+    lease = svc.registry.grant(30)
+
+    stop = threading.Event()
+    sheds = [0]
+
+    def flood():
+        # keep the background queue pinned at 10x its limit
+        while not stop.is_set():
+            for _ in range(10 * 16):
+                try:
+                    sched.submit_async(lambda: time.sleep(0.005),
+                                       lane=Lane.BACKGROUND, client="flood")
+                except SchedOverloadError:
+                    sheds[0] += 1
+            time.sleep(0.002)
+
+    flooder = threading.Thread(target=flood, daemon=True)
+    flooder.start()
+    try:
+        time.sleep(0.05)  # let the flood saturate the lane
+        for _ in range(20):
+            resp = svc.keepalive_one(rpc_pb2.LeaseKeepAliveRequest(ID=lease.id))
+            assert resp.TTL > 0  # refreshed, never shed, never expired
+        assert sheds[0] > 0, "flood never oversubscribed the background lane"
+        assert svc.registry.time_to_live(lease.id)[0] > 0
+    finally:
+        stop.set()
+        flooder.join(timeout=5)
+        b.close()
+        store.close()
+
+
+# ============================================================== wire surface
+@pytest.fixture(scope="module")
+def server():
+    import socket
+
+    from kubebrain_tpu.cli import build_endpoint, build_parser
+    from kubebrain_tpu.client import EtcdCompatClient
+
+    def free_port():
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    port = free_port()
+    args = build_parser().parse_args([
+        "--single-node", "--storage", "memkv", "--host", "127.0.0.1",
+        "--client-port", str(port),
+        "--peer-port", str(free_port()), "--info-port", str(free_port()),
+        "--lease-reap-interval", "0.1",
+        "--lease-checkpoint-interval", "60",
+    ])
+    endpoint, backend, store = build_endpoint(args)
+    endpoint.run()
+    client = EtcdCompatClient(f"127.0.0.1:{port}")
+    yield client, backend
+    client.close()
+    endpoint.close()
+    backend.close()
+    store.close()
+
+
+K_LEASED = b"/registry/pods/default/leased-pod"
+
+
+def test_wire_lease_lifecycle_with_expiry(server):
+    """etcd3 wire acceptance: grant → put-with-lease → TimeToLive(keys) →
+    expiry → watcher sees DELETE at a real mod_revision → TTL=-1."""
+    client, _backend = server
+    events, cancel = client.watch(b"/registry/pods/", b"/registry/pods0")
+
+    lease_id, granted = client.lease_grant(1)
+    assert lease_id > 0 and granted == 1
+    ok, rev = client.create(K_LEASED, b"spec", lease=lease_id)
+    assert ok and rev > 0
+
+    ttl, g, keys = client.lease_time_to_live(lease_id, keys=True)
+    assert ttl >= 0 and g == 1 and keys == [K_LEASED]
+    assert lease_id in client.lease_leases()
+
+    kind, kv, _prev = next(events)  # the create
+    assert (kind, kv.key) == ("PUT", K_LEASED)
+    kind, kv, _prev = next(events)  # the reaper's expiry delete
+    assert (kind, kv.key) == ("DELETE", K_LEASED)
+    assert kv.mod_revision > rev  # revision-stamped, sequenced after create
+    cancel()
+
+    assert client.get(K_LEASED) is None
+    assert client.lease_time_to_live(lease_id)[0] == -1
+    assert lease_id not in client.lease_leases()
+
+    # a watcher started AFTER expiry replays the delete from the cache
+    late_events, late_cancel = client.watch(
+        b"/registry/pods/", b"/registry/pods0", start_revision=rev)
+    kinds = [next(late_events)[0] for _ in range(2)]
+    assert kinds == ["PUT", "DELETE"]
+    late_cancel()
+
+
+def test_wire_keepalive_extends_and_revoke_deletes(server):
+    """The client lease() helper: background keepalive holds a 1s-TTL lease
+    alive well past its granted TTL; revoke deletes the attached key."""
+    client, _backend = server
+    h = client.lease(ttl=1, keepalive_interval=0.25)
+    key = b"/registry/pods/default/kept-alive"
+    ok, _rev = client.create(key, b"spec", lease=h.id)
+    assert ok
+    time.sleep(2.2)  # > 2x the granted TTL: only keepalives explain survival
+    assert h.alive
+    assert client.get(key) is not None
+    assert client.lease_time_to_live(h.id)[0] >= 0
+
+    h.revoke()
+    assert client.get(key) is None
+    assert client.lease_time_to_live(h.id)[0] == -1
+
+
+def test_wire_put_under_unknown_lease_fails(server):
+    import grpc
+
+    client, _backend = server
+    with pytest.raises(grpc.RpcError) as ei:
+        client.create(b"/registry/pods/default/orphan", b"v", lease=987654321)
+    assert ei.value.code() == grpc.StatusCode.NOT_FOUND
+    assert "lease not found" in ei.value.details()
+    assert client.get(b"/registry/pods/default/orphan") is None

@@ -251,7 +251,8 @@ def test_quorum_kill9_leader_auto_elects_no_acked_loss(tmp_path):
         t_kill = time.monotonic()
         c.kill(leader0)
         leader1, epoch1 = c.wait_leader(s, timeout=20.0)
-        elect_window = time.monotonic() - t_kill
+        t_elect = time.monotonic()
+        elect_window = t_elect - t_kill
         assert leader1 != leader0 and epoch1 > epoch0
         assert elect_window < 15.0, f"election took {elect_window:.1f}s"
         time.sleep(1.5)  # post-failover progress
@@ -260,6 +261,23 @@ def test_quorum_kill9_leader_auto_elects_no_acked_loss(tmp_path):
             t.join(timeout=30)
         assert not any(t.is_alive() for t in writers)
         assert len(acked) > 30, f"writers made little progress: {len(acked)}"
+
+        # Close the uncertain-op windows (the test_linearizability round-5
+        # discipline; ADVICE.md): uncapped ret=inf windows overlap every
+        # later op, and under CI load the checker's search explodes on them
+        # — the known load-sensitive flake mode. Cap ONLY ops whose call
+        # preceded the SIGKILL: a post-kill uncertain op can be re-issued to
+        # the new leader by the remote tier's retry loop, so its true
+        # linearization point may land after election and capping it would
+        # fabricate a violation. The cap VALUE is election-complete time
+        # (t_elect): a pre-kill frame can still drain from follower buffers
+        # a few ms past primary death, but by the time the new term is
+        # elected those frames have long applied or died with the leader.
+        # Mutate only after proving every writer thread is gone (asserted
+        # above) — a live writer could still be appending to the history.
+        for op in list(history.ops):
+            if op.ok is None and op.ret == math.inf and op.call < t_kill:
+                op.ret = t_elect
 
         # zero acked loss, read back from the NEW leader
         missing = [k for k in acked if _get(s, k) is None]

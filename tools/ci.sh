@@ -9,28 +9,35 @@
 #   4. observability    — trace/span tests + a live-server smoke: one Range
 #                         must populate /debug/traces and the
 #                         kb_rpc_stage_seconds histogram
-#   5. tier-1 pytest    — the ROADMAP.md verify command
+#   5. lease subsystem  — TTL state machine + revision-stamped expiry
+#                         (a lease regression silently breaks apiserver
+#                         event TTLs; fail before the long tier-1 run)
+#   6. tier-1 pytest    — the ROADMAP.md verify command
 # Run from anywhere; operates on the repo this script lives in.
 
 set -uo pipefail
 
 cd "$(dirname "$0")/.."
 
-echo "=== [1/5] make lint"
+echo "=== [1/6] make lint"
 make lint || exit 1
 
-echo "=== [2/5] make typecheck"
+echo "=== [2/6] make typecheck"
 make typecheck || exit 1
 
-echo "=== [3/5] scheduler semantics + bench-smoke (CPU fallback)"
+echo "=== [3/6] scheduler semantics + bench-smoke (CPU fallback)"
 env JAX_PLATFORMS=cpu python -m pytest tests/test_sched.py -q -m 'not slow' \
     -p no:cacheprovider || exit 1
 make bench-smoke || exit 1
 
-echo "=== [4/5] request tracing: span tests + live-server /debug/traces smoke"
+echo "=== [4/6] request tracing: span tests + live-server /debug/traces smoke"
 env JAX_PLATFORMS=cpu python -m pytest tests/test_trace.py -q -m 'not slow' \
     -p no:cacheprovider || exit 1
 env JAX_PLATFORMS=cpu python tools/smoke_trace.py || exit 1
 
-echo "=== [5/5] tier-1 tests (ROADMAP.md verify, one definition: make test-tier1)"
+echo "=== [5/6] lease subsystem: TTL state machine + revision-stamped expiry"
+env JAX_PLATFORMS=cpu python -m pytest tests/test_lease.py -q -m 'not slow' \
+    -p no:cacheprovider || exit 1
+
+echo "=== [6/6] tier-1 tests (ROADMAP.md verify, one definition: make test-tier1)"
 exec make test-tier1

@@ -251,6 +251,82 @@ class NoPrintOrRawLatency(Rule):
                     )
 
 
+_TTL_TOKENS = {"ttl", "deadline", "deadlines", "expire", "expires", "expired",
+               "expiry", "lease", "leases", "keepalive"}
+
+
+def _ttlish(expr: ast.expr) -> str | None:
+    """The dotted name of the first TTL/deadline-carrying Name/Attribute
+    inside ``expr`` ('ttl', 'deadline', 'lease.expires_at', ...)."""
+    for node in ast.walk(expr):
+        name = terminal_name(node) if isinstance(node, (ast.Name, ast.Attribute)) else ""
+        if name and _TTL_TOKENS & set(name.lower().split("_")):
+            return dotted_name(node) or name
+    return None
+
+
+@register
+class MonotonicLeaseClock(Rule):
+    """Wall-clock TTL math breaks under clock steps: an NTP jump (or VM
+    suspend/resume) either mass-expires every lease or grants them hours of
+    free life. Live deadlines belong on the monotonic clock —
+    ``kubebrain_tpu/lease/clock.py`` is the one serving-path module allowed
+    to touch the conversion."""
+
+    rule_id = "KB108"
+    summary = ("no time.time() TTL/deadline arithmetic on the serving path "
+               "outside kubebrain_tpu/lease/clock.py — use lease.clock")
+
+    def applies(self, relpath: str) -> bool:
+        rp = relpath.replace("\\", "/")
+        if rp == "kubebrain_tpu/lease/clock.py":
+            return False
+        return rp.startswith((
+            "kubebrain_tpu/lease/", "kubebrain_tpu/backend/",
+            "kubebrain_tpu/server/", "kubebrain_tpu/sched/",
+            "kubebrain_tpu/endpoint/",
+        ))
+
+    def check(self, tree: ast.Module, src: str) -> Iterable[tuple[ast.AST, str]]:
+        for node in ast.walk(tree):
+            if isinstance(node, ast.BinOp) and isinstance(node.op, (ast.Add, ast.Sub)):
+                sides = (node.left, node.right)
+                if any(_contains_time_time(s) for s in sides):
+                    name = _ttlish(node.left) or _ttlish(node.right)
+                    if name:
+                        yield node, (
+                            f"wall-clock TTL/deadline arithmetic with {name!r}; "
+                            "use kubebrain_tpu.lease.clock (monotonic)"
+                        )
+            elif isinstance(node, ast.Compare):
+                exprs = (node.left, *node.comparators)
+                if any(_contains_time_time(e) for e in exprs):
+                    name = next((t for e in exprs if (t := _ttlish(e))), None)
+                    if name:
+                        yield node, (
+                            f"wall-clock deadline comparison with {name!r}; "
+                            "use kubebrain_tpu.lease.clock (monotonic)"
+                        )
+            elif isinstance(node, ast.Assign):
+                # deadline = time.time() + 30 — ttl-ish target, constant rhs
+                value = node.value
+                if not (isinstance(value, ast.BinOp)
+                        and isinstance(value.op, (ast.Add, ast.Sub))
+                        and _contains_time_time(value)):
+                    continue
+                if _ttlish(value.left) or _ttlish(value.right):
+                    continue  # the BinOp branch reports this one
+                for target in node.targets:
+                    name = _ttlish(target) if isinstance(
+                        target, (ast.Name, ast.Attribute)) else None
+                    if name:
+                        yield node, (
+                            f"wall-clock deadline assigned to {name!r}; "
+                            "use kubebrain_tpu.lease.clock (monotonic)"
+                        )
+                        break
+
+
 _REV_TOKENS = {"rev", "revision"}
 
 
