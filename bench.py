@@ -987,6 +987,50 @@ def bench_sched() -> None:
 
     mismatches = sum(1 for a, b in zip(results, expect) if a != b)
     assert mismatches == 0, f"{mismatches}/{n_req} scheduled results diverged"
+
+    # deterministic batch-formation check (ISSUE 5): plug the single slot of
+    # a fresh scheduler, queue 8 distinct ranges + counts, release — they
+    # must ride ONE backend batch and match sequential results byte for byte
+    from kubebrain_tpu.sched import Lane
+
+    store2 = new_storage("memkv")
+    backend2 = Backend(store2, BackendConfig(event_ring_capacity=8192))
+    sched2 = ensure_scheduler(backend2, SchedConfig(depth=1, batch=8))
+    for i in range(200):
+        backend2.create(b"/registry/pods/ns-%02d/p-%04d" % (i % 8, i), b"x" * 32)
+    release = threading.Event()
+    sched2.submit_async(release.wait, Lane.SYSTEM)
+    time.sleep(0.1)
+    outs: dict = {}
+
+    def one_batched(i):
+        ns = i % 8
+        a, b = b"/registry/pods/ns-%02d/" % ns, b"/registry/pods/ns-%02d0" % ns
+        if i % 3 == 2:
+            outs[i] = ("count", sched2.count(a, b, client="w"))
+        else:
+            outs[i] = ("list", fingerprint(sched2.list_(a, b, 0, 0, client="w")))
+    bthreads = [threading.Thread(target=one_batched, args=(i,)) for i in range(8)]
+    for t in bthreads:
+        t.start()
+    time.sleep(0.3)
+    release.set()
+    for t in bthreads:
+        t.join(30.0)
+    assert sched2.batched > 0, "plugged slot formed no batch"
+    batched_mismatches = 0
+    for i in range(8):
+        ns = i % 8
+        a, b = b"/registry/pods/ns-%02d/" % ns, b"/registry/pods/ns-%02d0" % ns
+        if i % 3 == 2:
+            want = ("count", backend2.count(a, b))
+        else:
+            want = ("list", fingerprint(backend2.list_(a, b, 0, 0)))
+        batched_mismatches += outs[i] != want
+    assert batched_mismatches == 0, f"{batched_mismatches}/8 batched diverged"
+    backend2.close()
+    store2.close()
+
     print(json.dumps({
         "metric": "scheduled range reqs/sec",
         "value": round(n_req / sched_dt),
@@ -996,6 +1040,8 @@ def bench_sched() -> None:
             "requests": n_req, "keys": n_keys, "depth": depth,
             "byte_identical": True,
             "coalesced": sched.coalesced,
+            "batched_riders": sched2.batched,
+            "batched_byte_identical": True,
             "shed": {l.name.lower(): c for l, c in sched.shed_counts.items()},
             "sequential_reqs_per_sec": round(n_req / seq_dt),
             "baseline": "unscheduled sequential backend.list_",
@@ -1376,6 +1422,150 @@ def main() -> None:
     print(f"[bench] scheduled x{n_req} depth {depth}: "
           f"{scheduled/1e6:.1f}M rows/s", file=sys.stderr)
 
+    # QUERY-BATCHED dispatch (ISSUE 5): the same scheduler concurrency over
+    # 8 DISTINCT prefix ranges, but a freed dispatch slot drains every
+    # compatible ready request and launches ONE query-batched kernel for
+    # the whole set — the kernel-launch amortization the scheduler's
+    # pipelining alone can't buy (each pipelined request still pays its own
+    # launch). Acceptance on TPU: >= 1.5x the scheduled rate at the same
+    # concurrency, byte-identical per-query results; on the CPU dry run:
+    # byte-identical and within 10% of sequential.
+    NQ = 8
+    # distinct bounds: the dataset's key-space octile borders (real rows)
+    q_rows = [(n * i) // NQ for i in range(NQ)]
+    if use_pallas:
+        q_starts = np.stack([sp.pack_bound_flipped(chunks[r]) for r in q_rows])
+        q_ends = np.stack(
+            [sp.pack_bound_flipped(chunks[(n * (i + 1)) // NQ - 1])
+             for i in range(NQ - 1)] + [q_starts[0]])
+        q_unb = np.array([0] * (NQ - 1) + [1], dtype=np.int32)
+        q_his = np.full(NQ, np.int32(qhi31[0]), dtype=np.int32)
+        q_los = np.full(NQ, np.int32(qlo31[0]), dtype=np.int32)
+
+        @jax.jit
+        def count_one_q(kt, a, b, t, s_, e_, u_):
+            mask = sp.scan_mask_pallas(
+                kt, a, b, t, np.int32(n_real), s_, e_, u_,
+                np.int32(qhi31[0]), np.int32(qlo31[0]), interpret=interp)
+            return jnp.sum(mask, dtype=jnp.int32)
+
+        @jax.jit
+        def count_many_q(kt, a, b, t, ss, ee, uu, hh, ll):
+            mask = sp.scan_mask_pallas_q(
+                kt, a, b, t, np.int32(n_real), ss, ee, uu, hh, ll,
+                interpret=interp)
+            return jnp.sum(mask, axis=1, dtype=jnp.int32)
+
+        def one_count(k):
+            return count_one_q(*p_args, jnp.asarray(q_starts[k]),
+                               jnp.asarray(q_ends[k]), np.int32(q_unb[k]))
+
+        def many_counts(ks):
+            return count_many_q(
+                *p_args, jnp.asarray(q_starts[ks]), jnp.asarray(q_ends[ks]),
+                jnp.asarray(q_unb[ks]), jnp.asarray(q_his[ks]),
+                jnp.asarray(q_los[ks]))
+    else:
+        from kubebrain_tpu.ops.scan import visibility_mask_queries
+
+        q_starts = np.stack([chunks[r] for r in q_rows])
+        q_ends = np.stack([chunks[(n * (i + 1)) // NQ - 1]
+                           for i in range(NQ - 1)] + [q_starts[0]])
+        q_unb = np.array([False] * (NQ - 1) + [True])
+        q_his = np.full(NQ, qhi, dtype=np.uint32)
+        q_los = np.full(NQ, qlo, dtype=np.uint32)
+
+        @jax.jit
+        def count_one_q(keys, a, b, t, num, s_, e_, u_):
+            mask = visibility_mask(keys, a, b, t, num, s_, e_, u_, qhi, qlo)
+            return jnp.sum(mask, dtype=jnp.int32)
+
+        @jax.jit
+        def count_many_q(keys, a, b, t, num, ss, ee, uu, hh, ll):
+            masks = visibility_mask_queries(
+                keys, a, b, t, num, ss, ee, uu, hh, ll)
+            return jnp.sum(masks, axis=1, dtype=jnp.int32)
+
+        def one_count(k):
+            return count_one_q(d_args[0], d_args[1], d_args[2], d_args[3], nv,
+                               jnp.asarray(q_starts[k]),
+                               jnp.asarray(q_ends[k]), jnp.asarray(bool(q_unb[k])))
+
+        def many_counts(ks):
+            return count_many_q(
+                d_args[0], d_args[1], d_args[2], d_args[3], nv,
+                jnp.asarray(q_starts[ks]), jnp.asarray(q_ends[ks]),
+                jnp.asarray(q_unb[ks]), jnp.asarray(q_his[ks]),
+                jnp.asarray(q_los[ks]))
+
+    def batch_exec(descs):
+        """Scheduler batch executor: range indices -> per-query counts from
+        ONE kernel launch (pow2-padded like TpuScanner._dev_mask_batch)."""
+        ks = list(descs)
+        qp = 1
+        while qp < len(ks):
+            qp *= 2
+        counts = np.asarray(many_counts(np.array(ks + [ks[0]] * (qp - len(ks)))))
+        return [int(counts[j]) for j in range(len(ks))]
+
+    # warm + per-query oracle (sequential single dispatches)
+    expect_q = [int(one_count(k)) for k in range(NQ)]
+    batch_exec(list(range(NQ)))  # compile the Q=8 shape off the clock
+    t0 = time.time()
+    for i in range(n_req):
+        int(one_count(i % NQ))
+    seq_q_dt = time.time() - t0
+
+    # distinct ranges through the scheduler, one dispatch each (baseline)
+    sched = RequestScheduler(None, SchedConfig(depth=depth, batch=1))
+    try:
+        sched.submit(lambda: int(one_count(0)))  # warm the worker threads
+        t0 = time.time()
+        reqs = [sched.submit_async(
+            lambda k=i % NQ: int(one_count(k)), client=f"c{i % 4}")
+            for i in range(n_req)]
+        got_sched = [r.wait(300.0) for r in reqs]
+        sched_q_dt = time.time() - t0
+    finally:
+        sched.close()
+    scheduled_q = n * n_req / sched_q_dt
+    assert all(got_sched[i] == expect_q[i % NQ] for i in range(n_req))
+
+    # the same requests with batch formation on: slots plugged so every
+    # ready request queues, then one release -> n_req/NQ batched launches
+    sched = RequestScheduler(None, SchedConfig(depth=depth, batch=NQ))
+    try:
+        import threading as _threading
+        release = _threading.Event()
+        for _ in range(depth):
+            sched.submit_async(release.wait)
+        time.sleep(0.05)
+        reqs = [sched.submit_async(
+            lambda k=i % NQ: batch_exec([k])[0], client=f"c{i % 4}",
+            bargs=i % NQ, bexec=batch_exec) for i in range(n_req)]
+        t0 = time.time()
+        release.set()
+        got_batched = [r.wait(300.0) for r in reqs]
+        batched_dt = time.time() - t0
+    finally:
+        sched.close()
+    batched = n * n_req / batched_dt
+    mism = sum(1 for i in range(n_req) if got_batched[i] != expect_q[i % NQ])
+    assert mism == 0, f"{mism}/{n_req} batched results diverged"
+    print(f"[bench] batched x{n_req} ({NQ} distinct ranges/launch): "
+          f"{batched/1e6:.1f}M rows/s ({batched/scheduled_q:.2f}x scheduled, "
+          f"batched riders {sched.batched})", file=sys.stderr)
+    if on_tpu:
+        assert batched >= 1.5 * scheduled_q, (
+            f"batched {batched/1e6:.1f}M rows/s < 1.5x scheduled "
+            f"{scheduled_q/1e6:.1f}M rows/s at {NQ} distinct ranges")
+    else:
+        # CPU dry run: the batched path must cost ~the same total compute
+        tol = float(os.environ.get("KB_BENCH_BATCH_TOL", "1.10"))
+        assert batched_dt <= seq_q_dt * tol, (
+            f"CPU batched path {batched_dt:.3f}s vs sequential "
+            f"{seq_q_dt:.3f}s (> {tol:.0%})")
+
     # per-stage time fractions from the tracer's EWMAs: device stages from
     # the traced single-dispatch run, queue_wait from the scheduled run
     # (the scheduler records it for every request)
@@ -1405,6 +1595,11 @@ def main() -> None:
             "scheduled_rows_per_sec": round(scheduled),
             "scheduled_depth": depth,
             "scheduled_vs_single_dispatch": round(scheduled / rate, 3),
+            "scheduled_distinct_rows_per_sec": round(scheduled_q),
+            "batched_rows_per_sec": round(batched),
+            "batched_queries_per_launch": NQ,
+            "batched_vs_scheduled": round(batched / scheduled_q, 3),
+            "batched_byte_identical": True,
             "cpu_numpy_rows_per_sec": round(cpu_rate),
             "device": str(dev),
             "kernel": "pallas" if use_pallas else "jnp",

@@ -444,6 +444,57 @@ class Backend:
         read_rev = self._read_revision_checked(revision)
         return self.scanner.count(start, end, read_rev), read_rev
 
+    def list_batch(self, queries: list) -> list:
+        """Batched range reads — the scheduler's batch executor. ``queries``
+        is a list of ``("list", start, end, revision, limit)`` /
+        ``("count", start, end, revision)`` tuples; the return list is
+        aligned with it, each element a RangeResult, a ``(count,
+        read_rev)`` tuple, or an Exception instance to raise to that
+        query's waiter alone (a compacted revision fails its query, not
+        the batch). Read revisions resolve here, at execution start — the
+        same point a sequential execution would resolve them, so rev-0
+        batching preserves read-your-writes exactly like coalescing does.
+        Engines with a query-batched scanner (``scan_batch``, the TPU
+        mirror) answer every device-path query in ONE kernel dispatch;
+        other engines fall back to per-query scans with identical results.
+        """
+        out: list = [None] * len(queries)
+        resolved: list[tuple[int, tuple, int]] = []
+        for i, q in enumerate(queries):
+            try:
+                resolved.append((i, q, self._read_revision_checked(q[3])))
+            except Exception as e:
+                out[i] = e
+        scan_batch = getattr(self.scanner, "scan_batch", None)
+        if scan_batch is not None and len(resolved) > 1:
+            specs = [
+                ("count", q[1], q[2], rr) if q[0] == "count"
+                else ("range", q[1], q[2], rr, q[4])
+                for _i, q, rr in resolved
+            ]
+            results = scan_batch(specs)
+            for (i, q, rr), res in zip(resolved, results):
+                if isinstance(res, BaseException):
+                    out[i] = res
+                elif q[0] == "count":
+                    out[i] = (res, rr)
+                else:
+                    kvs, more = res
+                    out[i] = RangeResult(kvs=kvs, revision=rr, more=more,
+                                         count=len(kvs))
+            return out
+        for i, q, rr in resolved:  # engine-generic sequential fallback
+            try:
+                if q[0] == "count":
+                    out[i] = (self.scanner.count(q[1], q[2], rr), rr)
+                else:
+                    kvs, more = self.scanner.range_(q[1], q[2], rr, q[4])
+                    out[i] = RangeResult(kvs=kvs, revision=rr, more=more,
+                                         count=len(kvs))
+            except Exception as e:
+                out[i] = e
+        return out
+
     def list_by_stream(
         self, start: bytes, end: bytes, revision: int = 0
     ) -> tuple[int, Iterator[list[KeyValue]]]:

@@ -94,6 +94,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--sched-queue-limit", type=int, default=1024,
                    help="request scheduler: per-lane queued-request bound; "
                         "enqueue past it sheds immediately")
+    p.add_argument("--sched-batch", type=int, default=8,
+                   help="request scheduler: max distinct ready Range/Count "
+                        "requests drained into one dispatch slot — over the "
+                        "TPU engine they become ONE query-batched kernel "
+                        "launch (bench batched_rows_per_sec); 1 disables")
     p.add_argument("--grpc-workers", type=int, default=256,
                    help="gRPC worker threads; each open watch stream holds one")
     p.add_argument("--aio-port", type=int, default=0,
@@ -158,6 +163,8 @@ def validate_args(args) -> None:
     if getattr(args, "sched_depth", 1) < 0 or getattr(args, "sched_queue_limit", 1) < 1:
         raise SystemExit("--sched-depth must be >= 0 (0 = auto) and "
                          "--sched-queue-limit must be >= 1")
+    if getattr(args, "sched_batch", 1) < 1:
+        raise SystemExit("--sched-batch must be >= 1 (1 disables batching)")
     if getattr(args, "sched_shed_ms", 1.0) <= 0:
         raise SystemExit("--sched-shed-ms must be > 0")
     if getattr(args, "trace_slow_ms", 0.0) < 0:
@@ -254,6 +261,7 @@ def build_endpoint(args):
         depth=args.sched_depth,
         queue_limit=args.sched_queue_limit,
         shed_ms=args.sched_shed_ms,
+        batch=args.sched_batch,
     ), metrics=metrics)
 
     identity = args.identity or f"{get_host()}:{args.peer_port}"

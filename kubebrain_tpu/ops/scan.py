@@ -81,6 +81,22 @@ def visibility_mask(
     return cand & ~superseded & ~tomb
 
 
+def visibility_mask_queries(
+    keys, rev_hi, rev_lo, tomb, n_valid, starts, ends, unbounded_ends,
+    read_his, read_los,
+) -> jnp.ndarray:
+    """Query axis over :func:`visibility_mask`: Q distinct Range/Count
+    queries (``starts``/``ends`` uint32[Q, C] packed bounds,
+    ``unbounded_ends`` bool[Q], ``read_his``/``read_los`` uint32[Q])
+    answered against ONE block in one traced program. Returns bool[Q, N] —
+    the jnp fallback of the query-batched Pallas kernel
+    (scan_pallas.scan_mask_pallas_q)."""
+    f = lambda s, e, u, hi, lo: visibility_mask(
+        keys, rev_hi, rev_lo, tomb, n_valid, s, e, u, hi, lo
+    )
+    return jax.vmap(f)(starts, ends, unbounded_ends, read_his, read_los)
+
+
 @jax.jit
 def count_visible(keys, rev_hi, rev_lo, tomb, n_valid, start, end, unbounded_end, read_hi, read_lo):
     mask = visibility_mask(

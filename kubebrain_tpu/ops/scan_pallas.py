@@ -79,27 +79,18 @@ def _lex_less(keys, bound, neq, lt):
     return out
 
 
-def _kernel(scal_ref, start_ref, end_ref,
-            keys_ref, rh_ref, rl_ref, tomb_ref,
-            mask_ref,
-            carry_key, carry_flag):
-    i = pl.program_id(0)
-    nt = pl.num_programs(0)
-    t = nt - 1 - i  # reversed tile order
-
-    n_valid = scal_ref[0]
-    unbounded = scal_ref[1]
-    qhi = scal_ref[2]
-    qlo = scal_ref[3]
-
+def _tile_visibility(t, n_valid, unbounded, qhi, qlo, start, end,
+                     keys_ref, rh_ref, rl_ref, tomb_ref,
+                     carry_key, carry_flag):
+    """One reverse-order tile of the visibility scan: the shared body of the
+    single-query and query-batched kernels (so adding the query grid axis
+    cannot drift from the proven single-query math). Returns the int8
+    visibility block and updates the carry scratch for tile ``t - 1``."""
     keys = keys_ref[:, :]          # [C, T] int32 (sign-flipped chunks)
     rh = rh_ref[:, :]              # [1, T]
     rl = rl_ref[:, :]
     tomb = tomb_ref[:, :] != 0     # [1, T]
     c, tile = keys.shape
-
-    start = start_ref[:, :]        # [C, 1]
-    end = end_ref[:, :]
 
     neq_s = keys != start
     lt_s = keys < start
@@ -133,11 +124,51 @@ def _kernel(scal_ref, start_ref, end_ref,
     cand_next = jnp.where(is_last_col, carry_flag[0] * have_i, cand_next_i) != 0
 
     visible = cand & ~(same_next & cand_next) & ~tomb
-    mask_ref[:, :] = visible.astype(jnp.int8)
 
     # publish this tile's first column for the next grid step (tile t-1)
     carry_key[:, :] = keys[:, 0:1]
     carry_flag[0] = cand.astype(jnp.int32)[0, 0]
+    return visible.astype(jnp.int8)
+
+
+def _kernel(scal_ref, start_ref, end_ref,
+            keys_ref, rh_ref, rl_ref, tomb_ref,
+            mask_ref,
+            carry_key, carry_flag):
+    i = pl.program_id(0)
+    nt = pl.num_programs(0)
+    t = nt - 1 - i  # reversed tile order
+
+    mask_ref[:, :] = _tile_visibility(
+        t, scal_ref[0], scal_ref[1], scal_ref[2], scal_ref[3],
+        start_ref[:, :], end_ref[:, :],
+        keys_ref, rh_ref, rl_ref, tomb_ref,
+        carry_key, carry_flag,
+    )
+
+
+def _kernel_q(scal_ref, qscal_ref, start_ref, end_ref,
+              keys_ref, rh_ref, rl_ref, tomb_ref,
+              mask_ref,
+              carry_key, carry_flag):
+    """Query-batched variant: grid = (queries, reverse tiles). TPU grid
+    steps run sequentially with the LAST axis minor, so for each query q
+    the tile sweep i = 0..nt-1 is contiguous and the carry discipline of
+    the single-query kernel holds unchanged. No cross-query carry reset is
+    needed: tile nt-1 (the first step of every query) masks the carried
+    flag/key out via ``have_i`` exactly as the single-query kernel does on
+    its own first step."""
+    q = pl.program_id(0)
+    i = pl.program_id(1)
+    nt = pl.num_programs(1)
+    t = nt - 1 - i  # reversed tile order within the query
+
+    mask_ref[0] = _tile_visibility(
+        t, scal_ref[0], qscal_ref[q, 0], qscal_ref[q, 1], qscal_ref[q, 2],
+        start_ref[0], end_ref[0],
+        keys_ref, rh_ref, rl_ref, tomb_ref,
+        carry_key, carry_flag,
+    )
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
@@ -185,6 +216,62 @@ def scan_mask_pallas(keys_t, rh31, rl31, tomb, n_valid, start, end, unbounded,
         keys_t, rh31.reshape(1, n), rl31.reshape(1, n), tomb.reshape(1, n),
     )
     return mask.reshape(n) != 0
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def scan_mask_pallas_q(keys_t, rh31, rl31, tomb, n_valid, starts, ends,
+                       unbounded, qhi31, qlo31, interpret=False):
+    """Query-batched visibility masks: ONE kernel launch answers Q distinct
+    Range/Count queries over the same block (grid = queries × reverse
+    tiles) — the dispatch-bound regime's lever (BENCH_r05: pipelined
+    dispatch of the same kernel is 3.8× its single-dispatch p50, so a
+    kernel launch amortized over Q queries beats Q launches).
+
+    keys_t: int32[C, N] chunk-major sign-flipped; rh31/rl31: int32[N];
+    tomb: int8[N]; starts/ends: int32[Q, C] sign-flipped bounds;
+    unbounded/qhi31/qlo31: int32[Q] per-query scalars; n_valid scalar.
+    Returns bool[Q, N]. Q=1 is bit-identical to :func:`scan_mask_pallas`:
+    both kernels run the same ``_tile_visibility`` body, the batched grid
+    only adds a sequential query axis.
+    """
+    c, n = keys_t.shape
+    assert n % LANE_TILE == 0, "pad rows to LANE_TILE"
+    nq = starts.shape[0]
+    nt = n // LANE_TILE
+    scal = jnp.asarray(n_valid, jnp.int32).reshape(1)
+    qscal = jnp.stack([
+        jnp.asarray(unbounded, jnp.int32).reshape(nq),
+        jnp.asarray(qhi31, jnp.int32).reshape(nq),
+        jnp.asarray(qlo31, jnp.int32).reshape(nq),
+    ], axis=1)  # [Q, 3] per-query scalars, dynamically indexed from SMEM
+    rev_map = lambda q, i: (0, nt - 1 - i)
+    mask = pl.pallas_call(
+        _kernel_q,
+        grid=(nq, nt),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),            # n_valid
+            pl.BlockSpec(memory_space=pltpu.SMEM),            # per-query scalars
+            pl.BlockSpec((1, c, 1), lambda q, i: (q, 0, 0)),   # start bounds
+            pl.BlockSpec((1, c, 1), lambda q, i: (q, 0, 0)),   # end bounds
+            pl.BlockSpec((c, LANE_TILE), rev_map),             # keys
+            pl.BlockSpec((1, LANE_TILE), rev_map),             # rev hi
+            pl.BlockSpec((1, LANE_TILE), rev_map),             # rev lo
+            pl.BlockSpec((1, LANE_TILE), rev_map),             # tombstones
+        ],
+        out_specs=pl.BlockSpec((1, 1, LANE_TILE),
+                               lambda q, i: (q, 0, nt - 1 - i)),
+        out_shape=jax.ShapeDtypeStruct((nq, 1, n), jnp.int8),
+        scratch_shapes=[
+            pltpu.VMEM((c, 1), jnp.int32),                     # carried first key
+            pltpu.SMEM((1,), jnp.int32),                       # carried first cand
+        ],
+        interpret=interpret,
+    )(
+        scal, qscal,
+        starts.reshape(nq, c, 1), ends.reshape(nq, c, 1),
+        keys_t, rh31.reshape(1, n), rl31.reshape(1, n), tomb.reshape(1, n),
+    )
+    return mask.reshape(nq, n) != 0
 
 
 def _flip_sign_jnp(x: jnp.ndarray) -> jnp.ndarray:
@@ -288,6 +375,27 @@ def visibility_mask_batch_cached(keys_t, rh31, rl31, tomb8, nv, start, end,
     )
     mask = jax.vmap(f)(keys_t, rh31, rl31, tomb8, nv)
     return mask[:, :n]
+
+
+@functools.partial(jax.jit, static_argnames=("n", "interpret"))
+def visibility_mask_batch_cached_q(keys_t, rh31, rl31, tomb8, nv, starts, ends,
+                                   unbounded, read_hi, read_lo, n,
+                                   interpret=False):
+    """Query-batched Pallas path over a `prepare_mirror`-cached layout:
+    Q distinct queries × P partitions resolved in ONE dispatch. Only the
+    per-query bounds (uint32[Q, C] packed) and read revisions (uint32[Q]
+    split) are converted in-graph. Returns bool[Q, P, n]."""
+    qhi31, qlo31 = _split31_jnp(
+        jnp.asarray(read_hi, jnp.uint32), jnp.asarray(read_lo, jnp.uint32)
+    )
+    s = _flip_sign_jnp(jnp.asarray(starts, jnp.uint32))
+    e = _flip_sign_jnp(jnp.asarray(ends, jnp.uint32))
+    unb = jnp.asarray(unbounded, jnp.int32)
+    f = lambda kt, h, l, t, v: scan_mask_pallas_q(
+        kt, h, l, t, v, s, e, unb, qhi31, qlo31, interpret=interpret
+    )
+    mask = jax.vmap(f, out_axes=1)(keys_t, rh31, rl31, tomb8, nv)  # [Q, P, Npad]
+    return mask[:, :, :n]
 
 
 def prepare_blocks(chunks: np.ndarray, revs: np.ndarray, tomb: np.ndarray,

@@ -19,6 +19,19 @@ Queue model (docs/scheduler.md):
   sees everything it wrote before asking (read-your-writes holds);
   explicit-revision requests additionally join an already-executing
   leader, whose result is deterministic;
+- DISTINCT queued scan requests batch: when a dispatch slot frees, the
+  dispatcher drains up to ``batch - 1`` additional compatible ready scan
+  requests (same backend batch executor; iterators and wire-encoded
+  lists excluded) and the worker launches them as ONE batched backend
+  call — over the TPU engine that is one query-batched kernel dispatch
+  for the whole set (``TpuScanner.scan_batch``) — then demuxes each
+  member's result (or per-query error) to its own waiter. Rev-0 members
+  are safe for the same reason coalescing is: the batch resolves read
+  revisions at execution start, after every member's enqueue. Batching
+  composes with lanes (members drain in strict lane-priority order, so a
+  SYSTEM read rides the next slot rather than queuing behind it),
+  with coalescing (a drained member's followers share its demuxed
+  result), and with pipelined depth (each slot now carries a batch);
 - overload: each lane queue is bounded (``queue_limit``; enqueue sheds
   immediately when full) and every request carries an age deadline
   (``shed_ms``; stale requests shed at pop). Shed requests surface as
@@ -86,14 +99,18 @@ class SchedConfig:
     queue_limit: int = 1024  # per-lane queued-request bound
     shed_ms: float = 5000.0  # max queue age before a request is shed
     workers: int = 0         # worker threads; 0 = same as depth
+    batch: int = 8           # max distinct ready scan requests per dispatch
+    #                          slot (query-batched device scan); 1 disables
 
 
 class _Request:
     __slots__ = ("fn", "lane", "client", "key", "deterministic", "enqueued",
                  "done", "result", "error", "followers", "span", "joined",
-                 "finished_at")
+                 "finished_at", "bargs", "bexec", "batch_members",
+                 "joined_batch")
 
-    def __init__(self, fn, lane: Lane, client: str, key, deterministic=False):
+    def __init__(self, fn, lane: Lane, client: str, key, deterministic=False,
+                 bargs=None, bexec=None):
         self.fn = fn
         self.lane = lane
         self.client = client
@@ -109,6 +126,12 @@ class _Request:
         self.span = TRACER.current()
         self.joined = False       # attached to a coalesced leader
         self.finished_at = 0.0    # monotonic completion time (result_deliver)
+        # query-batching descriptor + executor: requests sharing ``bexec``
+        # may ride one dispatch slot as ``bexec([bargs...]) -> [result...]``
+        self.bargs = bargs
+        self.bexec = bexec
+        self.batch_members: list["_Request"] = []  # set on a batch leader
+        self.joined_batch = False  # rode another leader's batched dispatch
 
     # ---- completion (leader result fans out to coalesced followers)
     def finish(self, result=None, error: BaseException | None = None) -> None:
@@ -171,6 +194,25 @@ class _LaneQueue:
             return req
         return None
 
+    def pop_matching(self, pred) -> _Request | None:
+        """Pop the first request satisfying ``pred``, scanning clients in
+        service order but inspecting only each client's queue HEAD — a
+        client's own FIFO order is never reordered, and non-matching
+        clients keep their place in the round-robin."""
+        for i, client in enumerate(self.order):
+            q = self.clients.get(client)
+            if not q or not pred(q[0]):
+                continue
+            req = q.popleft()
+            self.size -= 1
+            del self.order[i]
+            if q:
+                self.order.append(client)  # back of the service order
+            else:
+                del self.clients[client]
+            return req
+        return None
+
 
 class RequestScheduler:
     """Admission + coalescing + bounded-depth pipelined dispatch.
@@ -202,6 +244,12 @@ class RequestScheduler:
         self.shed_counts = {lane: 0 for lane in Lane}
         self.coalesced = 0
         self.dispatched = 0
+        self.batched = 0  # requests that rode another leader's batch slot
+        # the backend's batch executor, resolved ONCE so member compatibility
+        # is an identity check (bound methods are fresh objects per access)
+        self._backend_bexec = (
+            getattr(backend, "list_batch", None) if backend is not None else None
+        )
         if metrics is not None:
             for lane in Lane:
                 metrics.register_gauge_fn(
@@ -308,18 +356,29 @@ class RequestScheduler:
             leftovers = list(self._runq)
             self._runq.clear()
         for r in leftovers:
+            for m in r.batch_members:  # batch riders must not strand either
+                m.finish(error=SchedClosedError("scheduler closed"))
             r.finish(error=SchedClosedError("scheduler closed"))
 
     # -------------------------------------------------------------- enqueue
     def submit_async(self, fn, lane: Lane = Lane.NORMAL, client: str = "",
-                     key=None, deterministic: bool = False) -> _Request:
+                     key=None, deterministic: bool = False, bargs=None,
+                     bexec=None) -> _Request:
         """Enqueue ``fn`` and return the waitable request (``.wait(t)``).
         Raises SchedOverloadError immediately when the lane queue is full.
         ``deterministic`` marks a request whose result is a pure function
         of its key (explicit read revision): it may additionally join an
-        already-executing leader."""
+        already-executing leader. ``bargs`` (with an optional ``bexec``
+        override, default: the backend's ``list_batch``) marks the request
+        query-batchable: a freed dispatch slot may drain it alongside other
+        requests sharing the same executor and run
+        ``bexec([bargs, ...]) -> [result-or-Exception, ...]`` as one
+        dispatch, demuxing element i to waiter i."""
         self._ensure_started()
-        req = _Request(fn, lane, client, key, deterministic)
+        if bargs is not None and bexec is None:
+            bexec = self._backend_bexec
+        req = _Request(fn, lane, client, key, deterministic,
+                       bargs=bargs, bexec=bexec)
         with self._cv:
             if self._closed:
                 raise SchedClosedError("scheduler closed")
@@ -351,9 +410,10 @@ class RequestScheduler:
         return req
 
     def submit(self, fn, lane: Lane = Lane.NORMAL, client: str = "", key=None,
-               deterministic: bool = False):
+               deterministic: bool = False, bargs=None, bexec=None):
         """Blocking submit: schedule ``fn`` and return its result."""
-        req = self.submit_async(fn, lane, client, key, deterministic)
+        req = self.submit_async(fn, lane, client, key, deterministic,
+                                bargs=bargs, bexec=bexec)
         timeout = self.config.shed_ms / 1000.0 * 4 + 60.0
         try:
             res = req.wait(timeout)
@@ -385,6 +445,7 @@ class RequestScheduler:
         return self.submit(
             lambda: self.backend.list_(start, end, revision, limit),
             lane, client, key, deterministic=revision != 0,
+            bargs=("list", start, end, revision, limit),
         )
 
     def count(self, start: bytes, end: bytes, revision: int = 0,
@@ -394,6 +455,7 @@ class RequestScheduler:
         return self.submit(
             lambda: self.backend.count(start, end, revision), lane, client,
             key, deterministic=revision != 0,
+            bargs=("count", start, end, revision),
         )
 
     def list_wire(self, start: bytes, end: bytes, revision: int = 0,
@@ -438,14 +500,53 @@ class RequestScheduler:
             if self._shed_if_stale(req):
                 self._release_slot()
                 continue
+            self._form_batch(req)
             with self._cv:
-                if req.key is not None:
-                    self._inflight[req.key] = req
-                self._inflight_count += 1
-            self.dispatched += 1
+                for r in (req, *req.batch_members):
+                    if r.key is not None:
+                        self._inflight[r.key] = r
+                    self._inflight_count += 1
+            self.dispatched += 1 + len(req.batch_members)
             with self._run_cv:
                 self._runq.append(req)
                 self._run_cv.notify()
+
+    def _form_batch(self, req: _Request) -> None:
+        """Drain up to ``batch - 1`` additional compatible ready scan
+        requests into ``req``'s dispatch slot. Compatible = carries the
+        same batch executor (the backend's ``list_batch``; streamed lists
+        and wire-encoded fast paths never set one). Members drain in
+        strict lane-priority order through the per-client round-robin, so
+        a queued SYSTEM read rides the very next slot instead of waiting
+        out lower-priority work ahead of it."""
+        if req.bexec is None or self.config.batch <= 1:
+            return
+        members: list[_Request] = []
+        want = self.config.batch - 1
+        compatible = lambda r: r.bexec is req.bexec
+        while len(members) < want:
+            with self._cv:
+                m = None
+                for lane in Lane:
+                    m = self._queues[lane].pop_matching(compatible)
+                    if m is not None:
+                        break
+                if m is None:
+                    break
+                if m.key is not None and self._pending.get(m.key) is m:
+                    del self._pending[m.key]
+            if self._shed_if_stale(m):
+                continue  # shed members don't occupy a batch position
+            members.append(m)
+        if not members:
+            return
+        req.batch_members = members
+        for m in members:
+            m.joined_batch = True
+        self.batched += len(members)
+        if self.metrics is not None:
+            self.metrics.emit_histogram(
+                "kb.sched.batch.size", float(1 + len(members)))
 
     def _next_request(self) -> _Request | None:
         with self._cv:
@@ -479,6 +580,9 @@ class RequestScheduler:
                         return
                     self._run_cv.wait(timeout=0.2)
                 req = self._runq.popleft()
+            if req.batch_members:
+                self._run_batch(req)
+                continue
             # enqueue -> execution start; recorded on the submitter's span
             TRACER.record_stage("queue_wait", req.enqueued, time.monotonic(),
                                 span=req.span)
@@ -496,6 +600,48 @@ class RequestScheduler:
                         del self._inflight[req.key]
                     self._inflight_count -= 1
             req.finish(result=result, error=err)
+
+    def _run_batch(self, req: _Request) -> None:
+        """Execute a batch leader + members as ONE backend call and demux.
+        The executor returns one result per descriptor, an Exception
+        element failing only its own query (e.g. a compacted revision);
+        an executor-level raise fails every member — the same visibility a
+        shared single dispatch would have had."""
+        batch = [req, *req.batch_members]
+        t_exec = time.monotonic()
+        for r in batch:
+            # enqueue -> execution start, on every rider's own span
+            TRACER.record_stage("queue_wait", r.enqueued, t_exec, span=r.span)
+        try:
+            with TRACER.use(req.span):
+                results = req.bexec([r.bargs for r in batch])
+            err = None
+            if len(results) != len(batch):  # executor contract violation
+                raise RuntimeError(
+                    f"batch executor returned {len(results)} results "
+                    f"for {len(batch)} queries")
+        except BaseException as e:
+            results, err = None, e
+        finally:
+            self._release_slot()
+            with self._cv:
+                for r in batch:
+                    if r.key is not None and \
+                            self._inflight.get(r.key) is r:
+                        del self._inflight[r.key]
+                    self._inflight_count -= 1
+        t_done = time.monotonic()
+        for i, r in enumerate(batch):
+            if err is not None:
+                r.finish(error=err)
+            elif isinstance(results[i], BaseException):
+                r.finish(error=results[i])
+            else:
+                r.finish(result=results[i])
+            if r is not req:
+                # the member's whole device residency happened inside the
+                # leader's execution — one stage, coalesce_join-style
+                TRACER.record_stage("batch_join", t_exec, t_done, span=r.span)
 
     # -------------------------------------------------------------- metrics
     def _emit_counter(self, name: str, lane: Lane, **tags) -> None:

@@ -34,7 +34,7 @@ from ...backend.common import TOMBSTONE
 from ...backend.scanner import CompactHistory, CompactStats, Scanner
 from ...ops import keys as keyops
 from ...ops.compact import victim_mask
-from ...ops.scan import lex_geq, lex_less, visibility_mask
+from ...ops.scan import lex_geq, lex_less, visibility_mask, visibility_mask_queries
 from ...parallel.mesh import make_mesh
 from ...trace import TRACER
 from .. import BatchWrite, CASFailedError, KvStorage, Partition, register_engine
@@ -110,18 +110,32 @@ def _vis_batch(keys, rh, rl, tomb, nv, start, end, unb, qhi, qlo):
     return mask, jnp.sum(mask, axis=1, dtype=jnp.int32)
 
 
-def _maybe_shard_map(f, mesh, n_part_args: int, n_rep_args: int):
+@jax.jit
+def _vis_batch_q(keys, rh, rl, tomb, nv, starts, ends, unbs, qhis, qlos):
+    """jnp visibility masks for Q distinct queries × all partitions in ONE
+    traced program: [Q, P, N] bool + [Q, P] counts. Elementwise over both
+    axes, so GSPMD partitions the ``part`` axis natively like _vis_batch."""
+    per_part = lambda k, a, b, t, n: visibility_mask_queries(
+        k, a, b, t, n, starts, ends, unbs, qhis, qlos)
+    mask = jax.vmap(per_part, out_axes=1)(keys, rh, rl, tomb, nv)  # [Q, P, N]
+    return mask, jnp.sum(mask, axis=2, dtype=jnp.int32)
+
+
+def _maybe_shard_map(f, mesh, n_part_args: int, n_rep_args: int,
+                     out_part_axis: int = 0):
     """shard_map ``f`` along ``part`` when the mesh is multi-device:
     pallas_call has no GSPMD partitioning rule, so without this XLA would
     replicate the whole mirror layout to every device per call. First
-    ``n_part_args`` args shard on axis 0; the rest replicate."""
+    ``n_part_args`` args shard on axis 0; the rest replicate. The output
+    shards on ``out_part_axis`` (the query-batched kernels put the query
+    axis ahead of ``part``)."""
     if mesh is None or mesh.devices.size <= 1:
         return f
     from jax.sharding import PartitionSpec as PS
 
     specs = dict(
         in_specs=(PS("part"),) * n_part_args + (PS(),) * n_rep_args,
-        out_specs=PS("part"),
+        out_specs=PS(*(None,) * out_part_axis, "part"),
     )
     shard_map = getattr(jax, "shard_map", None)
     if shard_map is None:  # pre-0.8 jax
@@ -149,12 +163,40 @@ def _vis_batch_pallas(keys_t, rh31, rl31, tomb8, nv, start, end, unb, qhi, qlo,
     return mask, jnp.sum(mask, axis=1, dtype=jnp.int32)
 
 
+@functools.partial(jax.jit, static_argnames=("n", "interpret", "mesh"))
+def _vis_batch_pallas_q(keys_t, rh31, rl31, tomb8, nv, starts, ends, unbs,
+                        qhis, qlos, n, interpret=False, mesh=None):
+    """Query-batched Pallas masks over the `prepare_mirror`-cached layout,
+    shard_map'd along ``part`` on a multi-device ``mesh`` (static):
+    [Q, P, n] bool + [Q, P] counts from ONE dispatch."""
+    from ...ops.scan_pallas import visibility_mask_batch_cached_q
+
+    f = _maybe_shard_map(
+        functools.partial(visibility_mask_batch_cached_q, n=n,
+                          interpret=interpret),
+        mesh, n_part_args=5, n_rep_args=5, out_part_axis=1,
+    )
+    mask = f(keys_t, rh31, rl31, tomb8, nv, starts, ends, unbs, qhis, qlos)
+    return mask, jnp.sum(mask, axis=2, dtype=jnp.int32)
+
+
 @functools.partial(jax.jit, static_argnames=("size",))
 def _indices_of_mask(mask, size):
     """Flat indices (p*N + row) of visible rows, device-compacted so the
     host transfer is O(results), not O(rows). ``size`` buckets to a power of
     two to bound recompiles."""
     flat = mask.reshape(-1)
+    (idx,) = jnp.nonzero(flat, size=size, fill_value=flat.shape[0])
+    return idx
+
+
+@functools.partial(jax.jit, static_argnames=("size",))
+def _indices_of_mask_sel(mask, sel, size):
+    """Flat (q·P·N + p·N + row) indices of visible rows of the SELECTED
+    queries of a batched mask [Q, P, N] — one device compaction serves
+    every Range query in the batch; Count queries (and pow2 padding
+    copies) are deselected so their rows never cross the wire."""
+    flat = (mask & sel[:, None, None]).reshape(-1)
     (idx,) = jnp.nonzero(flat, size=size, fill_value=flat.shape[0])
     return idx
 
@@ -273,6 +315,7 @@ class TpuScanner(Scanner):
         self._kernel_mesh = self._mesh if self._scan_kernel != "jnp" else None
         self._pallas_cache: tuple[Mirror, tuple] | None = None
         self._pallas_ttl_cache: tuple[Mirror, object] | None = None
+        self._probe_cache: tuple[Mirror, list] | None = None
         self._mlock = threading.RLock()
         self._mirror: Mirror | None = None
         self._delta = _DeltaIndex()
@@ -336,6 +379,7 @@ class TpuScanner(Scanner):
         self._force_rebuild = False
         self._pallas_cache = None  # old mirror's device copies must not pin
         self._pallas_ttl_cache = None
+        self._probe_cache = None
 
     def _merge_delta(self) -> None:
         """Dirty-partition-only merge: sort the delta alone, two-way merge it
@@ -356,6 +400,7 @@ class TpuScanner(Scanner):
         self._delta = _DeltaIndex()
         self._pallas_cache = None  # re-layout lazily on the next pallas query
         self._pallas_ttl_cache = None
+        self._probe_cache = None
 
     def publish(self) -> None:
         """Force the mirror fully up to date (bench/startup hook)."""
@@ -431,6 +476,48 @@ class TpuScanner(Scanner):
             mesh=self._kernel_mesh,
         )
 
+    def _dev_mask_batch(self, mirror: Mirror, specs):
+        """Batched visibility for Q distinct ``(start, end, read_rev)``
+        queries in ONE device dispatch — with :meth:`_dev_mask` the only
+        assembly points allowed to launch the scan kernels (kblint KB109),
+        so the batched path can't silently diverge from the single one.
+
+        Q is a program *shape* (the bounds arrays are [Q, C]), so every
+        distinct Q would jit-compile a fresh kernel; Q is therefore padded
+        to the next power of two with copies of query 0 and the returned
+        ``(mask [Qpad, P, N], counts [Qpad, P])`` cover the padded axis —
+        callers slice (or deselect) ``[:len(specs)]``."""
+        q = len(specs)
+        qpad = 1
+        while qpad < q:
+            qpad *= 2
+        padded = list(specs) + [specs[0]] * (qpad - q)
+        starts = np.stack([
+            keyops.pack_one(keyops.canonicalize_bound(s), self._kw)
+            for s, _e, _r in padded
+        ])
+        ends = np.stack([
+            keyops.pack_one(keyops.canonicalize_bound(e) if e else b"", self._kw)
+            for _s, e, _r in padded
+        ])
+        unbs = np.array([not e for _s, e, _r in padded])
+        qhi, qlo = keyops.split_revs(
+            np.array([r for _s, _e, r in padded], dtype=np.uint64))
+        if self._scan_kernel == "jnp":
+            return _vis_batch_q(
+                mirror.keys_dev, mirror.rh_dev, mirror.rl_dev, mirror.tomb_dev,
+                mirror.n_valid_dev, jnp.asarray(starts), jnp.asarray(ends),
+                jnp.asarray(unbs), jnp.asarray(qhi), jnp.asarray(qlo),
+            )
+        kt, rh31, rl31, t8, n = self._pallas_layout(mirror)
+        return _vis_batch_pallas_q(
+            kt, rh31, rl31, t8, mirror.n_valid_dev, jnp.asarray(starts),
+            jnp.asarray(ends), jnp.asarray(unbs.astype(np.int32)),
+            jnp.asarray(qhi), jnp.asarray(qlo),
+            n=n, interpret=(self._scan_kernel == "pallas_interpret"),
+            mesh=self._kernel_mesh,
+        )
+
     def _dev_visible_indices(self, mask, counts, n_flat: int):
         """(total, flat row indices) from a device mask — the shared
         two-phase gather: counts first (tiny transfer), then the compacted
@@ -440,6 +527,29 @@ class TpuScanner(Scanner):
         bucket = _pow2_bucket(total, n_flat)
         idx = np.asarray(_indices_of_mask(mask, size=bucket))[:total]
         return total, idx
+
+    def _materialize_visible(self, mirror: Mirror, idx: np.ndarray, overlay):
+        """Visible rows (flat p·N + row indices) → sorted KeyValue list with
+        the delta overlay merged — the ONE host materialization the single
+        and query-batched range paths share, so batched responses cannot
+        drift from sequential ones by construction."""
+        n_rows = mirror.keys_host.shape[1]
+        from ...backend.common import KeyValue
+
+        kvs: list[KeyValue] = []
+        parts, rows = np.divmod(idx, n_rows)
+        for p in np.unique(parts):
+            p_rows = rows[parts == p]
+            keys, values, revs = mirror.materialize(int(p), p_rows)
+            for uk, val, rv in zip(keys, values, revs):
+                if uk in overlay:
+                    continue  # delta supersedes
+                kvs.append(KeyValue(uk, val, int(rv)))
+        for uk, entry in overlay.items():
+            if entry is not None:
+                kvs.append(KeyValue(uk, entry[1], entry[0]))
+        kvs.sort(key=lambda kv: kv.key)
+        return kvs
 
     def range_(self, start: bytes, end: bytes, read_revision: int, limit: int = 0):
         if limit and limit <= self._host_limit_threshold:
@@ -460,26 +570,91 @@ class TpuScanner(Scanner):
             total, idx = self._dev_visible_indices(
                 mask, counts, mirror.keys_host.shape[0] * mirror.keys_host.shape[1]
             )
-        n_rows = mirror.keys_host.shape[1]
-        from ...backend.common import KeyValue
-
         with TRACER.stage("host_copy"):
-            kvs: list[KeyValue] = []
-            parts, rows = np.divmod(idx, n_rows)
-            for p in np.unique(parts):
-                p_rows = rows[parts == p]
-                keys, values, revs = mirror.materialize(int(p), p_rows)
-                for uk, val, rv in zip(keys, values, revs):
-                    if uk in overlay:
-                        continue  # delta supersedes
-                    kvs.append(KeyValue(uk, val, int(rv)))
-            for uk, entry in overlay.items():
-                if entry is not None:
-                    kvs.append(KeyValue(uk, entry[1], entry[0]))
-            kvs.sort(key=lambda kv: kv.key)
+            kvs = self._materialize_visible(mirror, idx, overlay)
         if limit:
             return kvs[:limit], len(kvs) > limit
         return kvs, False
+
+    def scan_batch(self, queries):
+        """B concurrent distinct Range/Count queries against ONE mirror
+        snapshot = ONE device dispatch (the ROADMAP query-batched
+        ``_dev_mask`` lever). ``queries`` is a list of
+        ``("range", start, end, read_rev, limit)`` /
+        ``("count", start, end, read_rev)`` tuples. Returns a list aligned
+        with ``queries`` whose elements are ``(kvs, more)`` for range,
+        ``int`` for count, or an Exception instance — per-query demux, so
+        e.g. one compacted read revision fails its own query, never the
+        batch. Results are byte-identical to sequential ``range_``/
+        ``count`` calls: bounds/revision packing, index extraction, and
+        host materialization all reuse the single-query code paths."""
+        out: list = [None] * len(queries)
+        device: list[tuple[int, tuple]] = []
+        for i, spec in enumerate(queries):
+            kind, start, end, read_rev = spec[0], spec[1], spec[2], spec[3]
+            try:
+                if (kind == "range" and spec[4]
+                        and spec[4] <= self._host_limit_threshold):
+                    # same small-page host fallback as range_: one engine
+                    # iter beats a kernel launch for a 500-row page
+                    out[i] = Scanner.range_(self, start, end, read_rev, spec[4])
+                    continue
+                self._snapshot_checked(read_rev)
+            except Exception as e:  # demuxed to this query's waiter
+                out[i] = e
+                continue
+            device.append((i, spec))
+        if not device:
+            return out
+        if len(device) == 1:
+            # a batch of one gains nothing over the proven single path
+            i, spec = device[0]
+            try:
+                if spec[0] == "count":
+                    out[i] = self.count(spec[1], spec[2], spec[3])
+                else:
+                    out[i] = self.range_(spec[1], spec[2], spec[3], spec[4])
+            except Exception as e:
+                out[i] = e
+            return out
+        self._ensure_published()
+        with self._mlock:
+            mirror = self._mirror
+            overlays = [
+                self._delta.overlay(s[1], s[2], s[3]) for _, s in device
+            ]
+        with TRACER.stage("device_dispatch", device=True):
+            mask, counts = self._dev_mask_batch(
+                mirror, [(s[1], s[2], s[3]) for _, s in device])
+            sel = np.zeros(int(mask.shape[0]), dtype=bool)
+            for k, (_, s) in enumerate(device):
+                sel[k] = s[0] == "range"  # counts (and pow2 pad) stay off-wire
+        n_rows = mirror.keys_host.shape[1]
+        # both kernels emit [Qpad, P, N] with N == the host row width; the
+        # flat-index split below silently corrupts results if that drifts
+        assert int(mask.shape[2]) == n_rows, (mask.shape, n_rows)
+        stride = int(mask.shape[1]) * n_rows
+        idx = np.empty(0, dtype=np.int64)
+        with TRACER.stage("device_compute", device=True):
+            counts_h = np.asarray(counts)  # blocks on the kernel; [Qpad, P]
+            if sel.any():
+                want = int(counts_h[sel].sum())
+                bucket = _pow2_bucket(want, int(mask.shape[0]) * stride)
+                idx = np.asarray(_indices_of_mask_sel(
+                    mask, jnp.asarray(sel), size=bucket))[:want]
+        with TRACER.stage("host_copy"):
+            for k, (qi, spec) in enumerate(device):
+                if spec[0] == "count":
+                    out[qi] = self._overlay_corrected_count(
+                        mirror, int(counts_h[k].sum()), overlays[k], spec[3])
+                    continue
+                lo = np.searchsorted(idx, k * stride)
+                hi = np.searchsorted(idx, (k + 1) * stride)
+                kvs = self._materialize_visible(
+                    mirror, idx[lo:hi] - k * stride, overlays[k])
+                limit = spec[4]
+                out[qi] = (kvs[:limit], len(kvs) > limit) if limit else (kvs, False)
+        return out
 
     def range_stream(self, start: bytes, end: bytes, read_revision: int, batch_size: int = 300):
         """Device-indexed streaming list: bounded batches materialized on
@@ -553,13 +728,78 @@ class TpuScanner(Scanner):
         with TRACER.stage("device_compute", device=True):
             counts = np.asarray(counts)
             total = int(counts.sum())
-        for uk, entry in overlay.items():
-            had = self._host_visible(mirror, uk, read_revision)
-            if entry is None and had:
+        return self._overlay_corrected_count(mirror, total, overlay, read_revision)
+
+    def _overlay_corrected_count(self, mirror: Mirror, total: int, overlay,
+                                 read_rev: int) -> int:
+        """Count = device total + delta-overlay correction. The mirror
+        visibility probes for the overlay keys run as ONE vectorized
+        searchsorted pass (`_host_visible_batch`) instead of a Python
+        binary search (with a key decode per step) per overlay key."""
+        if not overlay:
+            return total
+        keys = list(overlay.keys())
+        had = self._host_visible_batch(mirror, keys, read_rev)
+        for uk, h in zip(keys, had):
+            entry = overlay[uk]
+            if entry is None and h:
                 total -= 1
-            elif entry is not None and not had:
+            elif entry is not None and not h:
                 total += 1
         return total
+
+    def _probe_views(self, mirror: Mirror) -> list:
+        """Per-partition void views of the packed key bytes (valid rows
+        only), identity-cached per mirror like `_pallas_layout`: void rows
+        compare as raw bytes, so one np.searchsorted resolves every probe
+        of a partition at once."""
+        cached = self._probe_cache
+        if cached is not None and cached[0] is mirror:
+            return cached[1]
+        views = []
+        for p in range(mirror.partitions):
+            nv = int(mirror.n_valid[p])
+            if nv == 0:
+                views.append(np.empty(0, dtype=f"V{self._kw}"))
+                continue
+            u8 = keyops.chunks_to_u8(mirror.keys_host[p, :nv])
+            views.append(np.ascontiguousarray(u8).view(f"V{self._kw}").reshape(-1))
+        self._probe_cache = (mirror, views)
+        return views
+
+    def _host_visible_batch(self, mirror: Mirror, ukeys: list, read_rev: int) -> list:
+        """Vectorized `_host_visible` over many keys: group probes by
+        partition, one searchsorted pass per partition against the cached
+        byte view, then a per-group (short, ascending) revision pick."""
+        if not ukeys:
+            return []
+        views = self._probe_views(mirror)
+        by_part: dict[int, list[int]] = {}
+        for j, uk in enumerate(ukeys):
+            by_part.setdefault(self._partition_of(mirror, uk), []).append(j)
+        out = [False] * len(ukeys)
+        for p, idxs in by_part.items():
+            view = views[p]
+            if view.shape[0] == 0:
+                continue
+            probes_u8 = keyops.chunks_to_u8(np.stack([
+                keyops.pack_one(ukeys[j], self._kw) for j in idxs
+            ]))
+            probes = np.ascontiguousarray(probes_u8).view(
+                f"V{self._kw}").reshape(-1)
+            lo = np.searchsorted(view, probes, side="left")
+            hi = np.searchsorted(view, probes, side="right")
+            revs = mirror.revs_host[p]
+            tombs = mirror.tomb_host[p]
+            for j, l, h in zip(idxs, lo, hi):
+                if l == h:
+                    continue  # key absent from the mirror
+                # rows of one key are revision-ascending: last rev <= read_rev
+                pos = int(l) + int(np.searchsorted(
+                    revs[l:h], np.uint64(read_rev), side="right")) - 1
+                if pos >= l:
+                    out[j] = not bool(tombs[pos])
+        return out
 
     def _host_visible(self, mirror: Mirror, ukey: bytes, read_rev: int) -> bool:
         """Host-side point visibility check against the published mirror
@@ -804,6 +1044,7 @@ class TpuScanner(Scanner):
                 self._delta = _DeltaIndex()
                 self._pallas_cache = None
                 self._pallas_ttl_cache = None
+                self._probe_cache = None
         return stats
 
 

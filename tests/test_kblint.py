@@ -371,10 +371,81 @@ def test_kb108_scoped_and_suppressible():
     assert ids(sup, ANY) == []
 
 
+# ------------------------------------------------------------------- KB109
+TPU_ENG = "kubebrain_tpu/storage/tpu/x.py"
+SCHED = "kubebrain_tpu/sched/x.py"
+
+
+def test_kb109_flags_stray_kernel_call_in_engine_layer():
+    src = ("from kubebrain_tpu.ops.scan_pallas import scan_mask_pallas\n"
+           "def fast_count(kt, a, b, t, n, s, e):\n"
+           "    return scan_mask_pallas(kt, a, b, t, n, s, e, 0, 0, 0).sum()\n")
+    assert ids(src, TPU_ENG) == ["KB109"]
+    assert ids(src, SCHED) == ["KB109"]
+
+
+def test_kb109_flags_stray_dispatch_inside_class_method():
+    # TpuScanner methods are exactly where the rule's target code lives —
+    # class bodies must be descended into, not skipped at the header
+    src = ("from kubebrain_tpu.ops.scan_pallas import scan_mask_pallas\n"
+           "class Engine:\n"
+           "    def sneaky(self, *a):\n"
+           "        return scan_mask_pallas(*a)\n")
+    assert ids(src, TPU_ENG) == ["KB109"]
+    ok = ("from kubebrain_tpu.ops.scan_pallas import scan_mask_pallas_q\n"
+          "class Engine:\n"
+          "    def _dev_mask_batch(self, *a):\n"
+          "        return scan_mask_pallas_q(*a)\n")
+    assert ids(ok, TPU_ENG) == []
+
+
+def test_kb109_flags_wrapped_kernel_reference():
+    # vmap/partial around a kernel outside an assembly point is the same
+    # bypass as calling it directly
+    src = ("import jax\n"
+           "from kubebrain_tpu.ops.scan_pallas import visibility_mask_batch_cached_q\n"
+           "def sneaky(args):\n"
+           "    return jax.vmap(visibility_mask_batch_cached_q)(*args)\n")
+    assert ids(src, TPU_ENG) == ["KB109"]
+
+
+def test_kb109_allows_assembly_points_and_wrappers():
+    src = ("from kubebrain_tpu.ops.scan_pallas import scan_mask_pallas_q\n"
+           "def _vis_batch_pallas_q(kt, s):\n"
+           "    f = lambda x: scan_mask_pallas_q(x, s)\n"
+           "    return f(kt)\n"
+           "class E:\n"
+           "    def _dev_mask(self, m, s, e, r):\n"
+           "        return _vis_batch_pallas_q(m, s)\n"
+           "    def _dev_mask_batch(self, m, specs):\n"
+           "        return _vis_batch_q(m, specs)\n"
+           "    def scan_batch(self, qs):\n"
+           "        return self._dev_mask_batch(None, qs)\n")
+    assert ids(src, TPU_ENG) == []
+
+
+def test_kb109_scoped_and_suppressible():
+    src = ("from kubebrain_tpu.ops.scan_pallas import scan_mask_pallas\n"
+           "def f(*a):\n"
+           "    return scan_mask_pallas(*a)\n")
+    assert ids(src, ANY) == []  # ops/tests layers stay free to call kernels
+    sup = ("from kubebrain_tpu.ops.scan_pallas import scan_mask_pallas\n"
+           "def f(*a):\n"
+           "    return scan_mask_pallas(*a)  # kblint: disable=KB109\n")
+    assert ids(sup, TPU_ENG) == []
+
+
+def test_kb106_covers_batched_entry_points():
+    src = "def f(backend, qs):\n    return backend.list_batch(qs)\n"
+    assert ids(src, SRV_ETCD) == ["KB106"]
+    src2 = "def f(scanner, qs):\n    return scanner.scan_batch(qs)\n"
+    assert ids(src2, EP) == ["KB106"]
+
+
 # ------------------------------------------------------------ registry/CLI
 def test_registry_has_all_rules():
     assert set(RULES) == {"KB101", "KB102", "KB103", "KB104", "KB105", "KB106",
-                          "KB107", "KB108"}
+                          "KB107", "KB108", "KB109"}
     for rule in RULES.values():
         assert rule.summary
 

@@ -2,10 +2,11 @@
 # The offline CI entry point (mirrored by .github/workflows/check.yml):
 #   1. make lint        — kblint project invariants + native lint
 #   2. make typecheck   — mypy (or compileall fallback)
-#   3. scheduler gate   — sched semantics tests + bench-smoke (the
-#                         byte-identical scheduled-path check; fast, and a
-#                         scheduler regression should fail before the long
-#                         tier-1 run, not 10 minutes into it)
+#   3. scheduler gate   — sched semantics + query-batched scan tests
+#                         (batched == sequential byte-identical, incl. the
+#                         batched Pallas kernel cases) + bench-smoke; fast,
+#                         and a scheduler regression should fail before the
+#                         long tier-1 run, not 10 minutes into it
 #   4. observability    — trace/span tests + a live-server smoke: one Range
 #                         must populate /debug/traces and the
 #                         kb_rpc_stage_seconds histogram
@@ -25,8 +26,9 @@ make lint || exit 1
 echo "=== [2/6] make typecheck"
 make typecheck || exit 1
 
-echo "=== [3/6] scheduler semantics + bench-smoke (CPU fallback)"
-env JAX_PLATFORMS=cpu python -m pytest tests/test_sched.py -q -m 'not slow' \
+echo "=== [3/6] scheduler semantics + query-batched scan + bench-smoke (CPU fallback)"
+env JAX_PLATFORMS=cpu python -m pytest tests/test_sched.py \
+    tests/test_sched_batch.py tests/test_scan_pallas.py -q -m 'not slow' \
     -p no:cacheprovider || exit 1
 make bench-smoke || exit 1
 

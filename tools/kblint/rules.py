@@ -327,6 +327,82 @@ class MonotonicLeaseClock(Rule):
                         break
 
 
+#: the device scan-kernel entry points (ops.scan_pallas + the engine's jit
+#: wrappers). Launching one anywhere except the engine's assembly points
+#: forks the query-packing logic: a stray call site can silently disagree
+#: with `_dev_mask`/`_dev_mask_batch` on bound canonicalization, revision
+#: splitting, pow2 padding, or the kernel/mesh selection — exactly the
+#: drift the single-assembly-point discipline exists to prevent.
+_SCAN_DISPATCH_NAMES = {
+    "scan_mask_pallas", "scan_mask_pallas_q",
+    "visibility_mask_batch", "visibility_mask_batch_cached",
+    "visibility_mask_batch_cached_q",
+    "_vis_batch", "_vis_batch_q", "_vis_batch_pallas", "_vis_batch_pallas_q",
+}
+#: functions allowed to reference them: the two engine assembly points and
+#: the module-level jit wrappers those assembly points dispatch through
+_SCAN_DISPATCH_ALLOWED = {
+    "_dev_mask", "_dev_mask_batch",
+    "_vis_batch", "_vis_batch_q", "_vis_batch_pallas", "_vis_batch_pallas_q",
+}
+
+
+@register
+class ScanDispatchOnlyInAssemblyPoints(Rule):
+    """Device scan dispatch in the scheduler/TPU-engine layers may only
+    happen inside the `_dev_mask`/`_dev_mask_batch` assembly points (and
+    the engine's own jit wrappers they call) — stray
+    `scan_mask_pallas`/`visibility_mask_batch` call sites bypass the one
+    place query packing, Q padding, and kernel selection are kept
+    coherent."""
+
+    rule_id = "KB109"
+    summary = ("device scan kernels may only be dispatched from the "
+               "_dev_mask/_dev_mask_batch assembly points "
+               "(sched/, storage/tpu/)")
+
+    def applies(self, relpath: str) -> bool:
+        return relpath.replace("\\", "/").startswith(
+            ("kubebrain_tpu/sched/", "kubebrain_tpu/storage/tpu/")
+        )
+
+    def check(self, tree: ast.Module, src: str) -> Iterable[tuple[ast.AST, str]]:
+        def scan(body: list[ast.stmt], func_name: str | None):
+            allowed = func_name in _SCAN_DISPATCH_ALLOWED
+            for node in walk_same_scope(body):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    yield from scan(node.body, node.name)
+                    continue
+                if isinstance(node, ast.ClassDef):
+                    # methods are where the engine's dispatch code lives —
+                    # walk_same_scope stops at the class header, so descend
+                    # explicitly (class-level statements get no allowance)
+                    yield from scan(node.body, None)
+                    continue
+                if isinstance(node, ast.Lambda):
+                    # a lambda belongs to its enclosing def (the engine
+                    # wrappers close over the kernel via lambdas)
+                    yield from scan([ast.Expr(value=node.body)], func_name)
+                    continue
+                if allowed:
+                    continue
+                # both direct calls and bare references count — wrapping a
+                # kernel in vmap/partial outside an assembly point is the
+                # same bypass as calling it
+                name = None
+                if isinstance(node, (ast.Name, ast.Attribute)):
+                    name = terminal_name(node)
+                if name in _SCAN_DISPATCH_NAMES:
+                    where = f" (in {func_name!r})" if func_name else ""
+                    yield node, (
+                        f"device scan dispatch {name}{where}: kernels may "
+                        "only launch from the _dev_mask/_dev_mask_batch "
+                        "assembly points"
+                    )
+
+        yield from scan(tree.body, None)
+
+
 _REV_TOKENS = {"rev", "revision"}
 
 
@@ -346,6 +422,7 @@ def _revision_like(expr: ast.expr) -> str | None:
 #: shedding, so one unthrottled caller can starve the device pipeline.
 _SCAN_ENTRY_POINTS = {
     "list_", "list_wire", "list_by_stream", "count", "range_", "range_stream",
+    "list_batch", "scan_batch",
 }
 _SCAN_RECEIVERS = {"backend", "scanner"}
 
