@@ -3,8 +3,8 @@
 
 SHELL := /bin/bash  # test-tier1 needs pipefail
 
-.PHONY: all native test bench bench-all bench-smoke run clean protos lint \
-        typecheck check test-tier1
+.PHONY: all native test bench bench-all bench-smoke bench-cluster run clean \
+        protos lint typecheck check test-tier1
 
 all: native
 
@@ -58,6 +58,16 @@ bench-all: native
 bench-smoke:
 	JAX_PLATFORMS=cpu KB_BENCH_METRIC=sched KB_BENCH_KEYS=2000 \
 	    KB_BENCH_OPS=200 python bench.py
+
+# Cluster-scale workload replay (kubebrain_tpu/workload): deterministic
+# kube-apiserver traffic for an N-node simulated cluster through the real
+# gRPC front — pod churn + controller list/watch + node lease keepalives +
+# compaction in one run. Emits WORKLOAD_rNN.json (docs/workloads.md).
+# Same seed => byte-identical op trace (self-checked every run).
+N ?= 1000
+bench-cluster:
+	JAX_PLATFORMS=cpu KB_BENCH_METRIC=cluster KB_BENCH_NODES=$(N) \
+	    python bench.py
 
 run: native
 	python -m kubebrain_tpu.cli --single-node --storage=tpu --inner-storage=native

@@ -29,6 +29,28 @@ WIDTH = 64  # bytes per packed key; registry bench keys are ~36B
 CHUNKS = WIDTH // 4
 
 
+def platform_info() -> dict:
+    """Platform/device stamp carried by EVERY emitted bench JSON: acceptance
+    bars differ by device class (the PR 5 batched bar is TPU-only), so each
+    record must say where it ran instead of leaving that to stderr logs.
+    Never *initializes* a jax backend just for the stamp — jax.devices() on
+    a merely-imported jax would pay seconds of XLA startup on host-only
+    benches, and in this container could attach the wedge-prone axon tunnel
+    the bench only ever probes from a throwaway subprocess."""
+    jax = sys.modules.get("jax")
+    if jax is not None:
+        try:
+            backends = getattr(
+                getattr(jax, "_src", None), "xla_bridge", None)
+            if backends is not None and getattr(backends, "_backends", None):
+                dev = jax.devices()[0]  # backend already live: this is cheap
+                return {"platform": dev.platform, "device": str(dev)}
+        except Exception:
+            pass
+    return {"platform": os.environ.get("JAX_PLATFORMS") or "host",
+            "device": "host(jax backend not initialized)"}
+
+
 def _probe_tpu_alive(timeout: float = 90.0) -> bool:
     """The axon tunnel serializes one client and can wedge; probe it in a
     throwaway subprocess so a dead tunnel can't hang the bench."""
@@ -167,6 +189,7 @@ def bench_fanout() -> None:
         "value": round(rate),
         "unit": "event*watcher/sec",
         "vs_baseline": round(rate / py_rate, 3),
+        "platform": platform_info(),
         "detail": {
             "watchers": n_watchers, "events": n_events,
             "mask_p50_ms": round(p50 * 1e3, 2),
@@ -336,6 +359,7 @@ def bench_compact() -> None:
         "value": round(rate),
         "unit": "rows/sec",
         "vs_baseline": round(rate / cpu_rate, 3),
+        "platform": platform_info(),
         "detail": {
             "rows": n, "kept": kept,
             "compact_p50_ms": round(p50 * 1e3, 2),
@@ -412,6 +436,7 @@ def bench_insert() -> None:
         "value": round(rate),
         "unit": "ops/sec",
         "vs_baseline": round(rate / 28_644, 3),  # reference KubeBrain/TiKV insert
+        "platform": platform_info(),
         "detail": {
             "ops": per * n_threads, "threads": n_threads,
             "value_bytes": 512, "engine": "native(C++)",
@@ -461,6 +486,7 @@ def bench_delete() -> None:
         "value": round(rate),
         "unit": "ops/sec",
         "vs_baseline": round(rate / 5_028, 3),  # reference's published delete
+        "platform": platform_info(),
         "detail": {"ops": per * n_threads, "threads": n_threads,
                    "engine": "native(C++)", "reference": "4.8-5.0k (KubeBrain), 10.8-11.2k (etcd)"},
     }))
@@ -535,6 +561,7 @@ def bench_grpc_list() -> None:
         "value": round(rate),
         "unit": "keys/sec",
         "vs_baseline": round(py_p50 / front_p50, 3),
+        "platform": platform_info(),
         "detail": {"keys": n_keys, "list_p50_ms": round(p50 * 1e3, 2),
                    "py_endpoint_p50_ms": round(py_p50 * 1e3, 2),
                    "value_bytes": 512, "paged": 1000,
@@ -663,6 +690,7 @@ def bench_grpc_insert() -> None:
         "value": round(rate),
         "unit": "ops/sec",
         "vs_baseline": round(rate / 28_644, 3),
+        "platform": platform_info(),
         "detail": detail,
     }))
 
@@ -738,6 +766,7 @@ def bench_rebuild() -> None:
             "value": int(rate),
             "unit": "rows/sec",
             "vs_baseline": round(slow / fast, 3),
+            "platform": platform_info(),
             "detail": {
                 "rows": rows,
                 "bulk_export_ms": round(fast * 1e3, 1),
@@ -829,6 +858,7 @@ def bench_sim() -> None:
         "value": round(rate),
         "unit": "ops/sec",
         "vs_baseline": round(rate / 14_801, 3),  # reference mixed-RW insert low bound
+        "platform": platform_info(),
         "detail": {
             "watchers": n_watchers, "ops": per * n_threads,
             "events_delivered": delivered[0],
@@ -904,6 +934,7 @@ def _bench_sim_wire() -> None:
         "value": round(res["rate"]),
         "unit": "ops/sec",
         "vs_baseline": round(res["rate"] / 14_801, 3),
+        "platform": platform_info(),
         "detail": {
             "watchers": n_watchers, "namespaces": n_ns, "ops": res["ops"],
             "events_delivered": res["deliveries"],
@@ -1036,6 +1067,7 @@ def bench_sched() -> None:
         "value": round(n_req / sched_dt),
         "unit": "requests/sec",
         "vs_baseline": round(seq_dt / sched_dt, 3),
+        "platform": platform_info(),
         "detail": {
             "requests": n_req, "keys": n_keys, "depth": depth,
             "byte_identical": True,
@@ -1049,6 +1081,56 @@ def bench_sched() -> None:
     }))
     backend.close()
     store.close()
+
+
+def bench_cluster() -> None:
+    """Cluster-scale workload replay (make bench-cluster N=...): the
+    deterministic kube-apiserver traffic generator driven through the real
+    gRPC front — pod churn + per-controller list/watch + node lease
+    keepalives + compaction in ONE run — reporting per-lane p50/p99, shed
+    rates, watch queue->wire lag, and lease counts reconciled against
+    /metrics. Full report: WORKLOAD_rNN.json (docs/workloads.md).
+
+    Env knobs: KB_BENCH_NODES (or N), KB_WORKLOAD_SEED, KB_WORKLOAD_DURATION
+    (simulated seconds), KB_WORKLOAD_SCALE (sim seconds per real second),
+    KB_WORKLOAD_STORAGE, KB_WORKLOAD_OUT (report path)."""
+    from kubebrain_tpu.workload.runner import run_workload
+    from kubebrain_tpu.workload.spec import WorkloadSpec
+
+    nodes = int(os.environ.get("KB_BENCH_NODES", os.environ.get("N", 1000)))
+    spec = WorkloadSpec.for_cluster(
+        nodes,
+        seed=int(os.environ.get("KB_WORKLOAD_SEED", 0)),
+        duration_s=float(os.environ.get("KB_WORKLOAD_DURATION", 30.0)),
+        time_scale=float(os.environ.get("KB_WORKLOAD_SCALE", 5.0)),
+        storage=os.environ.get("KB_WORKLOAD_STORAGE", "memkv"),
+    )
+    report = run_workload(spec, out_path=os.environ.get("KB_WORKLOAD_OUT") or None)
+    lanes = {lane: {"p50_ms": s["p50_ms"], "p99_ms": s["p99_ms"],
+                    "count": s["count"], "shed": s["shed"]}
+             for lane, s in report["lanes"].items()}
+    print(json.dumps({
+        "metric": "cluster-replay ops/sec",
+        "value": report["replay"]["ops_per_sec"],
+        "unit": "ops/sec",
+        "vs_baseline": 1.0 if report["slo"]["pass"] else 0.0,
+        "platform": platform_info(),
+        "detail": {
+            "nodes": spec.nodes,
+            "seed": spec.seed,
+            "trace_sha256": report["trace"]["sha256"],
+            "slo_pass": report["slo"]["pass"],
+            "violations": report["slo"]["violations"],
+            "lanes": lanes,
+            "watchers": report["watch"]["watchers"],
+            "watch_events": report["watch"]["events"],
+            "watch_wire_lag_p99_s": report["watch"]["lag_wire_p99_s"],
+            "keepalives_acked": report["leases"]["keepalives_acked"],
+            "lease_expiries": report["leases"]["metrics"]["expired_delta"],
+            "batched_requests": report["sched"]["batched_requests"],
+            "reconcile_ok": report["reconcile"]["ok"],
+        },
+    }))
 
 
 def bench_watcurve() -> None:
@@ -1137,6 +1219,7 @@ def bench_watcurve() -> None:
         "value": curve[best_wat],
         "unit": "queries/sec",
         "vs_baseline": round(curve[best_wat] / base, 3),
+        "platform": platform_info(),
         "detail": {
             "curve_qps": {str(k): v for k, v in curve.items()},
             "queries": n_q, "rows": n, "devices": n_dev,
@@ -1201,6 +1284,8 @@ def main() -> None:
         return bench_rebuild()
     if metric == "sched":
         return bench_sched()
+    if metric == "cluster":
+        return bench_cluster()
     if metric == "watcurve":
         return bench_watcurve()
 
@@ -1273,6 +1358,7 @@ def main() -> None:
             "value": round(rate),
             "unit": "rows/sec",
             "vs_baseline": round(rate / cpu_rate, 3),
+            "platform": platform_info(),
             "detail": {"rows": usable, "devices": n_dev,
                        "scan_p50_ms": round(p50 * 1e3, 2),
                        "cpu_numpy_rows_per_sec": round(cpu_rate)},
@@ -1587,6 +1673,7 @@ def main() -> None:
         "value": round(rate),
         "unit": "rows/sec",
         "vs_baseline": round(rate / cpu_rate, 3),
+        "platform": platform_info(),
         "detail": {
             "rows": n, "visible": tpu_visible,
             "scan_p50_ms": round(p50 * 1e3, 2),
@@ -1600,6 +1687,11 @@ def main() -> None:
             "batched_queries_per_launch": NQ,
             "batched_vs_scheduled": round(batched / scheduled_q, 3),
             "batched_byte_identical": True,
+            # the PR 5 acceptance bar (>= 1.5x scheduled at 8 distinct
+            # prefixes) is a TPU bar: on CPU dispatch isn't the bottleneck,
+            # so the run only proves byte-identity + cost parity and the
+            # bar stays machine-visibly pending until a real-TPU round
+            "batched_acceptance_1_5x": "pass" if on_tpu else "pending_tpu",
             "cpu_numpy_rows_per_sec": round(cpu_rate),
             "device": str(dev),
             "kernel": "pallas" if use_pallas else "jnp",

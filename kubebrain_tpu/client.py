@@ -16,10 +16,12 @@ No generated stubs: raw grpc channels + the protos in kubebrain_tpu.proto.
 
 from __future__ import annotations
 
+import collections
 import queue
 import threading
+import time
 from dataclasses import dataclass
-from typing import Iterator
+from typing import Callable, Iterable, Iterator
 
 import grpc
 
@@ -29,18 +31,34 @@ from .trace import make_traceparent
 PARTITION_MAGIC_REVISION = 1888
 
 
-def _traced_call(callable_):
-    """Wrap a grpc multicallable so every invocation carries a W3C
+class _TracedCall:
+    """Wraps a grpc multicallable so every invocation carries a W3C
     ``traceparent`` metadata entry — the server parents its span tree under
     it, so a client-observed slow call is findable in ``/debug/traces`` by
     trace id. Continues the ambient span's trace when the caller is itself
-    inside one."""
+    inside one. ``future`` (unary multicallables only) is the pipelined
+    path bulk helpers use to keep a window of RPCs in flight on one
+    channel."""
 
-    def call(request, timeout=None, metadata=None):
-        md = tuple(metadata or ()) + (("traceparent", make_traceparent()),)
-        return callable_(request, timeout=timeout, metadata=md)
+    __slots__ = ("_call",)
 
-    return call
+    def __init__(self, callable_):
+        self._call = callable_
+
+    @staticmethod
+    def _md(metadata):
+        return tuple(metadata or ()) + (("traceparent", make_traceparent()),)
+
+    def __call__(self, request, timeout=None, metadata=None):
+        return self._call(request, timeout=timeout, metadata=self._md(metadata))
+
+    def future(self, request, timeout=None, metadata=None):
+        return self._call.future(
+            request, timeout=timeout, metadata=self._md(metadata))
+
+
+def _traced_call(callable_):
+    return _TracedCall(callable_)
 
 
 @dataclass
@@ -90,10 +108,8 @@ class EtcdCompatClient:
         ))
 
     # --------------------------------------------------------------- writes
-    def create(self, key: bytes, value: bytes, lease: int = 0) -> tuple[bool, int]:
-        """(succeeded, revision) — revision is the new mod revision on
-        success, the existing one on conflict. ``lease`` attaches the key
-        to a granted lease (see :meth:`lease`)."""
+    @staticmethod
+    def _create_txn(key: bytes, value: bytes, lease: int = 0) -> rpc_pb2.TxnRequest:
         req = rpc_pb2.TxnRequest()
         c = req.compare.add()
         c.result, c.target, c.key, c.mod_revision = (
@@ -102,11 +118,36 @@ class EtcdCompatClient:
         req.success.add().request_put.CopyFrom(
             rpc_pb2.PutRequest(key=key, value=value, lease=lease))
         req.failure.add().request_range.CopyFrom(rpc_pb2.RangeRequest(key=key))
-        r = self._txn(req)
+        return req
+
+    @staticmethod
+    def _parse_put_txn(r) -> tuple[bool, int]:
         if r.succeeded:
             return True, r.responses[0].response_put.header.revision
         kvs = r.responses[0].response_range.kvs
         return False, kvs[0].mod_revision if kvs else 0
+
+    def create(self, key: bytes, value: bytes, lease: int = 0) -> tuple[bool, int]:
+        """(succeeded, revision) — revision is the new mod revision on
+        success, the existing one on conflict. ``lease`` attaches the key
+        to a granted lease (see :meth:`lease`)."""
+        return self._parse_put_txn(self._txn(self._create_txn(key, value, lease)))
+
+    def create_bulk(self, items: Iterable[tuple[bytes, bytes]], lease: int = 0,
+                    window: int = 128) -> list[tuple[bool, int]]:
+        """Pipelined creates: up to ``window`` Txn futures in flight on one
+        channel, results in input order. This is the preload path of the
+        workload replay harness — a sequential create() loop is bounded by
+        one RTT per key, the future window by the server's commit rate."""
+        out: list[tuple[bool, int]] = []
+        pending: collections.deque = collections.deque()
+        for key, value in items:
+            if len(pending) >= window:
+                out.append(self._parse_put_txn(pending.popleft().result()))
+            pending.append(self._txn.future(self._create_txn(key, value, lease)))
+        while pending:
+            out.append(self._parse_put_txn(pending.popleft().result()))
+        return out
 
     def update(self, key: bytes, value: bytes, mod_revision: int,
                lease: int = 0) -> tuple[bool, int]:
@@ -151,14 +192,22 @@ class EtcdCompatClient:
         return ClientKV(kv.key, kv.value, kv.mod_revision)
 
     def list(
-        self, start: bytes, end: bytes, revision: int = 0, limit: int = 0, page: int = 1000
+        self, start: bytes, end: bytes, revision: int = 0, limit: int = 0,
+        page: int = 1000, stats: dict | None = None,
     ) -> tuple[list[ClientKV], int]:
-        """Paginated list; returns (kvs, list_revision)."""
+        """Paginated list; returns (kvs, list_revision). ``stats`` (if
+        given) has its ``"rpcs"`` entry incremented per Range RPC *issued*
+        (before the call, so shed/errored pages are still counted) — the
+        workload harness reconciles client-side RPC counts against the
+        server's /metrics, which counts failed RPCs too, and pagination
+        makes ops != RPCs."""
         out: list[ClientKV] = []
         key = start
         list_rev = revision
         while True:
             want = min(page, limit - len(out)) if limit else page
+            if stats is not None:
+                stats["rpcs"] = stats.get("rpcs", 0) + 1
             r = self._range(rpc_pb2.RangeRequest(
                 key=key, range_end=end, revision=list_rev, limit=want
             ))
@@ -168,6 +217,18 @@ class EtcdCompatClient:
             if not r.more or (limit and len(out) >= limit):
                 return out, list_rev
             key = r.kvs[-1].key + b"\x00"
+
+    def list_unpaged(
+        self, start: bytes, end: bytes, revision: int = 0
+    ) -> tuple[list[ClientKV], int]:
+        """One unpaged Range (limit=0) — the informer-relist/snapshot shape
+        the scheduler classifies BACKGROUND. ``list()`` always pages and so
+        always rides the NORMAL lane; replaying realistic relist storms
+        needs the heavyweight shape on the wire."""
+        r = self._range(rpc_pb2.RangeRequest(
+            key=start, range_end=end, revision=revision))
+        return ([ClientKV(kv.key, kv.value, kv.mod_revision) for kv in r.kvs],
+                r.header.revision)
 
     def count(self, start: bytes, end: bytes) -> int:
         r = self._range(rpc_pb2.RangeRequest(key=start, range_end=end, count_only=True))
@@ -454,6 +515,260 @@ class LeaseHandle:
         self._requests.put(None)
         if self._thread.is_alive():
             self._thread.join(timeout=5.0)
+
+
+class MuxWatch:
+    """One multiplexed watch (see :class:`WatchMux`): the server-assigned
+    watch id plus reader-thread-maintained delivery counters."""
+
+    __slots__ = ("key", "range_end", "watch_id", "events", "cancelled",
+                 "last_revision", "ready")
+
+    def __init__(self, key: bytes, range_end: bytes):
+        self.key = key
+        self.range_end = range_end
+        self.watch_id = -1
+        self.events = 0
+        self.cancelled = False
+        self.last_revision = 0
+        self.ready = threading.Event()
+
+
+class _WatchMuxStream:
+    """One Watch stream carrying many watches. The server's read loop
+    handles create requests strictly in order, so created acks match the
+    pending-add FIFO; event batches demux by ``watch_id``."""
+
+    def __init__(self, client: "EtcdCompatClient"):
+        self._requests: queue.Queue = queue.Queue()
+        self._responses = client._watch(iter(self._requests.get, None))
+        self._lock = threading.Lock()
+        self._pending: collections.deque[MuxWatch] = collections.deque()
+        self._by_id: dict[int, MuxWatch] = {}
+        self.dead = False
+        self._reader = threading.Thread(
+            target=self._read_loop, name="kb-watchmux", daemon=True)
+        self._reader.start()
+
+    def add(self, key: bytes, range_end: bytes, start_revision: int,
+            timeout: float) -> MuxWatch:
+        w = MuxWatch(key, range_end)
+        req = rpc_pb2.WatchRequest()
+        req.create_request.key = key
+        req.create_request.range_end = range_end
+        req.create_request.start_revision = start_revision
+        with self._lock:
+            if self.dead:
+                raise TimeoutError("watch mux stream is dead")
+            # append + send under one lock: concurrent add() calls must hit
+            # the wire in pending-FIFO order or created acks mismatch
+            self._pending.append(w)
+            self._requests.put(req)
+        if not w.ready.wait(timeout):
+            raise TimeoutError(
+                f"watch registration not acked within {timeout}s "
+                f"(key={key!r})")
+        return w
+
+    def _read_loop(self) -> None:
+        rpc_error = grpc.RpcError  # closure-bound, survives teardown
+        try:
+            for resp in self._responses:
+                if resp.created:
+                    with self._lock:
+                        w = self._pending.popleft() if self._pending else None
+                    if w is not None:
+                        w.watch_id = resp.watch_id
+                        with self._lock:
+                            self._by_id[resp.watch_id] = w
+                        if resp.canceled:  # e.g. compacted start revision
+                            w.cancelled = True
+                        w.ready.set()
+                if resp.events:
+                    with self._lock:
+                        w = self._by_id.get(resp.watch_id)
+                    if w is not None:
+                        w.events += len(resp.events)
+                        w.last_revision = resp.header.revision
+                if resp.canceled and not resp.created:
+                    with self._lock:
+                        w = self._by_id.get(resp.watch_id)
+                    if w is not None:
+                        w.cancelled = True
+        except (rpc_error, ValueError):
+            pass  # stream torn down (close() or channel death)
+        finally:
+            with self._lock:
+                self.dead = True
+                pending = list(self._pending)
+                self._pending.clear()
+            for w in pending:
+                w.cancelled = True
+                w.ready.set()
+
+    def watchers(self) -> list[MuxWatch]:
+        with self._lock:
+            return list(self._by_id.values())
+
+    def close(self) -> None:
+        self._requests.put(None)
+
+
+class WatchMux:
+    """Many long-lived watches multiplexed over a few Watch streams.
+
+    A :meth:`EtcdCompatClient.watch` session costs one client thread AND
+    one server worker thread per watch — at informer scale (one watcher
+    per controller) that is thousands of threads on each side. The mux
+    rides the etcd protocol's native multiplexing instead: each stream
+    carries any number of watches, so N watchers cost ``streams`` threads
+    total. Deliveries are *counted* per watch (the workload harness's
+    need), not queued — wire-lag attribution lives in the server's
+    ``kb_watch_lag_seconds`` metric."""
+
+    def __init__(self, client: "EtcdCompatClient", streams: int = 4):
+        if streams < 1:
+            raise ValueError("streams must be >= 1")
+        self._streams = [_WatchMuxStream(client) for _ in range(streams)]
+        self._rr = 0
+
+    def add(self, key: bytes, range_end: bytes = b"", start_revision: int = 0,
+            shard: int | None = None, timeout: float = 30.0) -> MuxWatch:
+        if shard is None:
+            shard, self._rr = self._rr, self._rr + 1
+        s = self._streams[shard % len(self._streams)]
+        return s.add(key, range_end, start_revision, timeout)
+
+    def watchers(self) -> list[MuxWatch]:
+        return [w for s in self._streams for w in s.watchers()]
+
+    def total_events(self) -> int:
+        return sum(w.events for w in self.watchers())
+
+    def cancelled_count(self) -> int:
+        return sum(1 for w in self.watchers() if w.cancelled)
+
+    def close(self) -> None:
+        for s in self._streams:
+            s.close()
+
+
+class _KeepaliveMuxStream:
+    """One LeaseKeepAlive stream multiplexing pings for many lease ids.
+    The server answers requests in order, so ack matching is the send
+    FIFO; each ack invokes the caller's callback with (latency_s, ttl)."""
+
+    def __init__(self, client: "EtcdCompatClient"):
+        self._requests: queue.Queue = queue.Queue()
+        self._responses = client._lease_keepalive(iter(self._requests.get, None))
+        self._lock = threading.Lock()
+        self._pending: collections.deque = collections.deque()
+        self._idle = threading.Condition(self._lock)
+        self.sent = 0
+        self.acked = 0
+        self.expired_acks = 0
+        self.dead = False
+        self._reader = threading.Thread(
+            target=self._read_loop, name="kb-leasemux", daemon=True)
+        self._reader.start()
+
+    def send(self, lease_id: int,
+             on_ack: Callable[[float, int], None] | None = None) -> bool:
+        with self._lock:
+            if self.dead:
+                return False
+            # append + send under one lock (ack matching is the send FIFO)
+            self._pending.append((time.monotonic(), on_ack))
+            self.sent += 1
+            self._requests.put(rpc_pb2.LeaseKeepAliveRequest(ID=lease_id))
+        return True
+
+    def _read_loop(self) -> None:
+        rpc_error = grpc.RpcError
+        try:
+            for resp in self._responses:
+                with self._lock:
+                    t0, on_ack = (self._pending.popleft()
+                                  if self._pending else (None, None))
+                    self.acked += 1
+                    if resp.TTL <= 0:
+                        self.expired_acks += 1
+                    self._idle.notify_all()
+                if on_ack is not None and t0 is not None:
+                    on_ack(time.monotonic() - t0, resp.TTL)
+        except (rpc_error, ValueError):
+            pass
+        finally:
+            with self._lock:
+                self.dead = True
+                self._idle.notify_all()
+
+    def flush(self, timeout: float) -> bool:
+        deadline = time.monotonic() + timeout
+        with self._lock:
+            while self.acked < self.sent and not self.dead:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._idle.wait(remaining)
+            return self.acked >= self.sent
+
+    def close(self) -> None:
+        self._requests.put(None)
+
+
+class LeaseMux:
+    """Node-scale lease fan-out: pipelined grants plus keepalives for many
+    lease ids multiplexed over a few LeaseKeepAlive streams (one
+    :class:`LeaseHandle` per lease would cost a thread and a stream per
+    node). Keepalives are fire-and-forget from the caller's perspective;
+    acks are counted (and optionally called back) on the reader threads,
+    and :meth:`flush` fences them all."""
+
+    def __init__(self, client: "EtcdCompatClient", streams: int = 4):
+        if streams < 1:
+            raise ValueError("streams must be >= 1")
+        self._client = client
+        self._streams = [_KeepaliveMuxStream(client) for _ in range(streams)]
+
+    def grant_bulk(self, n: int, ttl: int, window: int = 64) -> list[int]:
+        """Grant ``n`` leases with pipelined LeaseGrant futures; returns
+        the server-assigned ids in order."""
+        ids: list[int] = []
+        pending: collections.deque = collections.deque()
+        for _ in range(n):
+            if len(pending) >= window:
+                ids.append(pending.popleft().result().ID)
+            pending.append(self._client._lease_grant.future(
+                rpc_pb2.LeaseGrantRequest(TTL=ttl)))
+        while pending:
+            ids.append(pending.popleft().result().ID)
+        return ids
+
+    def keepalive_async(self, lease_id: int, shard: int = 0,
+                        on_ack: Callable[[float, int], None] | None = None) -> bool:
+        return self._streams[shard % len(self._streams)].send(lease_id, on_ack)
+
+    @property
+    def sent(self) -> int:
+        return sum(s.sent for s in self._streams)
+
+    @property
+    def acked(self) -> int:
+        return sum(s.acked for s in self._streams)
+
+    @property
+    def expired_acks(self) -> int:
+        return sum(s.expired_acks for s in self._streams)
+
+    def flush(self, timeout: float = 30.0) -> bool:
+        deadline = time.monotonic() + timeout
+        return all(s.flush(max(0.001, deadline - time.monotonic()))
+                   for s in self._streams)
+
+    def close(self) -> None:
+        for s in self._streams:
+            s.close()
 
 
 class BrainClient:

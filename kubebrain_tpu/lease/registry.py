@@ -138,7 +138,13 @@ class LeaseRegistry:
                 return 0
             lease.deadline = clock.deadline_for(lease.granted_ttl)
             self._dirty = True
-            return max(1, int(lease.granted_ttl))
+            ttl = max(1, int(lease.granted_ttl))
+        # successful refreshes are counted so an external traffic source
+        # (the workload replay harness) can reconcile its keepalive acks
+        # against the server's own view
+        if self._metrics is not None:
+            self._metrics.emit_counter("kb.lease.keepalive.total", 1)
+        return ttl
 
     # ------------------------------------------------------------ attachment
     def require(self, lease_id: int) -> None:

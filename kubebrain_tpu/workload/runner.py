@@ -1,0 +1,697 @@
+"""Replay engine: executes a generated schedule against a real kubebrain
+server **through the gRPC front** and emits the SLO report.
+
+Execution model (bounded open-loop):
+
+- a single dispatcher thread walks the replay schedule on the
+  :class:`~kubebrain_tpu.workload.clock.ReplayPacer` and routes each op to
+  a shard — pod writes hash by key (per-key ordering, so CAS revisions
+  thread through without coordination), controller reads hash by watcher,
+  compaction runs on a dedicated admin shard, keepalives go straight to
+  the multiplexed lease streams;
+- every shard is one worker thread + one gRPC channel + a bounded queue:
+  the schedule never waits for completions (open-loop), but a full shard
+  queue blocks the dispatcher (bounded) — the recorded dispatch lag is
+  then part of the result, exactly like a congested real client fleet;
+- watches ride :class:`~kubebrain_tpu.client.WatchMux` (N watchers over a
+  few streams), keepalives ride :class:`~kubebrain_tpu.client.LeaseMux`.
+
+The report reconciles client-side RPC counts against the server's own
+/metrics exposition (rpc_server_count deltas, kb_lease_* counters,
+kb_watch_backlog series) — a replay whose numbers don't add up is a
+harness bug, not a benchmark.
+
+CLI: ``python -m kubebrain_tpu.workload.runner --nodes 5000`` (or
+``make bench-cluster N=5000``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import queue
+import socket
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+import zlib
+from collections import Counter
+from dataclasses import asdict
+
+import grpc
+
+from .. import coder
+from ..client import EtcdCompatClient, LeaseMux, WatchMux
+from . import generator, slo
+from .clock import ReplayPacer
+from .generator import (
+    COMPACT, CTRL_LIST, CTRL_RELIST, CTRL_START, LEASE_GRANT,
+    LEASE_KEEPALIVE, LEASE_LIST, LEASE_PREFIX, POD_CREATE, POD_DELETE,
+    POD_UPDATE, PODS_PREFIX, PRELOAD_CREATE, ns_name,
+)
+from .spec import WorkloadSpec
+
+REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+#: op kind -> report lane. Writes aren't scheduler lanes (the write path
+#: bypasses the read scheduler) but they are a latency population the
+#: report must keep separate; compaction is an administrative write.
+#: PRELOAD_CREATE is deliberately absent: preload is an untimed pipelined
+#: burst, and its samples would dilute the replay's lane percentiles and
+#: shed/error denominators (it still appears under op_kinds).
+LANE_OF = {
+    POD_CREATE: "write",
+    POD_UPDATE: "write",
+    POD_DELETE: "write",
+    COMPACT: "write",
+    LEASE_GRANT: "system",
+    LEASE_KEEPALIVE: "system",
+    LEASE_LIST: "system",
+    CTRL_START: "normal",
+    CTRL_LIST: "normal",
+    CTRL_RELIST: "background",
+}
+
+_TXN = "/etcdserverpb.KV/Txn"
+_RANGE = "/etcdserverpb.KV/Range"
+_COMPACT = "/etcdserverpb.KV/Compact"
+_LEASE_GRANT_RPC = "/etcdserverpb.Lease/LeaseGrant"
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class _Stats:
+    """Thread-safe per-kind latency samples + outcome counters."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.samples: dict[str, list[float]] = {}
+        self.outcomes: Counter = Counter()
+        self.error_samples: list[str] = []
+
+    def record(self, kind: str, dt: float, outcome: str = "ok",
+               err: str | None = None, sample: bool = True) -> None:
+        with self._lock:
+            self.outcomes[(kind, outcome)] += 1
+            if outcome == "ok" and sample:
+                self.samples.setdefault(kind, []).append(dt)
+            if err is not None and len(self.error_samples) < 20:
+                self.error_samples.append(f"{kind}: {err}")
+
+    def count(self, kind: str, outcome: str | None = None) -> int:
+        with self._lock:
+            if outcome is not None:
+                return self.outcomes[(kind, outcome)]
+            return sum(n for (k, _o), n in self.outcomes.items() if k == kind)
+
+
+class _Shard(threading.Thread):
+    """One worker thread + one channel + a bounded op queue."""
+
+    def __init__(self, name: str, target: str, qsize: int, stats: _Stats):
+        super().__init__(name=name, daemon=True)
+        self.client = EtcdCompatClient(target)
+        self.q: queue.Queue = queue.Queue(maxsize=qsize)
+        self._stats = stats
+        self.start()
+
+    def submit(self, fn) -> None:
+        self.q.put(fn)  # blocks when full: the bounded part of open-loop
+
+    def run(self) -> None:
+        while True:
+            fn = self.q.get()
+            try:
+                if fn is None:
+                    return
+                fn(self.client)
+            except Exception as e:  # a broken op must not kill the shard
+                self._stats.record("SHARD", 0.0, "error", err=repr(e))
+            finally:
+                self.q.task_done()
+
+    def close(self) -> None:
+        self.q.put(None)
+        self.join(timeout=10.0)
+        self.client.close()
+
+
+class WorkloadRunner:
+    def __init__(self, spec: WorkloadSpec, target: str | None = None,
+                 info_port: int = 0, out_path: str | None = None,
+                 write_report: bool = True, server_log: str | None = None):
+        if target and not info_port:
+            raise ValueError(
+                "--target needs the server's info port too (the /metrics "
+                "listener the report reconciles against); pass info_port/"
+                "--target-info-port")
+        self.spec = spec
+        self._target = target
+        self._out_path = out_path
+        self._write = write_report
+        self._server_log = server_log or os.environ.get("KB_WORKLOAD_SERVER_LOG")
+        self.stats = _Stats()
+        self._rpc_lock = threading.Lock()
+        self._rpc: Counter = Counter()
+        self._revs_lock = threading.Lock()
+        self._revs: dict[bytes, int] = {}
+        self._max_rev = 0
+        self._last_compact = 0
+        self._lease_lock = threading.Lock()
+        self._lease_ids: dict[int, int] = {}
+        self._server: subprocess.Popen | None = None
+        self._info_port = info_port
+        # /metrics lives on the target's host, not necessarily localhost
+        self._info_host = (target.rsplit(":", 1)[0] if target
+                           else "127.0.0.1")
+
+    # ------------------------------------------------------------- plumbing
+    def _count_rpc(self, what: str, n: int = 1) -> None:
+        with self._rpc_lock:
+            self._rpc[what] += n
+
+    def _note_rev(self, key: bytes, rev: int, ok: bool) -> None:
+        with self._revs_lock:
+            if rev > self._max_rev:
+                self._max_rev = rev
+            if ok:
+                self._revs[key] = rev
+
+    def _execute(self, kind: str, fn, client) -> None:
+        t0 = time.monotonic()
+        try:
+            outcome = fn(client) or "ok"
+        except grpc.RpcError as e:
+            code = e.code() if hasattr(e, "code") else None
+            if code == grpc.StatusCode.RESOURCE_EXHAUSTED:
+                self.stats.record(kind, 0.0, "shed")
+            else:
+                self.stats.record(kind, 0.0, "error", err=f"{code}: {e}")
+            return
+        except Exception as e:
+            # e.g. a WatchMux registration timeout in CTRL_START: it must
+            # land under the op's own kind/lane so the error-rate bound can
+            # see it, not vanish into a synthetic bucket
+            self.stats.record(kind, 0.0, "error", err=repr(e))
+            return
+        self.stats.record(kind, time.monotonic() - t0, outcome)
+
+    def _scrape(self) -> slo.PromSnapshot:
+        with urllib.request.urlopen(
+            f"http://{self._info_host}:{self._info_port}/metrics", timeout=15
+        ) as resp:
+            return slo.parse_prom(resp.read().decode())
+
+    # ------------------------------------------------------------ op bodies
+    def _ns_bounds(self, ns: int) -> tuple[bytes, bytes]:
+        prefix = PODS_PREFIX + ns_name(ns) + b"/"
+        return prefix, coder.prefix_end(prefix)
+
+    def _do_pod_create(self, op):
+        def fn(client):
+            self._count_rpc("txn")
+            ok, rev = client.create(op.key, b"v" * op.size)
+            self._note_rev(op.key, rev, ok)
+            return None if ok else "conflict"
+        return fn
+
+    def _do_pod_update(self, op):
+        def fn(client):
+            with self._revs_lock:
+                rev = self._revs.get(op.key)
+            if rev is None:
+                return "skip"  # its create failed/shed earlier
+            self._count_rpc("txn")
+            ok, newrev = client.update(op.key, b"u" * op.size, rev)
+            self._note_rev(op.key, newrev, ok)
+            return None if ok else "conflict"
+        return fn
+
+    def _do_pod_delete(self, op):
+        def fn(client):
+            with self._revs_lock:
+                rev = self._revs.get(op.key)
+            if rev is None:
+                return "skip"
+            self._count_rpc("txn")
+            ok = client.delete(op.key, rev)
+            if ok:
+                with self._revs_lock:
+                    self._revs.pop(op.key, None)
+            return None if ok else "conflict"
+        return fn
+
+    def _do_lease_grant(self, op):
+        def fn(client):
+            self._count_rpc("lease_grant")
+            lid, _granted = client.lease_grant(self.spec.lease_ttl_s)
+            self._count_rpc("txn")
+            ok, rev = client.create(op.key, b"node-lease", lease=lid)
+            self._note_rev(op.key, rev, ok)
+            with self._lease_lock:
+                self._lease_ids[op.node] = lid
+            return None if ok else "conflict"
+        return fn
+
+    def _do_ctrl_start(self, op):
+        def fn(client):
+            start, end = self._ns_bounds(op.ns)
+            st: dict = {}
+            try:
+                _kvs, rev = client.list(start, end, page=self.spec.list_limit,
+                                        stats=st)
+            finally:
+                # the server's rpc_server_count includes shed/errored RPCs,
+                # so the client must count attempts, not successes
+                self._count_rpc("range", st.get("rpcs", 0))
+            w = self._watchmux.add(start, end, start_revision=rev + 1,
+                                   shard=op.watcher, timeout=60.0)
+            return "error" if w.cancelled else None
+        return fn
+
+    def _do_ctrl_list(self, op):
+        def fn(client):
+            start, end = self._ns_bounds(op.ns)
+            st: dict = {}
+            try:
+                client.list(start, end, limit=self.spec.list_limit,
+                            page=self.spec.list_limit, stats=st)
+            finally:
+                self._count_rpc("range", st.get("rpcs", 0))
+        return fn
+
+    def _do_ctrl_relist(self, op):
+        def fn(client):
+            start, end = self._ns_bounds(op.ns)
+            self._count_rpc("range")
+            client.list_unpaged(start, end)
+        return fn
+
+    def _do_lease_list(self, _op):
+        def fn(client):
+            st: dict = {}
+            try:
+                client.list(LEASE_PREFIX, coder.prefix_end(LEASE_PREFIX),
+                            page=1000, stats=st)
+            finally:
+                self._count_rpc("range", st.get("rpcs", 0))
+        return fn
+
+    def _do_compact(self, _op):
+        def fn(client):
+            with self._revs_lock:
+                max_rev, last = self._max_rev, self._last_compact
+            target = (max_rev + last) // 2
+            if target <= last:
+                return "skip"  # not enough new history yet
+            self._count_rpc("compact")
+            client.compact(target)
+            with self._revs_lock:
+                if target > self._last_compact:
+                    self._last_compact = target
+        return fn
+
+    def _dispatch_keepalive(self, op) -> None:
+        with self._lease_lock:
+            lid = self._lease_ids.get(op.node)
+        if lid is None:
+            # replay is running ahead of the (queued) grant — count it, the
+            # reconciliation only tracks keepalives actually sent
+            self.stats.record(LEASE_KEEPALIVE, 0.0, "skip")
+            return
+        def on_ack(dt: float, ttl: int) -> None:
+            self.stats.record(LEASE_KEEPALIVE, dt,
+                              "ok" if ttl > 0 else "error",
+                              err=None if ttl > 0 else "keepalive TTL<=0")
+        if not self._leasemux.keepalive_async(lid, shard=op.node, on_ack=on_ack):
+            self.stats.record(LEASE_KEEPALIVE, 0.0, "error",
+                              err="keepalive stream dead")
+
+    # -------------------------------------------------------------- phases
+    def _spawn_server(self) -> None:
+        client_port = free_port()
+        self._info_port = free_port()
+        args = [sys.executable, "-m", "kubebrain_tpu.cli", "--single-node",
+                "--storage", self.spec.storage, "--host", "127.0.0.1",
+                "--client-port", str(client_port),
+                "--peer-port", str(free_port()),
+                "--info-port", str(self._info_port),
+                # the replay owns compaction cadence; the server's own
+                # compactor would make the op trace's COMPACT accounting lie
+                "--compact-interval", "86400"]
+        platform = os.environ.get("KB_WORKLOAD_JAX_PLATFORM", "cpu")
+        if platform:
+            args += ["--jax-platform", platform]
+        stderr = subprocess.DEVNULL
+        if self._server_log:
+            stderr = open(self._server_log, "ab")  # noqa: SIM115
+        self._server = subprocess.Popen(args, cwd=REPO_ROOT, stderr=stderr)
+        self._target = f"127.0.0.1:{client_port}"
+
+    def _probe(self, deadline_s: float = 60.0) -> None:
+        # fresh channel per attempt: a channel opened before the server
+        # binds accrues reconnect backoff (the test_kvrpc boot lesson)
+        deadline = time.monotonic() + deadline_s
+        while time.monotonic() < deadline:
+            probe = EtcdCompatClient(self._target)
+            try:
+                probe.count(b"/workload-probe", b"/workload-probe0")
+                probe.close()
+                return
+            except grpc.RpcError:
+                probe.close()
+                time.sleep(0.3)
+        raise RuntimeError(f"server at {self._target} never served")
+
+    def _preload(self, preload_ops) -> float:
+        t0 = time.monotonic()
+        client = EtcdCompatClient(self._target)
+        try:
+            items = [(op.key, b"v" * op.size) for op in preload_ops]
+            self._count_rpc("txn", len(items))
+            results = client.create_bulk(items, window=128)
+        finally:
+            client.close()
+        for op, (ok, rev) in zip(preload_ops, results):
+            self._note_rev(op.key, rev, ok)
+            # outcome bookkeeping only: pipelined-burst latency is not a
+            # per-op sample (it would be a fabricated 0)
+            self.stats.record(PRELOAD_CREATE, 0.0, "ok" if ok else "conflict",
+                              sample=False)
+        return time.monotonic() - t0
+
+    def _route(self, op) -> None:
+        kind = op.kind
+        if kind == LEASE_KEEPALIVE:
+            self._dispatch_keepalive(op)
+            return
+        if kind in (POD_CREATE, POD_UPDATE, POD_DELETE, LEASE_GRANT):
+            shard = self._write_shards[zlib.crc32(op.key) % len(self._write_shards)]
+            body = {POD_CREATE: self._do_pod_create,
+                    POD_UPDATE: self._do_pod_update,
+                    POD_DELETE: self._do_pod_delete,
+                    LEASE_GRANT: self._do_lease_grant}[kind](op)
+        elif kind in (CTRL_START, CTRL_LIST, CTRL_RELIST, LEASE_LIST):
+            shard = self._range_shards[op.watcher % len(self._range_shards)]
+            body = {CTRL_START: self._do_ctrl_start,
+                    CTRL_LIST: self._do_ctrl_list,
+                    CTRL_RELIST: self._do_ctrl_relist,
+                    LEASE_LIST: self._do_lease_list}[kind](op)
+        elif kind == COMPACT:
+            shard = self._admin_shard
+            body = self._do_compact(op)
+        else:  # pragma: no cover
+            raise AssertionError(f"unroutable op kind {kind}")
+        shard.submit(lambda client, k=kind, b=body: self._execute(k, b, client))
+
+    def _drain(self, timeout_s: float = 60.0) -> bool:
+        deadline = time.monotonic() + timeout_s
+        shards = [*self._write_shards, *self._range_shards, self._admin_shard]
+        while time.monotonic() < deadline:
+            if all(s.q.unfinished_tasks == 0 for s in shards):
+                break
+            time.sleep(0.05)
+        else:
+            return False
+        return self._leasemux.flush(max(1.0, deadline - time.monotonic()))
+
+    # ----------------------------------------------------------------- run
+    def run(self) -> dict:
+        spec = self.spec
+        spec.validate()
+        schedule = generator.generate(spec)
+        sha = schedule.sha256()
+        # determinism self-check: the SAME spec must regenerate the SAME
+        # byte trace (the replay's identity; acceptance gate)
+        sha2 = generator.generate(spec).sha256()
+        if sha != sha2:
+            raise RuntimeError(f"non-deterministic schedule: {sha} != {sha2}")
+
+        owns_server = self._target is None
+        if owns_server:
+            self._spawn_server()
+        self._write_shards: list[_Shard] = []
+        self._range_shards: list[_Shard] = []
+        try:
+            self._probe()
+            baseline = self._scrape()
+            preload_wall = self._preload(schedule.preload)
+
+            self._write_shards = [
+                _Shard(f"kb-wl-write-{i}", self._target, spec.shard_queue, self.stats)
+                for i in range(spec.write_shards)]
+            self._range_shards = [
+                _Shard(f"kb-wl-range-{i}", self._target, spec.shard_queue, self.stats)
+                for i in range(spec.range_shards)]
+            self._admin_shard = _Shard(
+                "kb-wl-admin", self._target, spec.shard_queue, self.stats)
+            self._watch_client = EtcdCompatClient(self._target)
+            self._watchmux = WatchMux(self._watch_client, streams=spec.watch_streams)
+            self._lease_client = EtcdCompatClient(self._target)
+            self._leasemux = LeaseMux(self._lease_client, streams=spec.lease_streams)
+
+            replay_ops = schedule.replay
+            pacer = ReplayPacer(spec.time_scale)
+            for op in replay_ops:
+                pacer.wait_until(op.t_ms)
+                self._route(op)
+            drained = self._drain()
+            replay_wall = pacer.elapsed_s()
+            time.sleep(0.3)  # let the last watch batches reach the wire
+            final = self._scrape()
+            report = self._build_report(
+                schedule, sha, baseline, final, preload_wall, replay_wall,
+                pacer, drained)
+        finally:
+            for s in [*self._write_shards, *self._range_shards,
+                      *([self._admin_shard] if hasattr(self, "_admin_shard") else [])]:
+                s.close()
+            if hasattr(self, "_watchmux"):
+                self._watchmux.close()
+                self._watch_client.close()
+            if hasattr(self, "_leasemux"):
+                self._leasemux.close()
+                self._lease_client.close()
+            if owns_server and self._server is not None:
+                self._server.terminate()
+                try:
+                    self._server.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    self._server.kill()
+
+        passed, violations = slo.evaluate(report, spec.bounds)
+        report["slo"]["pass"] = passed
+        report["slo"]["violations"] = violations
+        if self._write:
+            path = self._out_path or slo.next_report_path(REPO_ROOT)
+            slo.write_report(report, path)
+            print(f"[workload] SLO report: {path} "
+                  f"({'PASS' if passed else 'FAIL'})", file=sys.stderr)
+        else:
+            slo.validate_report(report)
+        return report
+
+    # --------------------------------------------------------------- report
+    def _build_report(self, schedule, sha, baseline, final, preload_wall,
+                      replay_wall, pacer, drained) -> dict:
+        spec = self.spec
+        stats = self.stats
+
+        op_kinds: dict[str, dict] = {}
+        for kind in generator.ALL_KINDS:
+            with stats._lock:
+                samples = list(stats.samples.get(kind, ()))
+                outs = {o: n for (k, o), n in stats.outcomes.items() if k == kind}
+            if not outs and not samples:
+                continue
+            op_kinds[kind] = {
+                "count": sum(outs.values()),
+                "ok": outs.get("ok", 0),
+                "shed": outs.get("shed", 0),
+                "errors": outs.get("error", 0),
+                "conflicts": outs.get("conflict", 0),
+                "skipped": outs.get("skip", 0),
+                "p50_ms": round(slo.percentile(samples, 0.5) * 1e3, 3),
+                "p99_ms": round(slo.percentile(samples, 0.99) * 1e3, 3),
+            }
+
+        lanes: dict[str, dict] = {}
+        for lane in ("system", "normal", "background", "write"):
+            kinds = [k for k, l in LANE_OF.items() if l == lane]
+            samples = []
+            with stats._lock:
+                for k in kinds:
+                    samples.extend(stats.samples.get(k, ()))
+            lanes[lane] = {
+                "count": sum(op_kinds.get(k, {}).get("count", 0) for k in kinds),
+                "ok": sum(op_kinds.get(k, {}).get("ok", 0) for k in kinds),
+                "shed": sum(op_kinds.get(k, {}).get("shed", 0) for k in kinds),
+                "errors": sum(op_kinds.get(k, {}).get("errors", 0) for k in kinds),
+                "p50_ms": round(slo.percentile(samples, 0.5) * 1e3, 3),
+                "p99_ms": round(slo.percentile(samples, 0.99) * 1e3, 3),
+            }
+
+        watchers = self._watchmux.watchers()
+        live_watchers = sum(1 for w in watchers if not w.cancelled)
+        watch = {
+            "watchers": live_watchers,
+            "events": self._watchmux.total_events(),
+            "cancelled": self._watchmux.cancelled_count(),
+            "lag_wire_p99_s": slo.hist_quantile(
+                final, "kb_watch_lag_seconds", 0.99, point="wire"),
+            "lag_queue_p99_s": slo.hist_quantile(
+                final, "kb_watch_lag_seconds", 0.99, point="queue"),
+        }
+
+        mux = self._leasemux
+        leases = {
+            "granted": stats.count(LEASE_GRANT, "ok"),
+            "keepalives_sent": mux.sent,
+            "keepalives_acked": mux.acked,
+            "expired_acks": mux.expired_acks,
+            "keepalives_skipped": stats.count(LEASE_KEEPALIVE, "skip"),
+            "metrics": {
+                "granted_delta": int(slo.delta(
+                    final, baseline, "kb_lease_granted_total")),
+                "keepalive_delta": int(slo.delta(
+                    final, baseline, "kb_lease_keepalive_total")),
+                "expired_delta": int(slo.delta(
+                    final, baseline, "kb_lease_expired_total")),
+                "active": slo.series_sum(final, "kb_lease_active"),
+            },
+        }
+
+        b_count, b_sum = slo.hist_count_sum(baseline, "kb_sched_batch_size")
+        f_count, f_sum = slo.hist_count_sum(final, "kb_sched_batch_size")
+        sched = {
+            "batched_launches": int(f_count - b_count),
+            "batched_requests": int(f_sum - b_sum),
+            "shed_total": int(slo.delta(final, baseline, "kb_sched_shed_total")),
+            "coalesced_total": int(slo.delta(
+                final, baseline, "kb_sched_coalesced_total")),
+        }
+
+        with self._rpc_lock:
+            rpc = dict(self._rpc)
+        checks: dict[str, dict] = {}
+
+        def chk(name: str, client_v: int, server_v: int) -> None:
+            checks[name] = {"client": int(client_v), "server": int(server_v),
+                            "ok": int(client_v) == int(server_v)}
+
+        chk("txn_rpcs", rpc.get("txn", 0),
+            slo.delta(final, baseline, "rpc_server_count", method=_TXN))
+        chk("range_rpcs", rpc.get("range", 0),
+            slo.delta(final, baseline, "rpc_server_count", method=_RANGE))
+        chk("compact_rpcs", rpc.get("compact", 0),
+            slo.delta(final, baseline, "rpc_server_count", method=_COMPACT))
+        chk("lease_grant_rpcs", rpc.get("lease_grant", 0),
+            slo.delta(final, baseline, "rpc_server_count", method=_LEASE_GRANT_RPC))
+        chk("lease_keepalives", mux.acked - mux.expired_acks,
+            slo.delta(final, baseline, "kb_lease_keepalive_total"))
+        chk("watchers", live_watchers,
+            slo.series_count(final, "kb_watch_backlog"))
+        reconcile_ok = all(c["ok"] for c in checks.values())
+
+        replay_ops = len(schedule.replay)
+        report = {
+            "schema": slo.SCHEMA_ID,
+            "spec": spec.to_dict(),
+            "platform": {
+                "platform": os.environ.get("KB_WORKLOAD_JAX_PLATFORM")
+                            or os.environ.get("JAX_PLATFORMS") or "default",
+                "device": f"kubebrain-cli(storage={spec.storage}, "
+                          f"front=sync-grpc)",
+            },
+            "trace": {
+                "sha256": sha,
+                "ops": len(schedule.ops),
+                "preload_ops": len(schedule.preload),
+                "replay_ops": replay_ops,
+                "determinism_checked": True,
+            },
+            "replay": {
+                "wall_s": round(replay_wall, 3),
+                "preload_wall_s": round(preload_wall, 3),
+                "ops_per_sec": round(replay_ops / replay_wall, 1)
+                               if replay_wall > 0 else 0.0,
+                "max_dispatch_lag_s": round(pacer.max_lag_s, 3),
+                "drained": drained,
+            },
+            "lanes": lanes,
+            "op_kinds": op_kinds,
+            "watch": watch,
+            "leases": leases,
+            "sched": sched,
+            "reconcile": {"ok": reconcile_ok, "checks": checks},
+            "slo": {"pass": False, "violations": [],
+                    "bounds": asdict(spec.bounds)},
+            "errors": list(stats.error_samples),
+        }
+        return report
+
+
+def run_workload(spec: WorkloadSpec, target: str | None = None,
+                 info_port: int = 0, out_path: str | None = None,
+                 write_report: bool = True,
+                 server_log: str | None = None) -> dict:
+    return WorkloadRunner(spec, target=target, info_port=info_port,
+                          out_path=out_path, write_report=write_report,
+                          server_log=server_log).run()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="kubebrain-workload",
+        description="deterministic kube-apiserver workload replay "
+                    "(docs/workloads.md)")
+    ap.add_argument("--nodes", "-n", type=int, default=100)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--duration", type=float, default=30.0,
+                    help="simulated seconds")
+    ap.add_argument("--scale", type=float, default=5.0,
+                    help="simulated seconds per real second")
+    ap.add_argument("--storage", default="memkv",
+                    choices=["memkv", "native", "tpu"])
+    ap.add_argument("--target", default="",
+                    help="host:port of a running server (default: spawn one)")
+    ap.add_argument("--target-info-port", type=int, default=0,
+                    help="info/metrics HTTP port of the --target server "
+                         "(required with --target)")
+    ap.add_argument("--out", default="",
+                    help="report path (default: WORKLOAD_rNN.json in repo root)")
+    ap.add_argument("--smoke", action="store_true",
+                    help="small-N CI smoke shape (short, every traffic kind)")
+    args = ap.parse_args(argv)
+
+    if args.smoke:
+        spec = WorkloadSpec.for_smoke(args.nodes, seed=args.seed)
+    else:
+        spec = WorkloadSpec.for_cluster(
+            args.nodes, seed=args.seed, duration_s=args.duration,
+            time_scale=args.scale, storage=args.storage)
+    report = run_workload(spec, target=args.target or None,
+                          info_port=args.target_info_port,
+                          out_path=args.out or None)
+    print(json.dumps({
+        "metric": "cluster-replay ops/sec",
+        "value": report["replay"]["ops_per_sec"],
+        "slo_pass": report["slo"]["pass"],
+        "violations": report["slo"]["violations"],
+        "trace_sha256": report["trace"]["sha256"],
+    }))
+    return 0 if report["slo"]["pass"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,244 @@
+"""SLO report: schema, /metrics reconciliation helpers, and bounds
+evaluation for the workload replay harness.
+
+The report is machine-readable JSON (``WORKLOAD_rNN.json``) with a fixed
+schema (:data:`SCHEMA_ID`, checked by :func:`validate_report`) so later
+perf PRs can diff replays mechanically. The prometheus text parser here
+is deliberately tiny — it reads the server's own /metrics exposition, the
+same bytes an operator's scrape sees, which is the whole point of
+reconciling client-side op counts against it.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import re
+
+SCHEMA_ID = "kubebrain-workload-slo/v1"
+
+# ------------------------------------------------------------ prom parsing
+
+_SERIES_RE = re.compile(
+    r'^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{(.*)\})?\s+([^\s]+)\s*$')
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+#: parsed exposition: name -> list of (labels dict, value)
+PromSnapshot = dict
+
+
+def parse_prom(text: str) -> PromSnapshot:
+    out: dict[str, list[tuple[dict, float]]] = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        m = _SERIES_RE.match(line)
+        if not m:
+            continue
+        name, raw_labels, raw_value = m.groups()
+        try:
+            value = float(raw_value)
+        except ValueError:
+            continue
+        labels = dict(_LABEL_RE.findall(raw_labels)) if raw_labels else {}
+        out.setdefault(name, []).append((labels, value))
+    return out
+
+
+def _matches(labels: dict, want: dict) -> bool:
+    return all(labels.get(k) == v for k, v in want.items())
+
+
+def series_sum(snap: PromSnapshot, name: str, **want: str) -> float:
+    """Sum of all series under ``name`` whose labels match ``want``.
+    Counters are tried under both ``name`` and ``name_total`` (the
+    prometheus_client text-exposition suffix)."""
+    total, found = 0.0, False
+    for candidate in (name, name + "_total"):
+        for labels, value in snap.get(candidate, ()):
+            if _matches(labels, want):
+                total += value
+                found = True
+        if found:
+            break
+    return total
+
+
+def series_count(snap: PromSnapshot, name: str, **want: str) -> int:
+    """Number of distinct series under ``name`` matching ``want`` (e.g.
+    one ``kb_watch_backlog`` series per live watcher)."""
+    return sum(1 for labels, _v in snap.get(name, ()) if _matches(labels, want))
+
+
+def delta(after: PromSnapshot, before: PromSnapshot, name: str, **want: str) -> float:
+    return series_sum(after, name, **want) - series_sum(before, name, **want)
+
+
+def hist_quantile(snap: PromSnapshot, name: str, q: float, **want: str) -> float | None:
+    """Quantile from a cumulative-bucket histogram, linearly interpolated
+    within the landing bucket. Returns None when the histogram is empty;
+    observations in the +Inf bucket report the top finite bound (a
+    conservative floor, not a fabricated tail)."""
+    buckets: list[tuple[float, float]] = []
+    for labels, value in snap.get(name + "_bucket", ()):
+        if "le" not in labels:
+            continue
+        rest = {k: v for k, v in labels.items() if k != "le"}
+        if not _matches(rest, want):
+            continue
+        le = float("inf") if labels["le"] in ("+Inf", "inf") else float(labels["le"])
+        buckets.append((le, value))
+    if not buckets:
+        return None
+    buckets.sort(key=lambda b: b[0])
+    total = buckets[-1][1]
+    if total <= 0:
+        return None
+    rank = q * total
+    prev_bound, prev_cum = 0.0, 0.0
+    for bound, cum in buckets:
+        if cum >= rank:
+            if math.isinf(bound):
+                return prev_bound
+            if cum == prev_cum:
+                return bound
+            frac = (rank - prev_cum) / (cum - prev_cum)
+            return prev_bound + frac * (bound - prev_bound)
+        prev_bound, prev_cum = bound, cum
+    return prev_bound
+
+
+def hist_count_sum(snap: PromSnapshot, name: str, **want: str) -> tuple[float, float]:
+    return (series_sum(snap, name + "_count", **want),
+            series_sum(snap, name + "_sum", **want))
+
+
+# ------------------------------------------------------------ report schema
+
+#: required top-level fields and the required keys inside each (one level
+#: deep is enough for mechanical diffing; values are free-form beyond it)
+_REQUIRED: dict[str, tuple[str, ...]] = {
+    "schema": (),
+    "spec": ("nodes", "seed", "duration_s", "time_scale"),
+    "platform": ("platform", "device"),
+    "trace": ("sha256", "ops", "preload_ops", "replay_ops"),
+    "replay": ("wall_s", "ops_per_sec", "max_dispatch_lag_s", "drained"),
+    "lanes": ("system", "normal", "background", "write"),
+    "op_kinds": (),
+    "watch": ("watchers", "events", "cancelled",
+              "lag_wire_p99_s", "lag_queue_p99_s"),
+    "leases": ("granted", "keepalives_sent", "keepalives_acked",
+               "expired_acks", "metrics"),
+    "sched": ("batched_launches", "batched_requests", "shed_total",
+              "coalesced_total"),
+    "reconcile": ("ok", "checks"),
+    "slo": ("pass", "violations", "bounds"),
+    "errors": (),
+}
+
+_LANE_FIELDS = ("count", "p50_ms", "p99_ms", "shed", "errors")
+
+
+def validate_report(report: dict) -> None:
+    """Raise ValueError naming every schema problem at once."""
+    problems: list[str] = []
+    if report.get("schema") != SCHEMA_ID:
+        problems.append(f"schema must be {SCHEMA_ID!r}, got {report.get('schema')!r}")
+    for field, subkeys in _REQUIRED.items():
+        if field not in report:
+            problems.append(f"missing field {field!r}")
+            continue
+        for sub in subkeys:
+            if sub not in report[field]:
+                problems.append(f"missing field {field!r}.{sub!r}")
+    for lane, stats in report.get("lanes", {}).items():
+        for f in _LANE_FIELDS:
+            if f not in stats:
+                problems.append(f"lane {lane!r} missing {f!r}")
+    if problems:
+        raise ValueError("invalid SLO report: " + "; ".join(problems))
+
+
+# --------------------------------------------------------------- evaluation
+
+def percentile(samples: list[float], q: float) -> float:
+    if not samples:
+        return 0.0
+    s = sorted(samples)
+    idx = min(len(s) - 1, max(0, int(math.ceil(q * len(s))) - 1))
+    return s[idx]
+
+
+def evaluate(report: dict, bounds) -> tuple[bool, list[str]]:
+    """Judge a report against declared bounds; returns (passed, violations).
+    ``bounds`` is a spec.SLOBounds (or anything with its attributes)."""
+    v: list[str] = []
+    if not report["replay"].get("drained", True):
+        # name the drain timeout explicitly: with ops still in flight at
+        # scrape time, the reconcile deltas below race the workers — a
+        # reconcile mismatch here would otherwise read as a counting bug
+        v.append("drain/flush timed out with ops still in flight "
+                 "(reconciliation below is unreliable)")
+    lane_bounds = {
+        "write": bounds.write_p99_ms,
+        "normal": bounds.normal_p99_ms,
+        "system": bounds.system_p99_ms,
+        "background": bounds.background_p99_ms,
+    }
+    total = shed = errors = 0
+    for lane, stats in report["lanes"].items():
+        total += stats["count"]
+        shed += stats["shed"]
+        errors += stats["errors"]
+        bound = lane_bounds.get(lane)
+        if bound is not None and stats["count"] and stats["p99_ms"] > bound:
+            v.append(f"lane {lane}: p99 {stats['p99_ms']:.1f}ms > {bound:.1f}ms")
+    if total:
+        if shed / total > bounds.max_shed_rate:
+            v.append(f"shed rate {shed}/{total} > {bounds.max_shed_rate:.2%}")
+        if errors / total > bounds.max_error_rate:
+            v.append(f"error rate {errors}/{total} > {bounds.max_error_rate:.2%}")
+    wire_p99 = report["watch"]["lag_wire_p99_s"]
+    if report["watch"]["events"] and wire_p99 is not None \
+            and wire_p99 > bounds.watch_wire_lag_p99_s:
+        v.append(f"watch wire lag p99 {wire_p99:.3f}s > "
+                 f"{bounds.watch_wire_lag_p99_s}s")
+    if report["watch"]["cancelled"] > bounds.max_watch_cancels:
+        v.append(f"{report['watch']['cancelled']} watch cancels > "
+                 f"{bounds.max_watch_cancels}")
+    expiries = report["leases"]["metrics"].get("expired_delta", 0)
+    if expiries > bounds.max_lease_expiries:
+        v.append(f"{expiries} lease expiries > {bounds.max_lease_expiries}")
+    # completed compactions only — "count" also tallies skip/shed/error
+    if report["op_kinds"].get("COMPACT", {}).get("ok", 0) < bounds.min_compactions:
+        v.append(f"fewer than {bounds.min_compactions} compactions completed")
+    if report["sched"]["batched_requests"] < bounds.min_batched_requests:
+        v.append(f"batched requests {report['sched']['batched_requests']} < "
+                 f"{bounds.min_batched_requests}")
+    if not report["reconcile"]["ok"]:
+        bad = [c for c, r in report["reconcile"]["checks"].items() if not r["ok"]]
+        v.append(f"client/server reconciliation failed: {', '.join(bad)}")
+    return (not v), v
+
+
+# ----------------------------------------------------------------- file IO
+
+_REPORT_RE = re.compile(r"^WORKLOAD_r(\d+)\.json$")
+
+
+def next_report_path(root: str) -> str:
+    """``WORKLOAD_rNN.json`` with the next free round number under root."""
+    rounds = [int(m.group(1)) for f in os.listdir(root)
+              if (m := _REPORT_RE.match(f))]
+    return os.path.join(root, "WORKLOAD_r%02d.json" % (max(rounds, default=0) + 1))
+
+
+def write_report(report: dict, path: str) -> str:
+    validate_report(report)
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(report, f, indent=1, sort_keys=True)
+        f.write("\n")
+    os.replace(tmp, path)
+    return path

@@ -1,0 +1,137 @@
+"""Workload specification: the full parameterization of one simulated
+cluster, plus the SLO bounds its replay report is judged against.
+
+Everything that shapes the generated op trace lives here so that
+``generate(spec)`` is a pure function of (spec, spec.seed) — the
+determinism contract the replay harness is built on. Runtime-only knobs
+(shard counts, stream counts) also live here so a report's ``spec`` echo
+fully describes how the numbers were produced.
+"""
+
+from __future__ import annotations
+
+from dataclasses import asdict, dataclass, field, replace
+
+
+@dataclass(frozen=True)
+class SLOBounds:
+    """Declared service-level bounds the replay report is evaluated
+    against (slo.evaluate). Defaults are deliberately loose — they must
+    hold on a 2-vCPU CI box while the REST of the test suite hammers the
+    same cores (measured: a ~20ms standalone system p99 stretches past
+    1.5s under full-suite load); the defaults catch harness breakage, and
+    tighter per-deployment bounds are a spec override, not an edit here."""
+
+    write_p99_ms: float = 5000.0
+    normal_p99_ms: float = 5000.0
+    system_p99_ms: float = 5000.0
+    background_p99_ms: float = 10000.0
+    max_shed_rate: float = 0.05
+    max_error_rate: float = 0.01
+    watch_wire_lag_p99_s: float = 10.0  # the lag histogram's top finite bucket
+    max_lease_expiries: int = 0
+    max_watch_cancels: int = 0
+    min_compactions: int = 1
+    #: total Range/Count requests that must have ridden a query-batched
+    #: dispatch (kb_sched_batch_size sum). 0 = don't require batching —
+    #: small-N smokes can't guarantee concurrent distinct ranges queue up.
+    min_batched_requests: int = 0
+
+
+@dataclass(frozen=True)
+class WorkloadSpec:
+    """One simulated cluster. Times suffixed ``_s`` are SIMULATED seconds
+    unless noted; ``time_scale`` maps them to real time at replay
+    (sim seconds per real second). ``lease_ttl_s`` is REAL seconds — the
+    server's lease clock runs in real time regardless of replay speed."""
+
+    nodes: int = 100
+    namespaces: int = 20
+    pods_per_node: int = 4
+    duration_s: float = 30.0
+    time_scale: float = 5.0
+    seed: int = 0
+
+    # traffic shape
+    churn_interval_s: float = 2.0        # mean per-node pod churn period
+    keepalive_interval_s: float = 10.0   # per-node Lease keepalive cadence
+    #: REAL seconds (server clock) — kube's node-lease TTL. Generous vs the
+    #: nominal keepalive cadence on purpose: on a loaded box the open-loop
+    #: replay can run behind schedule, and a too-tight TTL then reports
+    #: scheduler lag as lease expiries
+    lease_ttl_s: int = 40
+    list_interval_s: float = 7.0         # per-controller paged list (NORMAL)
+    list_limit: int = 200
+    relist_interval_s: float = 12.0      # aligned relist storms (BACKGROUND)
+    lease_list_interval_s: float = 5.0   # node-controller lease sweeps (SYSTEM)
+    lease_listers: int = 2
+    compact_interval_s: float = 12.0
+    grant_spread_s: float = 4.0          # lease grants staggered over this
+    watch_spread_s: float = 5.0          # controller starts staggered over this
+    value_min: int = 256                 # pod object size distribution bounds
+    value_max: int = 4096
+
+    # replay-engine knobs (runtime only; do not affect the generated trace)
+    storage: str = "memkv"
+    write_shards: int = 8
+    range_shards: int = 8
+    watch_streams: int = 4
+    lease_streams: int = 4
+    shard_queue: int = 512               # bounded open-loop backpressure depth
+
+    bounds: SLOBounds = field(default_factory=SLOBounds)
+
+    # ------------------------------------------------------------- validity
+    def validate(self) -> None:
+        if self.nodes < 1 or self.namespaces < 1 or self.pods_per_node < 0:
+            raise ValueError("nodes/namespaces/pods_per_node must be positive")
+        if self.duration_s <= 0 or self.time_scale <= 0:
+            raise ValueError("duration_s and time_scale must be > 0")
+        if self.seed < 0:
+            raise ValueError("seed must be >= 0")
+        # a keepalive cadence slower (in real time) than half the lease TTL
+        # guarantees expiries — that is a misconfigured spec, not a finding
+        real_keepalive = self.keepalive_interval_s / self.time_scale
+        if real_keepalive * 2.0 > self.lease_ttl_s:
+            raise ValueError(
+                f"keepalive every {real_keepalive:.1f}s real vs TTL "
+                f"{self.lease_ttl_s}s: leases would expire by construction")
+        if min(self.write_shards, self.range_shards,
+               self.watch_streams, self.lease_streams) < 1:
+            raise ValueError("shard/stream counts must be >= 1")
+
+    # ------------------------------------------------------------ factories
+    @classmethod
+    def for_cluster(cls, nodes: int, **overrides) -> "WorkloadSpec":
+        """The ``make bench-cluster N=...`` shape: namespaces scale with the
+        node count, and at >= 100 nodes the relist storms are expected to
+        form query batches (kb_sched_batch_size must move)."""
+        namespaces = max(4, min(100, nodes // 10))
+        bounds = overrides.pop(
+            "bounds",
+            SLOBounds(min_batched_requests=2 if nodes >= 100 else 0))
+        return cls(nodes=nodes, namespaces=namespaces, bounds=bounds,
+                   **overrides)
+
+    @classmethod
+    def for_smoke(cls, nodes: int = 10, **overrides) -> "WorkloadSpec":
+        """Small-N CI smoke: short replay, every traffic shape still
+        present (several churn ticks, >= 1 relist storm, >= 1 compaction,
+        >= 1 keepalive per node)."""
+        defaults = dict(
+            nodes=nodes, namespaces=max(2, nodes // 3), pods_per_node=3,
+            duration_s=10.0, time_scale=5.0,
+            churn_interval_s=1.5, keepalive_interval_s=4.0, lease_ttl_s=15,
+            list_interval_s=3.0, relist_interval_s=4.0,
+            lease_list_interval_s=3.0, lease_listers=1,
+            compact_interval_s=4.0, grant_spread_s=1.0, watch_spread_s=2.0,
+            write_shards=4, range_shards=4, watch_streams=2, lease_streams=2,
+        )
+        defaults.update(overrides)
+        return cls(**defaults)
+
+    def with_(self, **overrides) -> "WorkloadSpec":
+        return replace(self, **overrides)
+
+    def to_dict(self) -> dict:
+        return asdict(self)

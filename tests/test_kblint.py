@@ -435,6 +435,75 @@ def test_kb109_scoped_and_suppressible():
     assert ids(sup, TPU_ENG) == []
 
 
+# ------------------------------------------------------------------- KB110
+WORKLOAD = "kubebrain_tpu/workload/x.py"
+
+
+def test_kb110_flags_module_level_random():
+    src = "import random\ndef jitter():\n    return random.random()\n"
+    assert ids(src, WORKLOAD) == ["KB110"]
+    src2 = "import random\ndef pick(xs):\n    return random.choice(xs)\n"
+    assert ids(src2, WORKLOAD) == ["KB110"]
+
+
+def test_kb110_flags_np_random_and_unseeded_ctor():
+    src = "import numpy as np\ndef f():\n    return np.random.randint(10)\n"
+    assert ids(src, WORKLOAD) == ["KB110"]
+    src2 = "import random\ndef f():\n    return random.Random()\n"
+    assert ids(src2, WORKLOAD) == ["KB110"]
+
+
+def test_kb110_flags_time_time_in_schedule_path():
+    src = "import time\ndef stamp():\n    return time.time()\n"
+    assert ids(src, WORKLOAD) == ["KB110"]
+
+
+def test_kb110_allows_seeded_rng_and_monotonic():
+    src = ("import random\nimport time\n"
+           "def gen(seed):\n"
+           "    rng = random.Random(seed)\n"
+           "    t0 = time.monotonic()\n"
+           "    return rng.random() + rng.expovariate(2.0) + t0\n")
+    assert ids(src, WORKLOAD) == []
+    src2 = ("import numpy as np\n"
+            "def gen(seed):\n"
+            "    return np.random.default_rng(seed).integers(10)\n")
+    assert ids(src2, WORKLOAD) == []
+
+
+def test_kb110_sees_through_import_aliases():
+    # the holes an aliased import would open must stay closed (same
+    # diligence _is_time_time applies to `import time as _time`)
+    src = "import random as r\ndef f():\n    return r.random()\n"
+    assert ids(src, WORKLOAD) == ["KB110"]
+    src2 = "from random import random\ndef f():\n    return random()\n"
+    assert ids(src2, WORKLOAD) == ["KB110"]
+    src3 = ("import numpy.random\n"
+            "def f():\n    return numpy.random.randint(3)\n")
+    assert ids(src3, WORKLOAD) == ["KB110"]
+    # a plain dotted import binds the TOP-LEVEL package: seeded ctors and
+    # non-RNG numpy calls under it must not be mangled into false positives
+    src3b = ("import numpy.random\n"
+             "def f(seed, xs):\n"
+             "    return numpy.random.default_rng(seed), numpy.array(xs)\n")
+    assert ids(src3b, WORKLOAD) == []
+    # aliased but properly seeded stays legal
+    src4 = ("from random import Random\n"
+            "def f(seed):\n    return Random(seed).random()\n")
+    assert ids(src4, WORKLOAD) == []
+    src5 = "from random import Random\ndef f():\n    return Random()\n"
+    assert ids(src5, WORKLOAD) == ["KB110"]
+
+
+def test_kb110_scoped_and_suppressible():
+    src = "import random\ndef f():\n    return random.random()\n"
+    assert ids(src, ANY) == []  # only workload/ carries the replay contract
+    sup = ("import random\n"
+           "def f():\n"
+           "    return random.random()  # kblint: disable=KB110\n")
+    assert ids(sup, WORKLOAD) == []
+
+
 def test_kb106_covers_batched_entry_points():
     src = "def f(backend, qs):\n    return backend.list_batch(qs)\n"
     assert ids(src, SRV_ETCD) == ["KB106"]
@@ -445,7 +514,7 @@ def test_kb106_covers_batched_entry_points():
 # ------------------------------------------------------------ registry/CLI
 def test_registry_has_all_rules():
     assert set(RULES) == {"KB101", "KB102", "KB103", "KB104", "KB105", "KB106",
-                          "KB107", "KB108", "KB109"}
+                          "KB107", "KB108", "KB109", "KB110"}
     for rule in RULES.values():
         assert rule.summary
 

@@ -403,6 +403,97 @@ class ScanDispatchOnlyInAssemblyPoints(Rule):
         yield from scan(tree.body, None)
 
 
+#: module-level PRNG roots whose use makes a workload non-replayable
+#: (names are matched after alias canonicalization, so ``import random as
+#: r`` / ``from random import random`` don't slip through)
+_UNSEEDED_RNG_PREFIXES = ("random.", "numpy.random.")
+#: constructors that ARE the sanctioned way in — but only with an explicit
+#: seed argument (``random.Random()`` falls back to urandom/wall clock)
+_SEEDED_RNG_CTORS = {
+    "random.Random", "numpy.random.default_rng", "numpy.random.RandomState",
+}
+_RNG_MODULES = {"random", "numpy", "numpy.random"}
+
+
+def _rng_alias_maps(tree: ast.Module) -> tuple[dict, dict]:
+    """(root alias -> canonical module, from-imported name -> canonical
+    dotted name) for the RNG modules — the same aliased-import diligence
+    ``_is_time_time`` applies to ``time``."""
+    roots: dict[str, str] = {}
+    from_names: dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name in _RNG_MODULES:
+                    if a.asname:
+                        roots[a.asname] = a.name
+                    else:
+                        # `import numpy.random` binds the TOP-LEVEL package
+                        # name, so the canonical mapping is the identity —
+                        # mapping root -> full dotted module would mangle
+                        # numpy.array into numpy.random.array
+                        root = a.name.split(".", 1)[0]
+                        roots.setdefault(root, root)
+        elif isinstance(node, ast.ImportFrom) and node.module in _RNG_MODULES:
+            for a in node.names:
+                from_names[a.asname or a.name] = f"{node.module}.{a.name}"
+    return roots, from_names
+
+
+def _canon_rng_name(name: str, roots: dict, from_names: dict) -> str:
+    if name in from_names:
+        return from_names[name]
+    root, _, rest = name.partition(".")
+    if root in roots:
+        return roots[root] + ("." + rest if rest else "")
+    return name
+
+
+@register
+class ReplayableWorkloadRandomness(Rule):
+    """The workload generator's contract is seed ⇒ byte-identical op
+    trace (the replay harness's identity, asserted by the determinism
+    test AND re-checked on every run). One ``random.random()`` or
+    ``time.time()`` on the schedule path silently breaks replays in a way
+    no single run can detect — the trace still *looks* plausible. Thread
+    the seeded ``random.Random(seed)`` through instead, and use the event
+    wheel / monotonic clock for time."""
+
+    rule_id = "KB110"
+    summary = ("workload/ must stay replayable: no unseeded randomness "
+               "(module-level random.*/np.random.*) and no time.time() — "
+               "thread a seeded random.Random; clock via the event wheel")
+
+    def applies(self, relpath: str) -> bool:
+        return relpath.replace("\\", "/").startswith("kubebrain_tpu/workload/")
+
+    def check(self, tree: ast.Module, src: str) -> Iterable[tuple[ast.AST, str]]:
+        roots, from_names = _rng_alias_maps(tree)
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _canon_rng_name(dotted_name(node.func), roots, from_names)
+            if name in _SEEDED_RNG_CTORS:
+                if not node.args and not node.keywords:
+                    yield node, (
+                        f"{name}() without a seed falls back to wall-clock/"
+                        "urandom entropy; pass the spec seed"
+                    )
+                continue
+            if name.startswith(_UNSEEDED_RNG_PREFIXES):
+                yield node, (
+                    f"module-level PRNG call {name}(): unseeded global "
+                    "state breaks seed->trace determinism; use the "
+                    "threaded random.Random(seed)"
+                )
+            elif _is_time_time(node):
+                yield node, (
+                    "time.time() in workload/: wall-clock reads make the "
+                    "schedule non-replayable; use the event wheel "
+                    "(simulated time) or time.monotonic() for measurement"
+                )
+
+
 _REV_TOKENS = {"rev", "revision"}
 
 
