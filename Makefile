@@ -3,8 +3,8 @@
 
 SHELL := /bin/bash  # test-tier1 needs pipefail
 
-.PHONY: all native test bench bench-all bench-smoke bench-cluster run clean \
-        protos lint typecheck check test-tier1
+.PHONY: all native test bench bench-all bench-smoke bench-cluster \
+        bench-multichip run clean protos lint typecheck check test-tier1
 
 all: native
 
@@ -64,10 +64,22 @@ bench-smoke:
 # gRPC front — pod churn + controller list/watch + node lease keepalives +
 # compaction in one run. Emits WORKLOAD_rNN.json (docs/workloads.md).
 # Same seed => byte-identical op trace (self-checked every run).
+# MESH_PART/SCAN_PARTS drive a part-sharded server (STORAGE=tpu required;
+# docs/multichip.md), e.g.: make bench-cluster N=1000 STORAGE=tpu MESH_PART=8
 N ?= 1000
+STORAGE ?= memkv
+MESH_PART ?= 0
+SCAN_PARTS ?= 0
 bench-cluster:
 	JAX_PLATFORMS=cpu KB_BENCH_METRIC=cluster KB_BENCH_NODES=$(N) \
-	    python bench.py
+	    KB_WORKLOAD_STORAGE=$(STORAGE) KB_WORKLOAD_MESH_PART=$(MESH_PART) \
+	    KB_WORKLOAD_SCAN_PARTITIONS=$(SCAN_PARTS) python bench.py
+
+# Multichip sharded serving curve (docs/multichip.md): the scan workload
+# served through the scheduler at mesh sizes 1..8, byte-identical across
+# sizes; KB_MULTICHIP_OUT=MULTICHIP_rNN.json writes the schema'd report.
+bench-multichip:
+	JAX_PLATFORMS=cpu KB_BENCH_METRIC=multichip python bench.py
 
 run: native
 	python -m kubebrain_tpu.cli --single-node --storage=tpu --inner-storage=native

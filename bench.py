@@ -1093,7 +1093,9 @@ def bench_cluster() -> None:
 
     Env knobs: KB_BENCH_NODES (or N), KB_WORKLOAD_SEED, KB_WORKLOAD_DURATION
     (simulated seconds), KB_WORKLOAD_SCALE (sim seconds per real second),
-    KB_WORKLOAD_STORAGE, KB_WORKLOAD_OUT (report path)."""
+    KB_WORKLOAD_STORAGE, KB_WORKLOAD_OUT (report path),
+    KB_WORKLOAD_MESH_PART / KB_WORKLOAD_SCAN_PARTITIONS (sharded server,
+    requires KB_WORKLOAD_STORAGE=tpu; docs/multichip.md)."""
     from kubebrain_tpu.workload.runner import run_workload
     from kubebrain_tpu.workload.spec import WorkloadSpec
 
@@ -1104,6 +1106,8 @@ def bench_cluster() -> None:
         duration_s=float(os.environ.get("KB_WORKLOAD_DURATION", 30.0)),
         time_scale=float(os.environ.get("KB_WORKLOAD_SCALE", 5.0)),
         storage=os.environ.get("KB_WORKLOAD_STORAGE", "memkv"),
+        mesh_part=int(os.environ.get("KB_WORKLOAD_MESH_PART", 0)),
+        scan_partitions=int(os.environ.get("KB_WORKLOAD_SCAN_PARTITIONS", 0)),
     )
     report = run_workload(spec, out_path=os.environ.get("KB_WORKLOAD_OUT") or None)
     lanes = {lane: {"p50_ms": s["p50_ms"], "p99_ms": s["p99_ms"],
@@ -1130,6 +1134,210 @@ def bench_cluster() -> None:
             "batched_requests": report["sched"]["batched_requests"],
             "reconcile_ok": report["reconcile"]["ok"],
         },
+    }))
+
+
+def multichip_phase(mesh_sizes, n_keys=20_000, n_req=64, depth=4, batch=8,
+                    partitions=0, use_pallas=None, threads=8):
+    """Serve the SAME scan workload through the request scheduler over the
+    TPU engine at each mesh size and report the scaling curve — the
+    promoted multichip path (the MULTICHIP dry runs never served a
+    request). One host store is preloaded once; each mesh size wraps it in
+    a fresh ``TpuKvStorage`` whose mirror shards over ``part`` across that
+    many devices, then 8 distinct per-namespace Range/Count requests x
+    ``n_req`` are pushed through the scheduler concurrently (composing
+    with PR 2 lanes/pipelining and PR 5 query batching). Results are
+    fingerprinted against the unscheduled sequential oracle AND across
+    mesh sizes — byte identity is asserted, not sampled.
+
+    Shared by ``bench_multichip`` (KB_BENCH_METRIC=multichip) and
+    ``__graft_entry__.dryrun_multichip`` (the driver contract)."""
+    import threading
+
+    from kubebrain_tpu.backend import Backend, BackendConfig
+    from kubebrain_tpu.parallel.mesh import make_mesh
+    from kubebrain_tpu.sched import SchedConfig, ensure_scheduler
+    from kubebrain_tpu.storage import new_storage
+    from kubebrain_tpu.storage.tpu.engine import TRANSFER_METER, TpuKvStorage
+
+    NS = 8
+    inner = new_storage("memkv")
+    loader = Backend(inner, BackendConfig(
+        event_ring_capacity=max(8192, n_keys * 2)))
+    for i in range(n_keys):
+        loader.create(b"/registry/pods/ns-%02d/pod-%07d" % (i % NS, i),
+                      b"x" * 64)
+    loader.close()
+
+    # request mix: per-namespace Range (3 of 4) and Count (1 of 4) — the
+    # distinct-prefix shape that forms PR 5 query batches
+    reqs = []
+    for i in range(n_req):
+        ns = i % NS
+        bounds = (b"/registry/pods/ns-%02d/" % ns,
+                  b"/registry/pods/ns-%02d0" % ns)
+        reqs.append(("count" if i % 4 == 3 else "list", *bounds))
+
+    def fingerprint(kind, res):
+        if kind == "count":
+            return b"count|%d|%d" % res
+        out = [b"%d|%d|%d" % (res.revision, res.count, int(res.more))]
+        for kv in res.kvs:
+            out.append(kv.key + b"\x00" + kv.value + b"\x00%d" % kv.revision)
+        return b"\xff".join(out)
+
+    report = {
+        "mesh_sizes": list(mesh_sizes),
+        "rows_per_sec": {},
+        "scaling_vs_1dev": {},
+        "byte_identical": True,
+        "batched_riders": {},
+        "mirror_partitions": {},
+        "host_transfer_bytes_per_req": {},
+        "requests": n_req,
+        "sched": {"depth": depth, "batch": batch, "threads": threads},
+        "dataset": {"keys": n_keys, "namespaces": NS},
+    }
+    baseline_fps = None
+    kernel = None
+    try:
+        for ndev in mesh_sizes:
+            mesh = make_mesh(n_devices=ndev)
+            kw = {} if use_pallas is None else {"use_pallas": use_pallas}
+            store = TpuKvStorage(inner, mesh=mesh, partitions=partitions, **kw)
+            backend = Backend(store, BackendConfig(event_ring_capacity=8192))
+            sched = ensure_scheduler(
+                backend, SchedConfig(depth=depth, batch=batch))
+            kernel = backend.scanner._scan_kernel
+            # sequential unscheduled oracle; also publishes the mirror and
+            # compiles this mesh size's kernels off the clock
+            expect = []
+            for kind, s, e in reqs:
+                if kind == "count":
+                    expect.append(fingerprint(kind, backend.count(s, e)))
+                else:
+                    expect.append(fingerprint(kind, backend.list_(s, e)))
+            report["mirror_partitions"][str(ndev)] = \
+                backend.scanner._mirror.partitions
+
+            results: list = [None] * n_req
+            rows = [0] * n_req
+            pending = iter(range(n_req))
+            lock = threading.Lock()
+
+            def worker():
+                while True:
+                    with lock:
+                        try:
+                            i = next(pending)
+                        except StopIteration:
+                            return
+                    kind, s, e = reqs[i]
+                    if kind == "count":
+                        res = sched.count(s, e, client=f"c{i % 4}")
+                        rows[i] = res[0]
+                    else:
+                        res = sched.list_(s, e, 0, 0, client=f"c{i % 4}")
+                        rows[i] = len(res.kvs)
+                    results[i] = fingerprint(kind, res)
+
+            b0, _ = TRANSFER_METER.snapshot()
+            pool = [threading.Thread(target=worker) for _ in range(threads)]
+            t0 = time.monotonic()
+            for t in pool:
+                t.start()
+            for t in pool:
+                t.join()
+            dt = time.monotonic() - t0
+            b1, _ = TRANSFER_METER.snapshot()
+
+            mism = sum(1 for a, b in zip(results, expect) if a != b)
+            assert mism == 0, (
+                f"{mism}/{n_req} scheduled results diverged from the "
+                f"sequential oracle at mesh={ndev}")
+            if baseline_fps is None:
+                baseline_fps = expect
+            elif expect != baseline_fps:
+                report["byte_identical"] = False
+            report["rows_per_sec"][str(ndev)] = round(sum(rows) / dt)
+            report["batched_riders"][str(ndev)] = sched.batched
+            report["host_transfer_bytes_per_req"][str(ndev)] = round(
+                (b1 - b0) / n_req)
+            backend.close()
+    finally:
+        inner.close()
+    assert report["byte_identical"], "mesh sizes disagreed byte-for-byte"
+    base = report["rows_per_sec"].get(str(mesh_sizes[0]), 0) or 1
+    for k, v in report["rows_per_sec"].items():
+        report["scaling_vs_1dev"][k] = round(v / base, 3)
+    report["kernel"] = kernel
+    return report
+
+
+def bench_multichip() -> None:
+    """Multichip sharded serving (the promoted MULTICHIP phase): the scan
+    workload served through the scheduler at mesh sizes 1→8, byte-identical
+    across sizes, reported as ``multichip_rows_per_sec`` plus a schema'd
+    report (kubebrain-multichip/v1; KB_MULTICHIP_OUT=path writes it —
+    MULTICHIP_rNN.json replaces the bare ``dryrun ok`` tail of r01–r05).
+
+    Bars: on real TPU, near-linear scaling (>= 0.6x ideal at the largest
+    mesh) is asserted; on CPU simulation the devices share the same
+    sockets, so the bar is byte-identity plus no pathological slowdown
+    (largest mesh >= 0.5x of 1-device) with the TPU bar recorded
+    ``pending_tpu``."""
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu" or \
+            os.environ.get("KB_BENCH_PLATFORM") == "cpu":
+        _force_cpu()  # 8 virtual host devices so the mesh sizes exist
+    import jax
+
+    n_keys = int(os.environ.get("KB_BENCH_KEYS", 20_000))
+    n_req = int(os.environ.get("KB_BENCH_OPS", 64))
+    depth = int(os.environ.get("KB_SCHED_DEPTH", 4))
+    batch = int(os.environ.get("KB_SCHED_BATCH", 8))
+    partitions = int(os.environ.get("KB_SCAN_PARTITIONS", 0))
+    n_dev = len(jax.devices())
+    mesh_sizes = [k for k in (1, 2, 4, 8) if k <= n_dev]
+    on_tpu = jax.devices()[0].platform in ("tpu", "axon")
+
+    phase = multichip_phase(
+        mesh_sizes, n_keys=n_keys, n_req=n_req, depth=depth, batch=batch,
+        partitions=partitions)
+    top = str(mesh_sizes[-1])
+    rate = phase["rows_per_sec"][top]
+    base = phase["rows_per_sec"][str(mesh_sizes[0])]
+    scaling = phase["scaling_vs_1dev"][top]
+    if on_tpu:
+        assert scaling >= 0.6 * mesh_sizes[-1], (
+            f"multichip scaling {scaling:.2f}x at {top} devices is not "
+            f"near-linear (bar: >= {0.6 * mesh_sizes[-1]:.1f}x)")
+        acceptance = "pass"
+    else:
+        assert scaling >= 0.5, (
+            f"CPU-sim multichip serving collapsed: {scaling:.2f}x of the "
+            "1-device rate at the largest mesh")
+        acceptance = "pending_tpu"
+
+    report = {
+        "schema": "kubebrain-multichip/v1",
+        "metric": "multichip_rows_per_sec",
+        "platform": platform_info(),
+        "served_through_scheduler": True,
+        "acceptance_near_linear_tpu": acceptance,
+        **phase,
+    }
+    out_path = os.environ.get("KB_MULTICHIP_OUT")
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(report, f, indent=1)
+        print(f"[bench] wrote {out_path}", file=sys.stderr)
+    print(json.dumps({
+        "metric": "multichip_rows_per_sec",
+        "value": rate,
+        "unit": "rows/sec",
+        "vs_baseline": round(rate / base, 3),
+        "platform": platform_info(),
+        "detail": {k: v for k, v in report.items() if k != "platform"},
     }))
 
 
@@ -1286,6 +1494,8 @@ def main() -> None:
         return bench_sched()
     if metric == "cluster":
         return bench_cluster()
+    if metric == "multichip":
+        return bench_multichip()
     if metric == "watcurve":
         return bench_watcurve()
 

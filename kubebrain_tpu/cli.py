@@ -47,6 +47,16 @@ def build_parser() -> argparse.ArgumentParser:
                    help="run range scans through the Pallas/Mosaic kernel "
                         "instead of the fused-jnp kernel (tpu engine only; "
                         "interpret-mode off-TPU; env KB_USE_PALLAS)")
+    p.add_argument("--mesh-part", type=int, default=0,
+                   help="devices on the scan mesh's `part` axis (tpu engine "
+                        "only): the mirror's 20M-row keyspace shards across "
+                        "this many chips so per-chip HBM bounds the dataset; "
+                        "0 = every visible device (docs/multichip.md)")
+    p.add_argument("--scan-partitions", type=int, default=0,
+                   help="mirror partition count, decoupled from the mesh "
+                        "size (must be a multiple of --mesh-part; each "
+                        "device then holds P/N contiguous partitions); "
+                        "0 = one partition per mesh device")
     p.add_argument("--data-dir", default="",
                    help="durable storage dir for the native engine (WAL + "
                         "snapshot); empty = in-memory")
@@ -160,6 +170,16 @@ def validate_args(args) -> None:
             raise SystemExit(f"TLS file not found: {f}")
     if args.storage == "tpu" and args.inner_storage == "tpu":
         raise SystemExit("--inner-storage cannot be tpu")
+    mesh_part = getattr(args, "mesh_part", 0)
+    scan_parts = getattr(args, "scan_partitions", 0)
+    if mesh_part < 0 or scan_parts < 0:
+        raise SystemExit("--mesh-part and --scan-partitions must be >= 0")
+    if (mesh_part or scan_parts) and args.storage != "tpu":
+        raise SystemExit("--mesh-part/--scan-partitions require --storage=tpu")
+    if mesh_part and scan_parts and scan_parts % mesh_part:
+        raise SystemExit(
+            f"--scan-partitions {scan_parts} must be a multiple of "
+            f"--mesh-part {mesh_part}")
     if getattr(args, "sched_depth", 1) < 0 or getattr(args, "sched_queue_limit", 1) < 1:
         raise SystemExit("--sched-depth must be >= 0 (0 = auto) and "
                          "--sched-queue-limit must be >= 1")
@@ -218,7 +238,31 @@ def build_endpoint(args):
             inner_kw = {}
         if args.use_pallas:
             inner_kw["use_pallas"] = True
-        store = new_storage("tpu", inner=args.inner_storage, **inner_kw)
+        # multichip sharded serving (docs/multichip.md): an explicit mesh
+        # flag builds the partition mesh HERE, so the flag errors surface at
+        # boot, not on the first scan; no flags = today's every-device mesh
+        mesh = None
+        mesh_part = getattr(args, "mesh_part", 0)
+        scan_parts = getattr(args, "scan_partitions", 0)
+        if mesh_part or scan_parts:
+            import jax
+
+            from .parallel.mesh import make_mesh
+
+            avail = len(jax.devices())
+            if mesh_part > avail:
+                raise SystemExit(
+                    f"--mesh-part {mesh_part} exceeds the {avail} visible "
+                    f"device(s); set XLA_FLAGS=--xla_force_host_platform_"
+                    f"device_count for CPU simulation")
+            mesh = make_mesh(n_devices=mesh_part or None)
+            n_dev = int(mesh.devices.size)
+            if scan_parts and scan_parts % n_dev:
+                raise SystemExit(
+                    f"--scan-partitions {scan_parts} must be a multiple of "
+                    f"the mesh part-axis size {n_dev}")
+        store = new_storage("tpu", inner=args.inner_storage, mesh=mesh,
+                            partitions=scan_parts, **inner_kw)
     elif args.storage == "native":
         store = new_storage("native", **native_kw)
     elif args.storage == "remote":
@@ -251,6 +295,11 @@ def build_endpoint(args):
     # watch-path lag instrumentation: commit->delivery histogram + per-
     # watcher backlog gauges on /metrics
     backend.watcher_hub.set_metrics(metrics)
+
+    # per-shard HBM accounting (tpu engine): kb_mirror_bytes{device=}
+    # scrape-time gauges off the live mirror (docs/multichip.md)
+    if hasattr(backend.scanner, "register_metrics"):
+        backend.scanner.register_metrics(metrics)
 
     # the device-aware request scheduler, created here (before any service
     # constructs a KVService) so every surface shares the flag-configured

@@ -186,9 +186,16 @@ def build_mirror_from_arrays(
     mesh,
     key_width: int,
     snapshot_ts: int,
+    n_parts: int | None = None,
 ) -> Mirror:
-    """Sorted row arrays → partitioned, padded, device-resident Mirror."""
-    n_parts = int(np.prod(mesh.devices.shape)) if mesh is not None else 1
+    """Sorted row arrays → partitioned, padded, device-resident Mirror.
+
+    ``n_parts`` decouples the partition count from the mesh size
+    (--scan-partitions): P must be a multiple of the mesh's ``part`` axis so
+    ``PartitionSpec("part")`` places P//N contiguous partitions per device.
+    Default: one partition per mesh device."""
+    if n_parts is None:
+        n_parts = int(np.prod(mesh.devices.shape)) if mesh is not None else 1
     n = len(keys_u8)
     if keys_u8.shape[1] != key_width:
         padded = np.zeros((n, key_width), dtype=np.uint8)
@@ -259,37 +266,45 @@ def build_mirror(
     mesh,
     key_width: int,
     snapshot_ts: int,
+    n_parts: int | None = None,
 ) -> Mirror:
     """Python-row convenience path (tests / generic engines)."""
     return build_mirror_from_arrays(
-        *rows_to_arrays(rows, key_width), mesh, key_width, snapshot_ts
+        *rows_to_arrays(rows, key_width), mesh, key_width, snapshot_ts,
+        n_parts=n_parts,
     )
 
 
 def _assemble_sharded(mesh, host_arr: np.ndarray, old_dev, dirty: set[int]):
-    """Rebuild a [P, ...]-sharded device array, re-uploading ONLY the dirty
-    partitions' shards when the layout is one-partition-per-device (the
-    default mesh); clean shards reuse the existing device buffers. Falls back
-    to a full device_put for replicated / multi-axis layouts."""
+    """Rebuild a [P, ...]-sharded device array, re-uploading ONLY the device
+    shards holding dirty partitions when the layout places P//N contiguous
+    partitions per device (any single-axis mesh with P a multiple of the
+    device count — one-per-device is the k=1 case); clean shards reuse the
+    existing device buffers. Falls back to a full device_put for
+    replicated / multi-axis layouts."""
     if mesh is None:
         return jax.device_put(host_arr)
     spec = PartitionSpec("part", *(None,) * (host_arr.ndim - 1))
     sharding = NamedSharding(mesh, spec)
     P = host_arr.shape[0]
     axis_sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
-    one_per_dev = (
+    n_dev = axis_sizes.get("part", 0)
+    blocked = (
         old_dev is not None
         and len(mesh.axis_names) == 1
-        and axis_sizes.get("part") == P
+        and n_dev > 0
+        and P % n_dev == 0
         and tuple(old_dev.shape) == tuple(host_arr.shape)
     )
-    if not one_per_dev:
+    if not blocked:
         return jax.device_put(host_arr, sharding)
+    k = P // n_dev  # contiguous partitions per device shard
     by_dev = {s.device: s.data for s in old_dev.addressable_shards}
     shards = []
-    for p, d in enumerate(mesh.devices.flat):
-        if p in dirty or d not in by_dev:
-            shards.append(jax.device_put(host_arr[p : p + 1], d))
+    for i, d in enumerate(mesh.devices.flat):
+        lo = i * k
+        if d not in by_dev or any(p in dirty for p in range(lo, lo + k)):
+            shards.append(jax.device_put(host_arr[lo : lo + k], d))
         else:
             shards.append(by_dev[d])
     return jax.make_array_from_single_device_arrays(host_arr.shape, sharding, shards)

@@ -121,22 +121,25 @@ def _vis_batch_q(keys, rh, rl, tomb, nv, starts, ends, unbs, qhis, qlos):
     return mask, jnp.sum(mask, axis=2, dtype=jnp.int32)
 
 
-def _maybe_shard_map(f, mesh, n_part_args: int, n_rep_args: int,
-                     out_part_axis: int = 0):
+def _maybe_shard_map(f, mesh, n_part_args: int = 0, n_rep_args: int = 0,
+                     out_part_axis: int = 0, in_specs=None, out_specs=None):
     """shard_map ``f`` along ``part`` when the mesh is multi-device:
     pallas_call has no GSPMD partitioning rule, so without this XLA would
     replicate the whole mirror layout to every device per call. First
     ``n_part_args`` args shard on axis 0; the rest replicate. The output
     shards on ``out_part_axis`` (the query-batched kernels put the query
-    axis ahead of ``part``)."""
+    axis ahead of ``part``). Explicit ``in_specs``/``out_specs`` override
+    the counts for layouts the counts can't express (the index-compaction
+    helpers shard the middle axis)."""
     if mesh is None or mesh.devices.size <= 1:
         return f
     from jax.sharding import PartitionSpec as PS
 
-    specs = dict(
-        in_specs=(PS("part"),) * n_part_args + (PS(),) * n_rep_args,
-        out_specs=PS(*(None,) * out_part_axis, "part"),
-    )
+    if in_specs is None:
+        in_specs = (PS("part"),) * n_part_args + (PS(),) * n_rep_args
+    if out_specs is None:
+        out_specs = PS(*(None,) * out_part_axis, "part")
+    specs = dict(in_specs=in_specs, out_specs=out_specs)
     shard_map = getattr(jax, "shard_map", None)
     if shard_map is None:  # pre-0.8 jax
         from jax.experimental.shard_map import shard_map
@@ -184,21 +187,55 @@ def _vis_batch_pallas_q(keys_t, rh31, rl31, tomb8, nv, starts, ends, unbs,
 def _indices_of_mask(mask, size):
     """Flat indices (p*N + row) of visible rows, device-compacted so the
     host transfer is O(results), not O(rows). ``size`` buckets to a power of
-    two to bound recompiles."""
+    two to bound recompiles. Compaction-path only (`_pull_victim_mask`):
+    the GLOBAL nonzero forces a cross-shard gather on a multi-device mesh,
+    so the serving scan path uses the shard-local `_part_indices_of_mask`
+    instead."""
     flat = mask.reshape(-1)
     (idx,) = jnp.nonzero(flat, size=size, fill_value=flat.shape[0])
     return idx
 
 
-@functools.partial(jax.jit, static_argnames=("size",))
-def _indices_of_mask_sel(mask, sel, size):
-    """Flat (q·P·N + p·N + row) indices of visible rows of the SELECTED
-    queries of a batched mask [Q, P, N] — one device compaction serves
-    every Range query in the batch; Count queries (and pow2 padding
-    copies) are deselected so their rows never cross the wire."""
-    flat = (mask & sel[:, None, None]).reshape(-1)
-    (idx,) = jnp.nonzero(flat, size=size, fill_value=flat.shape[0])
-    return idx
+@functools.partial(jax.jit, static_argnames=("size", "mesh"))
+def _part_indices_of_mask(mask, size, mesh=None):
+    """Per-partition compacted row indices [P, size] (fill = N) of a
+    visibility mask [P, N] — the SHARD-LOCAL index extraction of the
+    serving scan path. Each device compacts only its own partitions'
+    rows (shard_map along ``part``), so a multi-device mesh never
+    all-gathers the [P, N] mask, and the host pull that follows is
+    O(visible rows per shard), not O(dataset). ``size`` = pow2 of the max
+    per-partition count (the caller knows it from the counts transfer)."""
+    def local(m):
+        per_row = lambda row: jnp.nonzero(
+            row, size=size, fill_value=row.shape[0])[0]
+        return jax.vmap(per_row)(m)
+
+    f = _maybe_shard_map(local, mesh, n_part_args=1)
+    return f(mask)
+
+
+@functools.partial(jax.jit, static_argnames=("size", "mesh"))
+def _part_indices_of_mask_sel(mask, sel, size, mesh=None):
+    """Per-(query, partition) compacted row indices [Q, P, size] of a
+    batched mask [Q, P, N], restricted to the SELECTED queries — the
+    shard-local analogue of `_part_indices_of_mask` for the query-batched
+    path. Count queries (and pow2 padding copies) are deselected so their
+    rows never cross the wire; the ``part`` axis (axis 1) stays sharded
+    end to end."""
+    from jax.sharding import PartitionSpec as PS
+
+    def local(m, s):
+        msel = m & s[:, None, None]
+        per_row = lambda row: jnp.nonzero(
+            row, size=size, fill_value=row.shape[0])[0]
+        return jax.vmap(jax.vmap(per_row))(msel)
+
+    f = _maybe_shard_map(
+        local, mesh,
+        in_specs=(PS(None, "part", None), PS()),
+        out_specs=PS(None, "part", None),
+    )
+    return f(mask, sel)
 
 
 def _pow2_bucket(want: int, n_flat: int) -> int:
@@ -208,6 +245,44 @@ def _pow2_bucket(want: int, n_flat: int) -> int:
     while bucket < max(want, 1):
         bucket *= 2
     return min(bucket, n_flat)
+
+
+class TransferMeter:
+    """Device→host byte accounting for the scan path. Every device pull in
+    this module funnels through :func:`_host_pull` (kblint KB111 statically
+    pins device→host transfers to the named materialization points), so
+    ``bytes`` IS the per-process host-transfer cost of serving — the
+    transfer-budget tests assert it scales with visible rows, never with
+    dataset size."""
+
+    __slots__ = ("_lock", "bytes", "pulls")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.bytes = 0
+        self.pulls = 0
+
+    def add(self, nbytes: int) -> None:
+        with self._lock:
+            self.bytes += int(nbytes)
+            self.pulls += 1
+
+    def snapshot(self) -> tuple[int, int]:
+        with self._lock:
+            return self.bytes, self.pulls
+
+
+TRANSFER_METER = TransferMeter()
+
+
+def _host_pull(x) -> np.ndarray:
+    """THE device→host materialization funnel for the scan path (kblint
+    KB111): blocks on the producing kernel and copies to host, with the
+    bytes metered. Pulling a device array anywhere else risks an
+    accidental full-mirror gather sneaking back onto the sharded path."""
+    arr = np.asarray(x)
+    TRANSFER_METER.add(arr.nbytes)
+    return arr
 
 
 @jax.jit
@@ -303,9 +378,19 @@ class TpuScanner(Scanner):
         merge_threshold: int = 4096,
         host_limit_threshold: int = 1024,
         use_pallas: bool | None = None,
+        partitions: int = 0,
     ):
         super().__init__(store, get_compact_revision, retry_min_revision, compact_history, max_workers)
         self._mesh = mesh if mesh is not None else make_mesh()
+        # --scan-partitions: mirror partition count decoupled from the mesh
+        # size (0 = one per device). P must be a multiple of the ``part``
+        # axis so PartitionSpec("part") places P//N partitions per device.
+        n_dev = int(self._mesh.devices.size) if self._mesh is not None else 1
+        if partitions and partitions % n_dev:
+            raise ValueError(
+                f"partitions={partitions} must be a multiple of the mesh "
+                f"part-axis size {n_dev}")
+        self._partitions = int(partitions)
         self._kw = key_width
         self._merge_threshold = merge_threshold
         self._host_limit_threshold = host_limit_threshold
@@ -320,6 +405,35 @@ class TpuScanner(Scanner):
         self._mirror: Mirror | None = None
         self._delta = _DeltaIndex()
         self._force_rebuild = True
+
+    # -------------------------------------------------------------- metrics
+    def register_metrics(self, metrics) -> None:
+        """Per-shard HBM accounting: a ``kb_mirror_bytes{device=}`` callback
+        gauge per mesh device, sampled at scrape time from the live mirror's
+        addressable shards — makes the "per-chip HBM bounds the dataset, not
+        the whole mirror" claim observable on /metrics."""
+        if metrics is None or self._mesh is None:
+            return
+        for d in self._mesh.devices.flat:
+            metrics.register_gauge_fn(
+                "kb.mirror.bytes",
+                functools.partial(self._mirror_device_bytes, str(d)),
+                device=str(d),
+            )
+
+    def _mirror_device_bytes(self, device: str) -> float:
+        """Bytes of mirror columns resident on ``device`` (shard metadata
+        only — sampling never copies device data)."""
+        mirror = self._mirror
+        if mirror is None:
+            return 0.0
+        total = 0
+        for arr in (mirror.keys_dev, mirror.rh_dev, mirror.rl_dev,
+                    mirror.tomb_dev, mirror.ttl_dev, mirror.n_valid_dev):
+            for s in getattr(arr, "addressable_shards", ()):
+                if str(s.device) == device:
+                    total += int(s.data.size) * s.data.dtype.itemsize
+        return float(total)
 
     # ------------------------------------------------------------ write feed
     def record_version_rows(self, rows: list[tuple[bytes, int, bytes]]) -> None:
@@ -366,7 +480,8 @@ class TpuScanner(Scanner):
                 )
         if arrays is not None:
             self._mirror = build_mirror_from_arrays(
-                *arrays, self._mesh, self._kw, snapshot
+                *arrays, self._mesh, self._kw, snapshot,
+                n_parts=self._partitions or None,
             )
         else:
             rows: list[tuple[bytes, int, bytes]] = []
@@ -374,7 +489,8 @@ class TpuScanner(Scanner):
                 ukey, rev = coder.decode(ikey)
                 if rev != 0:
                     rows.append((ukey, rev, value))
-            self._mirror = build_mirror(rows, self._mesh, self._kw, snapshot)
+            self._mirror = build_mirror(rows, self._mesh, self._kw, snapshot,
+                                        n_parts=self._partitions or None)
         self._delta = _DeltaIndex()
         self._force_rebuild = False
         self._pallas_cache = None  # old mirror's device copies must not pin
@@ -395,7 +511,8 @@ class TpuScanner(Scanner):
         )
         if m is None:
             merged = merge_sorted_arrays(self._mirror.flat_arrays(), sorted_delta)
-            m = build_mirror_from_arrays(*merged, self._mesh, self._kw, ts)
+            m = build_mirror_from_arrays(*merged, self._mesh, self._kw, ts,
+                                         n_parts=self._partitions or None)
         self._mirror = m
         self._delta = _DeltaIndex()
         self._pallas_cache = None  # re-layout lazily on the next pallas query
@@ -518,15 +635,27 @@ class TpuScanner(Scanner):
             mesh=self._kernel_mesh,
         )
 
-    def _dev_visible_indices(self, mask, counts, n_flat: int):
-        """(total, flat row indices) from a device mask — the shared
-        two-phase gather: counts first (tiny transfer), then the compacted
-        index list sized to the next power of two so the host never pulls
-        the full row mask."""
-        total = int(np.asarray(counts).sum())
-        bucket = _pow2_bucket(total, n_flat)
-        idx = np.asarray(_indices_of_mask(mask, size=bucket))[:total]
-        return total, idx
+    def _dev_visible_indices(self, mask, counts, n_rows: int):
+        """(total, flat p·N + row indices) from a device mask [P, N] — the
+        shared two-phase gather: per-partition counts first (tiny
+        transfer), then the SHARD-LOCAL compacted index block [P, size]
+        with size = pow2(max per-partition count). The host transfer is
+        bounded by P·pow2(max visible per shard) index words — O(visible
+        rows), never the [P, N] mask — and no cross-device gather happens
+        on a multi-device mesh (`_part_indices_of_mask` keeps the ``part``
+        axis sharded through the compaction)."""
+        counts_h = _host_pull(counts)  # [P]; blocks on the kernel
+        total = int(counts_h.sum())
+        if total == 0:
+            return 0, np.empty(0, dtype=np.int64)
+        size = _pow2_bucket(int(counts_h.max()), n_rows)
+        out = _host_pull(_part_indices_of_mask(mask, size=size,
+                                               mesh=self._mesh))
+        pieces = [
+            out[p, :c].astype(np.int64) + p * n_rows
+            for p, c in enumerate(counts_h) if c
+        ]
+        return total, np.concatenate(pieces)
 
     def _materialize_visible(self, mirror: Mirror, idx: np.ndarray, overlay):
         """Visible rows (flat p·N + row indices) → sorted KeyValue list with
@@ -568,7 +697,7 @@ class TpuScanner(Scanner):
             mask, counts = self._dev_mask(mirror, start, end, read_revision)
         with TRACER.stage("device_compute", device=True):
             total, idx = self._dev_visible_indices(
-                mask, counts, mirror.keys_host.shape[0] * mirror.keys_host.shape[1]
+                mask, counts, mirror.keys_host.shape[1]
             )
         with TRACER.stage("host_copy"):
             kvs = self._materialize_visible(mirror, idx, overlay)
@@ -633,15 +762,31 @@ class TpuScanner(Scanner):
         # both kernels emit [Qpad, P, N] with N == the host row width; the
         # flat-index split below silently corrupts results if that drifts
         assert int(mask.shape[2]) == n_rows, (mask.shape, n_rows)
-        stride = int(mask.shape[1]) * n_rows
+        n_parts = int(mask.shape[1])
+        stride = n_parts * n_rows
         idx = np.empty(0, dtype=np.int64)
         with TRACER.stage("device_compute", device=True):
-            counts_h = np.asarray(counts)  # blocks on the kernel; [Qpad, P]
-            if sel.any():
-                want = int(counts_h[sel].sum())
-                bucket = _pow2_bucket(want, int(mask.shape[0]) * stride)
-                idx = np.asarray(_indices_of_mask_sel(
-                    mask, jnp.asarray(sel), size=bucket))[:want]
+            counts_h = _host_pull(counts)  # blocks on the kernel; [Qpad, P]
+            want = int(counts_h[sel].max()) if sel.any() else 0
+            if want:
+                # shard-local per-(query, partition) compaction: the host
+                # pulls Qpad·P·pow2(max count) index words — O(visible
+                # rows), never the [Q, P, N] mask — and the ``part`` axis
+                # stays sharded through the nonzero on a multi-device mesh
+                size = _pow2_bucket(want, n_rows)
+                idx_parts = _host_pull(_part_indices_of_mask_sel(
+                    mask, jnp.asarray(sel), size=size, mesh=self._mesh))
+                pieces = []
+                for k in np.nonzero(sel)[0]:
+                    base = int(k) * stride
+                    for p in range(n_parts):
+                        c = int(counts_h[k, p])
+                        if c:
+                            pieces.append(
+                                idx_parts[k, p, :c].astype(np.int64)
+                                + base + p * n_rows)
+                if pieces:
+                    idx = np.concatenate(pieces)
         with TRACER.stage("host_copy"):
             for k, (qi, spec) in enumerate(device):
                 if spec[0] == "count":
@@ -668,7 +813,7 @@ class TpuScanner(Scanner):
             overlay = self._delta.overlay(start, end, read_revision)
         mask, counts = self._dev_mask(mirror, start, end, read_revision)
         total, idx = self._dev_visible_indices(
-            mask, counts, mirror.keys_host.shape[0] * mirror.keys_host.shape[1]
+            mask, counts, mirror.keys_host.shape[1]
         )
         n_rows = mirror.keys_host.shape[1]
         extra = sorted(
@@ -726,8 +871,7 @@ class TpuScanner(Scanner):
         with TRACER.stage("device_dispatch", device=True):
             _, counts = self._dev_mask(mirror, start, end, read_revision)
         with TRACER.stage("device_compute", device=True):
-            counts = np.asarray(counts)
-            total = int(counts.sum())
+            total = int(_host_pull(counts).sum())
         return self._overlay_corrected_count(mirror, total, overlay, read_revision)
 
     def _overlay_corrected_count(self, mirror: Mirror, total: int, overlay,
@@ -1039,7 +1183,9 @@ class TpuScanner(Scanner):
                     surv, rows_to_arrays(self._delta.rows(), self._kw)
                 )
                 self._mirror = build_mirror_from_arrays(
-                    *merged, self._mesh, self._kw, self._store.get_timestamp_oracle()
+                    *merged, self._mesh, self._kw,
+                    self._store.get_timestamp_oracle(),
+                    n_parts=self._partitions or None,
                 )
                 self._delta = _DeltaIndex()
                 self._pallas_cache = None
@@ -1055,10 +1201,12 @@ class TpuKvStorage(KvStorage):
     version row for the mirror. Uncertain commits poison the mirror.
     """
 
-    def __init__(self, inner: KvStorage, mesh=None, key_width: int = keyops.KEY_WIDTH, **scanner_kw):
+    def __init__(self, inner: KvStorage, mesh=None, key_width: int = keyops.KEY_WIDTH,
+                 partitions: int = 0, **scanner_kw):
         self._inner = inner
         self._mesh = mesh
         self._kw = key_width
+        self._partitions = partitions
         self._scanner_kw = scanner_kw
         self._scanner: TpuScanner | None = None
         # expose the single-call fast paths only when the host engine has
@@ -1071,7 +1219,8 @@ class TpuKvStorage(KvStorage):
     # ---- scanner wiring (Backend calls make_scanner, storage/__init__.py)
     def make_scanner(self, **kw) -> TpuScanner:
         kw.update(self._scanner_kw)
-        self._scanner = TpuScanner(self, mesh=self._mesh, key_width=self._kw, **kw)
+        self._scanner = TpuScanner(self, mesh=self._mesh, key_width=self._kw,
+                                   partitions=self._partitions, **kw)
         return self._scanner
 
     # ---- engine delegation
@@ -1198,12 +1347,14 @@ class _TrackedBatch(BatchWrite):
 
 
 def _tpu_factory(inner: str = "memkv", mesh=None, key_width: int = keyops.KEY_WIDTH,
-                 use_pallas: bool | None = None, **inner_kw) -> TpuKvStorage:
+                 use_pallas: bool | None = None, partitions: int = 0,
+                 **inner_kw) -> TpuKvStorage:
     from .. import new_storage
 
     scanner_kw = {} if use_pallas is None else {"use_pallas": use_pallas}
     return TpuKvStorage(
-        new_storage(inner, **inner_kw), mesh=mesh, key_width=key_width, **scanner_kw
+        new_storage(inner, **inner_kw), mesh=mesh, key_width=key_width,
+        partitions=partitions, **scanner_kw
     )
 
 

@@ -352,10 +352,37 @@ class WorkloadRunner:
         platform = os.environ.get("KB_WORKLOAD_JAX_PLATFORM", "cpu")
         if platform:
             args += ["--jax-platform", platform]
+        env = None
+        if self.spec.mesh_part or self.spec.scan_partitions:
+            # multichip sharded serving: cluster replay drives a part-
+            # sharded server (docs/multichip.md)
+            if self.spec.mesh_part:
+                args += ["--mesh-part", str(self.spec.mesh_part)]
+            if self.spec.scan_partitions:
+                args += ["--scan-partitions", str(self.spec.scan_partitions)]
+            if self.spec.mesh_part:
+                want_dev = self.spec.mesh_part
+            else:
+                # mesh_part=0 means "every visible device": simulate a
+                # count that DIVIDES scan_partitions, or cli's boot-time
+                # divisibility check rejects a spec that validated fine
+                want_dev = next(
+                    (k for k in (8, 4, 2)
+                     if self.spec.scan_partitions % k == 0), 1)
+            if platform == "cpu":
+                # simulate the mesh devices in the child (the same
+                # mechanism tests/conftest.py uses)
+                env = dict(os.environ)
+                flags = env.get("XLA_FLAGS", "")
+                if "xla_force_host_platform_device_count" not in flags:
+                    env["XLA_FLAGS"] = (
+                        flags + f" --xla_force_host_platform_device_count="
+                                f"{want_dev}").strip()
         stderr = subprocess.DEVNULL
         if self._server_log:
             stderr = open(self._server_log, "ab")  # noqa: SIM115
-        self._server = subprocess.Popen(args, cwd=REPO_ROOT, stderr=stderr)
+        self._server = subprocess.Popen(args, cwd=REPO_ROOT, stderr=stderr,
+                                        env=env)
         self._target = f"127.0.0.1:{client_port}"
 
     def _probe(self, deadline_s: float = 60.0) -> None:
@@ -363,6 +390,14 @@ class WorkloadRunner:
         # binds accrues reconnect backoff (the test_kvrpc boot lesson)
         deadline = time.monotonic() + deadline_s
         while time.monotonic() < deadline:
+            # a boot-time flag rejection (e.g. --mesh-part > visible
+            # devices) exits the child immediately: fail fast with the
+            # exit status instead of probing a dead port for 60s
+            if self._server is not None and self._server.poll() is not None:
+                raise RuntimeError(
+                    f"server at {self._target} exited rc="
+                    f"{self._server.returncode} before serving (rerun with "
+                    f"server_log= to capture its stderr)")
             probe = EtcdCompatClient(self._target)
             try:
                 probe.count(b"/workload-probe", b"/workload-probe0")
@@ -663,6 +698,12 @@ def main(argv=None) -> int:
                     help="simulated seconds per real second")
     ap.add_argument("--storage", default="memkv",
                     choices=["memkv", "native", "tpu"])
+    ap.add_argument("--mesh-part", type=int, default=0,
+                    help="devices on the spawned server's scan-mesh `part` "
+                         "axis (--storage=tpu; docs/multichip.md)")
+    ap.add_argument("--scan-partitions", type=int, default=0,
+                    help="mirror partition count for the spawned server "
+                         "(--storage=tpu; multiple of --mesh-part)")
     ap.add_argument("--target", default="",
                     help="host:port of a running server (default: spawn one)")
     ap.add_argument("--target-info-port", type=int, default=0,
@@ -674,12 +715,15 @@ def main(argv=None) -> int:
                     help="small-N CI smoke shape (short, every traffic kind)")
     args = ap.parse_args(argv)
 
+    mesh_kw = {"mesh_part": args.mesh_part,
+               "scan_partitions": args.scan_partitions}
     if args.smoke:
-        spec = WorkloadSpec.for_smoke(args.nodes, seed=args.seed)
+        spec = WorkloadSpec.for_smoke(args.nodes, seed=args.seed,
+                                      storage=args.storage, **mesh_kw)
     else:
         spec = WorkloadSpec.for_cluster(
             args.nodes, seed=args.seed, duration_s=args.duration,
-            time_scale=args.scale, storage=args.storage)
+            time_scale=args.scale, storage=args.storage, **mesh_kw)
     report = run_workload(spec, target=args.target or None,
                           info_port=args.target_info_port,
                           out_path=args.out or None)

@@ -73,6 +73,13 @@ class WorkloadSpec:
 
     # replay-engine knobs (runtime only; do not affect the generated trace)
     storage: str = "memkv"
+    #: multichip sharded serving (docs/multichip.md): devices on the scan
+    #: mesh's `part` axis / mirror partition count, forwarded to the spawned
+    #: server as --mesh-part/--scan-partitions. 0 = server defaults. Only
+    #: meaningful with storage="tpu"; on CPU the runner simulates the
+    #: devices via xla_force_host_platform_device_count.
+    mesh_part: int = 0
+    scan_partitions: int = 0
     write_shards: int = 8
     range_shards: int = 8
     watch_streams: int = 4
@@ -99,6 +106,19 @@ class WorkloadSpec:
         if min(self.write_shards, self.range_shards,
                self.watch_streams, self.lease_streams) < 1:
             raise ValueError("shard/stream counts must be >= 1")
+        if self.mesh_part < 0 or self.scan_partitions < 0:
+            raise ValueError("mesh_part/scan_partitions must be >= 0")
+        if (self.mesh_part or self.scan_partitions) and self.storage != "tpu":
+            raise ValueError(
+                "mesh_part/scan_partitions require storage='tpu' (the mesh "
+                "shards the TPU engine's scan mirror)")
+        if self.mesh_part and self.scan_partitions \
+                and self.scan_partitions % self.mesh_part:
+            # mirror cli.validate_args: fail here with a ValueError instead
+            # of spawning a server that boot-rejects the same combination
+            raise ValueError(
+                f"scan_partitions={self.scan_partitions} must be a multiple "
+                f"of mesh_part={self.mesh_part}")
 
     # ------------------------------------------------------------ factories
     @classmethod

@@ -504,6 +504,67 @@ def test_kb110_scoped_and_suppressible():
     assert ids(sup, WORKLOAD) == []
 
 
+# ------------------------------------------------------------------- KB111
+TPU = "kubebrain_tpu/storage/tpu/x.py"
+
+
+def test_kb111_flags_device_get_outside_named_points():
+    src = "import jax\ndef leak(mask):\n    return jax.device_get(mask)\n"
+    assert ids(src, TPU) == ["KB111"]
+
+
+def test_kb111_flags_asarray_of_dev_column():
+    src = ("import numpy as np\n"
+           "def leak(mirror):\n"
+           "    return np.asarray(mirror.keys_dev)\n")
+    assert ids(src, TPU) == ["KB111"]
+
+
+def test_kb111_flags_asarray_of_kernel_result():
+    src = ("import numpy as np\n"
+           "def leak(m, nv):\n"
+           "    return np.asarray(_victim_counts(m, nv))\n")
+    assert ids(src, TPU) == ["KB111"]
+    # a scan-kernel reference outside the assembly points trips BOTH
+    # disciplines: KB109 (stray dispatch) and KB111 (unmetered transfer)
+    src1b = ("import numpy as np\n"
+             "def leak(m, c):\n"
+             "    return np.asarray(_vis_batch(m, c))\n")
+    assert ids(src1b, TPU) == ["KB109", "KB111"]
+    src2 = ("import numpy as np\n"
+            "def leak(mask):\n"
+            "    return np.array(_part_indices_of_mask(mask, size=8))\n")
+    assert ids(src2, TPU) == ["KB111"]
+
+
+def test_kb111_allows_named_materialization_points():
+    src = ("import jax\nimport numpy as np\n"
+           "def _host_pull(x):\n"
+           "    return np.asarray(x)\n"
+           "def _pallas_ttl8(self, mirror, npad):\n"
+           "    return jax.device_get(mirror.ttl_dev)\n"
+           "def _pull_victim_mask(self, mask_dev, mirror):\n"
+           "    return np.asarray(_survivor_indices(mask_dev, 1, size=4))\n")
+    assert ids(src, TPU) == []
+
+
+def test_kb111_ignores_host_array_conversions():
+    # np.asarray on host-side mirror columns is a no-op, not a transfer
+    src = ("import numpy as np\n"
+           "def f(mirror):\n"
+           "    return np.asarray(mirror.revs_host, dtype=np.uint64)\n")
+    assert ids(src, TPU) == []
+
+
+def test_kb111_scoped_to_storage_tpu_and_suppressible():
+    src = "import jax\ndef f(x):\n    return jax.device_get(x)\n"
+    assert ids(src, ANY) == []
+    sup = ("import jax\n"
+           "def f(x):\n"
+           "    return jax.device_get(x)  # kblint: disable=KB111\n")
+    assert ids(sup, TPU) == []
+
+
 def test_kb106_covers_batched_entry_points():
     src = "def f(backend, qs):\n    return backend.list_batch(qs)\n"
     assert ids(src, SRV_ETCD) == ["KB106"]
@@ -514,7 +575,7 @@ def test_kb106_covers_batched_entry_points():
 # ------------------------------------------------------------ registry/CLI
 def test_registry_has_all_rules():
     assert set(RULES) == {"KB101", "KB102", "KB103", "KB104", "KB105", "KB106",
-                          "KB107", "KB108", "KB109", "KB110"}
+                          "KB107", "KB108", "KB109", "KB110", "KB111"}
     for rule in RULES.values():
         assert rule.summary
 

@@ -17,37 +17,46 @@
 #                         op trace), SLO report schema, and a small-N
 #                         end-to-end replay through the real gRPC front
 #                         with client/server /metrics reconciliation
-#   7. tier-1 pytest    — the ROADMAP.md verify command
+#   7. multichip        — sharded serving on 8 simulated host devices
+#                         (conftest's xla_force_host_platform_device_count):
+#                         sharded-vs-single byte identity, O(visible-rows)
+#                         host transfer, dirty-shard-only republish, and
+#                         the served dry-run emitting multichip_rows_per_sec
+#   8. tier-1 pytest    — the ROADMAP.md verify command
 # Run from anywhere; operates on the repo this script lives in.
 
 set -uo pipefail
 
 cd "$(dirname "$0")/.."
 
-echo "=== [1/7] make lint"
+echo "=== [1/8] make lint"
 make lint || exit 1
 
-echo "=== [2/7] make typecheck"
+echo "=== [2/8] make typecheck"
 make typecheck || exit 1
 
-echo "=== [3/7] scheduler semantics + query-batched scan + bench-smoke (CPU fallback)"
+echo "=== [3/8] scheduler semantics + query-batched scan + bench-smoke (CPU fallback)"
 env JAX_PLATFORMS=cpu python -m pytest tests/test_sched.py \
     tests/test_sched_batch.py tests/test_scan_pallas.py -q -m 'not slow' \
     -p no:cacheprovider || exit 1
 make bench-smoke || exit 1
 
-echo "=== [4/7] request tracing: span tests + live-server /debug/traces smoke"
+echo "=== [4/8] request tracing: span tests + live-server /debug/traces smoke"
 env JAX_PLATFORMS=cpu python -m pytest tests/test_trace.py -q -m 'not slow' \
     -p no:cacheprovider || exit 1
 env JAX_PLATFORMS=cpu python tools/smoke_trace.py || exit 1
 
-echo "=== [5/7] lease subsystem: TTL state machine + revision-stamped expiry"
+echo "=== [5/8] lease subsystem: TTL state machine + revision-stamped expiry"
 env JAX_PLATFORMS=cpu python -m pytest tests/test_lease.py -q -m 'not slow' \
     -p no:cacheprovider || exit 1
 
-echo "=== [6/7] workload replay: determinism + SLO schema + small-N gRPC smoke"
+echo "=== [6/8] workload replay: determinism + SLO schema + small-N gRPC smoke"
 env JAX_PLATFORMS=cpu python -m pytest tests/test_workload.py -q -m 'not slow' \
     -p no:cacheprovider || exit 1
 
-echo "=== [7/7] tier-1 tests (ROADMAP.md verify, one definition: make test-tier1)"
+echo "=== [7/8] multichip sharded serving: identity + transfer budget + served dry-run"
+env JAX_PLATFORMS=cpu python -m pytest tests/test_multichip.py \
+    tests/test_graft_entry.py -q -m 'not slow' -p no:cacheprovider || exit 1
+
+echo "=== [8/8] tier-1 tests (ROADMAP.md verify, one definition: make test-tier1)"
 exec make test-tier1

@@ -494,6 +494,103 @@ class ReplayableWorkloadRandomness(Rule):
                 )
 
 
+#: device-array producers on the TPU engine's scan/compact path: a host
+#: conversion of anything these return (or of a ``*_dev`` mirror column) is
+#: a device→host transfer, and outside the named materialization points it
+#: is exactly the accidental full-mirror gather that killed the multichip
+#: dry run on real traffic
+_DEVICE_PRODUCER_NAMES = {
+    "_vis_batch", "_vis_batch_q", "_vis_batch_pallas", "_vis_batch_pallas_q",
+    "_indices_of_mask", "_part_indices_of_mask", "_part_indices_of_mask_sel",
+    "_survivor_indices", "_victim_counts", "_victim_batch",
+    "_victim_batch_pallas", "_dev_mask", "_dev_mask_batch",
+}
+#: numpy host-conversion entry points (device arrays convert implicitly)
+_HOST_CONVERTERS = {
+    "np.asarray", "numpy.asarray", "np.array", "numpy.array",
+    "np.copy", "numpy.copy",
+}
+#: the named materialization points allowed to pull device data to host in
+#: storage/tpu/ — everything else must go through `_host_pull` (which both
+#: blocks correctly and meters the bytes for the transfer-budget tests)
+_HOST_TRANSFER_ALLOWED = {
+    "_host_pull", "_materialize_visible", "_host_visible",
+    "_host_visible_batch", "_pallas_ttl8", "_pull_victim_mask",
+    "merge_partitions_incremental",
+}
+
+
+def _deviceish_expr(expr: ast.expr) -> str | None:
+    """The name making ``expr`` a device-array expression, if any: a
+    ``*_dev`` mirror column reference or a call to a device producer."""
+    for node in ast.walk(expr):
+        if isinstance(node, (ast.Name, ast.Attribute)):
+            t = terminal_name(node)
+            if t.endswith("_dev"):
+                return dotted_name(node) or t
+        if isinstance(node, ast.Call):
+            t = terminal_name(node.func)
+            if t in _DEVICE_PRODUCER_NAMES:
+                return t
+    return None
+
+
+@register
+class HostTransferOnlyAtMaterializationPoints(Rule):
+    """In ``storage/tpu/`` every device→host pull must happen at a named
+    materialization point (`_host_pull` and friends): a stray
+    ``np.asarray(mirror.keys_dev)`` or ``jax.device_get(mask)`` silently
+    re-introduces the full-mirror gather the shard-local scan path exists
+    to prevent — O(dataset) bytes over the device link per scan instead of
+    O(visible rows) — and dodges the transfer meter the budget tests
+    audit."""
+
+    rule_id = "KB111"
+    summary = ("storage/tpu/: jax.device_get / host conversion of device "
+               "arrays only inside the named materialization points "
+               "(_host_pull, _materialize_visible, _host_visible*, "
+               "_pallas_ttl8, _pull_victim_mask)")
+
+    def applies(self, relpath: str) -> bool:
+        return relpath.replace("\\", "/").startswith("kubebrain_tpu/storage/tpu/")
+
+    def check(self, tree: ast.Module, src: str) -> Iterable[tuple[ast.AST, str]]:
+        def scan(body: list[ast.stmt], func_name: str | None):
+            allowed = func_name in _HOST_TRANSFER_ALLOWED
+            for node in walk_same_scope(body):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    yield from scan(node.body, node.name)
+                    continue
+                if isinstance(node, ast.ClassDef):
+                    yield from scan(node.body, None)
+                    continue
+                if isinstance(node, ast.Lambda):
+                    yield from scan([ast.Expr(value=node.body)], func_name)
+                    continue
+                if allowed or not isinstance(node, ast.Call):
+                    continue
+                name = dotted_name(node.func)
+                where = f" (in {func_name!r})" if func_name else ""
+                if name in ("jax.device_get", "device_get"):
+                    yield node, (
+                        f"device→host transfer {name}(){where}: only the "
+                        "named materialization points may pull device data "
+                        "(use _host_pull)"
+                    )
+                elif name in _HOST_CONVERTERS:
+                    dev = next(
+                        (d for a in (*node.args, *(kw.value for kw in node.keywords))
+                         if (d := _deviceish_expr(a))), None)
+                    if dev:
+                        yield node, (
+                            f"implicit device→host transfer {name}({dev}...)"
+                            f"{where}: only the named materialization points "
+                            "may pull device data (use _host_pull)"
+                        )
+
+        yield from scan(tree.body, None)
+
+
 _REV_TOKENS = {"rev", "revision"}
 
 
