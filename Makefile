@@ -8,10 +8,14 @@ SHELL := /bin/bash  # test-tier1 needs pipefail
 
 all: native
 
-# Static analysis: the kblint project-invariant rules (tools/kblint, see
-# docs/static_analysis.md) over all Python, plus the native lint pass.
+# Static analysis: the kblint syntactic rules (KB101-KB111) over all
+# Python PLUS the interprocedural tier (--deep: call graph over
+# kubebrain_tpu/ + tools/ + bench.py, rules KB112-KB115, baseline.json),
+# then the native lint pass. The deep run is held to a 60s wall-clock
+# budget (exceeded = failure) and is incremental via .kblint_cache/
+# (content-hash keyed; KBLINT_CACHE=0 disables). docs/static_analysis.md.
 lint:
-	python -m tools.kblint kubebrain_tpu tools tests
+	python -m tools.kblint kubebrain_tpu tools tests --deep --budget 60
 	$(MAKE) -C native lint
 
 # mypy over the typed core when installed; compileall fallback otherwise

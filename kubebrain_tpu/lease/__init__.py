@@ -17,6 +17,7 @@ See docs/leases.md for the state machine, reaper design, and metrics.
 from __future__ import annotations
 
 import threading
+from typing import Any
 
 from .reaper import DEFAULT_CHECKPOINT_INTERVAL, DEFAULT_REAP_INTERVAL, LeaseReaper
 from .registry import Lease, LeaseExistsError, LeaseNotFoundError, LeaseRegistry
@@ -35,7 +36,7 @@ __all__ = [
 _ENSURE_LOCK = threading.Lock()
 
 
-def ensure_lease(backend, peers=None, metrics=None,
+def ensure_lease(backend: Any, peers: Any = None, metrics: Any = None,
                  reap_interval: float = DEFAULT_REAP_INTERVAL,
                  checkpoint_interval: float = DEFAULT_CHECKPOINT_INTERVAL,
                  ) -> LeaseRegistry:

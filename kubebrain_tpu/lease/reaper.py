@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import logging
 import threading
+from typing import Any
 
 from ..backend.errors import FutureRevisionError
 from ..storage.errors import KeyNotFoundError
@@ -34,9 +35,11 @@ DEFAULT_CHECKPOINT_INTERVAL = 5.0
 
 
 class LeaseReaper:
-    def __init__(self, backend, registry: LeaseRegistry, peers=None,
+    def __init__(self, backend: Any, registry: LeaseRegistry,
+                 peers: Any = None,
                  reap_interval: float = DEFAULT_REAP_INTERVAL,
-                 checkpoint_interval: float = DEFAULT_CHECKPOINT_INTERVAL):
+                 checkpoint_interval: float = DEFAULT_CHECKPOINT_INTERVAL,
+                 ) -> None:
         self.backend = backend
         self.registry = registry
         self.peers = peers

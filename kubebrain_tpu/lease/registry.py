@@ -48,7 +48,7 @@ _INT64_MAX = (1 << 63) - 1
 class LeaseNotFoundError(Exception):
     """etcd ErrLeaseNotFound: the lease does not exist (or has expired)."""
 
-    def __init__(self, lease_id: int):
+    def __init__(self, lease_id: int) -> None:
         super().__init__(f"lease {lease_id} not found")
         self.lease_id = lease_id
 
@@ -56,7 +56,7 @@ class LeaseNotFoundError(Exception):
 class LeaseExistsError(Exception):
     """etcd ErrLeaseExist: grant with an explicit id that is already live."""
 
-    def __init__(self, lease_id: int):
+    def __init__(self, lease_id: int) -> None:
         super().__init__(f"lease {lease_id} already exists")
         self.lease_id = lease_id
 

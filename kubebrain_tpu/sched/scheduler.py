@@ -50,6 +50,7 @@ import threading
 import time
 from collections import deque
 from dataclasses import dataclass, field
+from typing import Any, Callable
 
 from ..trace import TRACER
 from .lanes import Lane, classify
@@ -69,7 +70,7 @@ AUTO_DEPTH_DEFAULT = 4
 class SchedOverloadError(Exception):
     """Request shed by admission control (queue full or deadline passed)."""
 
-    def __init__(self, lane: Lane, reason: str):
+    def __init__(self, lane: Lane, reason: str) -> None:
         super().__init__(f"{ERR_TOO_MANY_REQUESTS} (lane={lane.name.lower()}, {reason})")
         self.lane = lane
         self.reason = reason
@@ -79,7 +80,7 @@ class SchedClosedError(Exception):
     """Scheduler shut down while the request was queued."""
 
 
-def client_of(context) -> str:
+def client_of(context: Any) -> str:
     """Fair-queuing flow id for a gRPC(-ish) context: the transport peer
     when the context has one (python-grpc), else anonymous (native-front
     backhaul contexts have no peer()). Shared by every service surface so
@@ -109,8 +110,9 @@ class _Request:
                  "finished_at", "bargs", "bexec", "batch_members",
                  "joined_batch")
 
-    def __init__(self, fn, lane: Lane, client: str, key, deterministic=False,
-                 bargs=None, bexec=None):
+    def __init__(self, fn: Callable[[], Any], lane: Lane, client: str,
+                 key: Any, deterministic: bool = False,
+                 bargs: Any = None, bexec: Any = None) -> None:
         self.fn = fn
         self.lane = lane
         self.client = client
@@ -134,7 +136,8 @@ class _Request:
         self.joined_batch = False  # rode another leader's batched dispatch
 
     # ---- completion (leader result fans out to coalesced followers)
-    def finish(self, result=None, error: BaseException | None = None) -> None:
+    def finish(self, result: Any = None,
+               error: BaseException | None = None) -> None:
         self.result = result
         self.error = error
         self.finished_at = time.monotonic()
@@ -194,7 +197,7 @@ class _LaneQueue:
             return req
         return None
 
-    def pop_matching(self, pred) -> _Request | None:
+    def pop_matching(self, pred: Callable[[_Request], bool]) -> _Request | None:
         """Pop the first request satisfying ``pred``, scanning clients in
         service order but inspecting only each client's queue HEAD — a
         client's own FIFO order is never reordered, and non-matching
@@ -221,8 +224,9 @@ class RequestScheduler:
     only, e.g. the bench microharness).
     """
 
-    def __init__(self, backend=None, config: SchedConfig | None = None,
-                 metrics=None):
+    def __init__(self, backend: Any = None,
+                 config: SchedConfig | None = None,
+                 metrics: Any = None) -> None:
         self.backend = backend
         self.config = config or SchedConfig()
         self.metrics = metrics
@@ -361,9 +365,10 @@ class RequestScheduler:
             r.finish(error=SchedClosedError("scheduler closed"))
 
     # -------------------------------------------------------------- enqueue
-    def submit_async(self, fn, lane: Lane = Lane.NORMAL, client: str = "",
-                     key=None, deterministic: bool = False, bargs=None,
-                     bexec=None) -> _Request:
+    def submit_async(self, fn: Callable[[], Any],
+                     lane: Lane = Lane.NORMAL, client: str = "",
+                     key: Any = None, deterministic: bool = False,
+                     bargs: Any = None, bexec: Any = None) -> _Request:
         """Enqueue ``fn`` and return the waitable request (``.wait(t)``).
         Raises SchedOverloadError immediately when the lane queue is full.
         ``deterministic`` marks a request whose result is a pure function
@@ -409,8 +414,10 @@ class RequestScheduler:
             self._cv.notify()
         return req
 
-    def submit(self, fn, lane: Lane = Lane.NORMAL, client: str = "", key=None,
-               deterministic: bool = False, bargs=None, bexec=None):
+    def submit(self, fn: Callable[[], Any], lane: Lane = Lane.NORMAL,
+               client: str = "", key: Any = None,
+               deterministic: bool = False, bargs: Any = None,
+               bexec: Any = None) -> Any:
         """Blocking submit: schedule ``fn`` and return its result."""
         req = self.submit_async(fn, lane, client, key, deterministic,
                                 bargs=bargs, bexec=bexec)
@@ -439,7 +446,7 @@ class RequestScheduler:
     # ----------------------------------------------- backend range entries
     # (the only scan path the service layer may use; kblint KB106)
     def list_(self, start: bytes, end: bytes, revision: int = 0,
-              limit: int = 0, client: str = ""):
+              limit: int = 0, client: str = "") -> Any:
         lane = classify(start, end, limit)
         key = ("list", start, end, revision, limit)
         return self.submit(
@@ -449,7 +456,7 @@ class RequestScheduler:
         )
 
     def count(self, start: bytes, end: bytes, revision: int = 0,
-              client: str = ""):
+              client: str = "") -> Any:
         lane = classify(start, end, count_only=True)
         key = ("count", start, end, revision)
         return self.submit(
@@ -459,7 +466,7 @@ class RequestScheduler:
         )
 
     def list_wire(self, start: bytes, end: bytes, revision: int = 0,
-                  limit: int = 0, client: str = ""):
+                  limit: int = 0, client: str = "") -> Any:
         if getattr(self.backend.scanner, "list_wire", None) is None:
             return None  # engine has no wire encoder; skip the queue round
         lane = classify(start, end, limit)
@@ -470,7 +477,7 @@ class RequestScheduler:
         )
 
     def list_by_stream(self, start: bytes, end: bytes, revision: int = 0,
-                       client: str = ""):
+                       client: str = "") -> Any:
         """Admission + initial dispatch for a streamed list. The returned
         iterator is consumed on the caller's thread (a stream can outlive
         any sane queue deadline); coalescing is disabled — iterators are
@@ -644,7 +651,7 @@ class RequestScheduler:
                 TRACER.record_stage("batch_join", t_exec, t_done, span=r.span)
 
     # -------------------------------------------------------------- metrics
-    def _emit_counter(self, name: str, lane: Lane, **tags) -> None:
+    def _emit_counter(self, name: str, lane: Lane, **tags: Any) -> None:
         if self.metrics is not None:
             self.metrics.emit_counter(name, 1, lane=lane.name.lower(), **tags)
 
@@ -652,8 +659,8 @@ class RequestScheduler:
 _ENSURE_LOCK = threading.Lock()
 
 
-def ensure_scheduler(backend, config: SchedConfig | None = None,
-                     metrics=None) -> RequestScheduler:
+def ensure_scheduler(backend: Any, config: SchedConfig | None = None,
+                     metrics: Any = None) -> RequestScheduler:
     """The process-wide scheduler for ``backend``: every service surface
     (sync etcd, aio, native front, brain) must share one admission queue or
     lanes mean nothing. First caller wins; cli.build_endpoint calls this

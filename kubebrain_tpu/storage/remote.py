@@ -650,10 +650,13 @@ class RemoteKvStorage(KvStorage):
                     # standalone-acked clock ran ahead.
                     with self._rr_lock:
                         adoptable = (cand_epoch, cand_ts) >= self._max_seen
-                        if adoptable:
-                            self._cur_epoch = cand_epoch
                     if adoptable:
-                        self._repoint(idx, addr)
+                        # _repoint updates _cur_epoch inside its locked
+                        # swap; setting it here-and-early would tag acks
+                        # from the OLD primary with the new epoch if the
+                        # repoint fails or is refused
+                        self._repoint(idx, addr,
+                                      lineage=(cand_epoch, cand_ts))
                         return idx
                     last_exc = StorageError(
                         f"{addr} is a primary of a stale lineage "
@@ -664,11 +667,22 @@ class RemoteKvStorage(KvStorage):
             except (OSError, EOFError, StorageError) as exc:
                 last_exc = exc
                 continue
-            self._repoint(idx, addr)
+            # learn the bumped epoch BEFORE repointing so the swap carries
+            # the promoted member's lineage — without it a concurrent
+            # adoption of an even newer leader during the (seconds-wide)
+            # connect window could be silently overwritten with this one
+            lineage = None
             try:
-                self.member_info(idx)  # learn the bumped epoch
+                _, new_ts, _, _, new_epoch = self.member_info(idx)
+                lineage = (new_epoch, new_ts)
             except Exception:
-                pass
+                pass  # degrade to an unvalidated swap rather than fail over
+            self._repoint(idx, addr, lineage=lineage)
+            if lineage is None:
+                try:
+                    self.member_info(idx)  # learn the bumped epoch
+                except Exception:
+                    pass
             return idx
         raise StorageError(f"no promotable follower reachable: {last_exc}")
 
@@ -699,27 +713,68 @@ class RemoteKvStorage(KvStorage):
                 stale = self._max_seen
             else:
                 stale = None
-                self._cur_epoch = epoch
+                if idx == self._primary:
+                    # already pointed there: just refresh the snapshot.
+                    # The repoint case defers to _repoint's locked swap so
+                    # a refused/failed swap can't leave _cur_epoch
+                    # claiming a leader that was never adopted.
+                    self._cur_epoch = epoch
         if stale is not None:
             raise StorageError(
                 f"best reachable leader {addr} has lineage ({epoch}, {ts}) "
                 f"< observed {stale}; refusing to adopt")
         if idx != self._primary:
-            self._repoint(idx, addr)
+            self._repoint(idx, addr, lineage=(epoch, ts))
         return idx
 
-    def _repoint(self, idx: int, addr: tuple[str, int]) -> None:
+    def _repoint(self, idx: int, addr: tuple[str, int],
+                 lineage: tuple[int, int] | None = None) -> None:
         """Swing the pool to a new primary; old conns surface as
-        UncertainResultError to in-flight callers and repair as usual."""
+        UncertainResultError to in-flight callers and repair as usual.
+
+        ``lineage`` is the (epoch, ts) the caller's adoption decision was
+        based on; it is RE-VALIDATED against ``_max_seen`` inside the swap
+        lock, because between the caller's guard and this swap another
+        thread can adopt a newer leader (and the connect loop below makes
+        that window seconds wide) — losing that race must abandon the
+        fresh pool, not overwrite the newer adoption with a stale one."""
+        # Connect the replacement pool BEFORE taking _rr_lock: a TCP
+        # connect can block for seconds on an unreachable host, and doing
+        # it under the lock convoys every reader thread through failover
+        # (kblint KB112). It also means a failed connect leaves the OLD
+        # primary/pool intact instead of a repointed primary with stale
+        # connections.
+        fresh: list[_PooledConn] = []
+        try:
+            for _ in range(len(self._pool)):
+                fresh.append(_PooledConn(addr, self._timeout))
+        except OSError:
+            for c in fresh:
+                c.close()
+            raise
         with self._rr_lock:
-            self._primary = idx
-            self._address = addr
-            old, self._pool = self._pool, [
-                _PooledConn(addr, self._timeout) for _ in range(len(self._pool))
-            ]
-            old_f, self._fpools = self._fpools, {}
-            self._frole.clear()
-            self._fdown.clear()
+            if lineage is not None and lineage < self._max_seen:
+                stale = self._max_seen
+            else:
+                stale = None
+                self._primary = idx
+                self._address = addr
+                if lineage is not None:
+                    # the epoch snapshot must advance WITH the adoption —
+                    # updating it before the swap (or not at all) leaves
+                    # acks tagged with the wrong lineage when the swap
+                    # fails or when another thread raced us here
+                    self._cur_epoch = lineage[0]
+                old, self._pool = self._pool, fresh
+                old_f, self._fpools = self._fpools, {}
+                self._frole.clear()
+                self._fdown.clear()
+        if stale is not None:
+            for c in fresh:
+                c.close()
+            raise StorageError(
+                f"leader {addr} lineage {lineage} fell behind observed "
+                f"{stale} while repointing; refusing to adopt")
         for c in old:
             c.close()
         for conns in old_f.values():

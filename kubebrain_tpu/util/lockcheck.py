@@ -46,6 +46,8 @@ __all__ = [
     "reset",
     "take_violations",
     "violations",
+    "edges",
+    "export_edges",
     "Violation",
     "LockOrderError",
 ]
@@ -324,3 +326,26 @@ def take_violations() -> list[Violation]:
         out = list(_violations)
         _violations.clear()
     return out
+
+
+def edges() -> list[tuple[str, str]]:
+    """Snapshot of the observed lock-order graph: (A, B) = "B was acquired
+    while A was held", keyed by construction site ('pkg/file.py:NN')."""
+    with _state_lock:
+        return sorted(_edges.keys())
+
+
+def export_edges(path: str) -> int:
+    """Write the observed edges as JSON for the static linter's KB115
+    cross-check (``python -m tools.kblint --deep --lock-edges <path>``):
+    static edges never observed at runtime ARE the runtime detector's
+    coverage gap, and this file is how that gap becomes a number. Returns
+    the number of edges written. Set ``KB_LOCKCHECK_EDGES=<path>`` to have
+    the pytest conftest export automatically at session end."""
+    import json
+    snap = edges()
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump({"format": "kblint-lock-edges/v1",
+                   "edges": [list(e) for e in snap]}, f, indent=1)
+        f.write("\n")
+    return len(snap)

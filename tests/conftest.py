@@ -77,6 +77,21 @@ def _lockcheck_guard():
         )
 
 
+def pytest_sessionfinish(session, exitstatus):
+    # KB_LOCKCHECK_EDGES=<path>: dump the session's observed lock-order
+    # graph for the static linter's KB115 cross-check (the runtime
+    # detector's coverage gap becomes measurable:
+    # python -m tools.kblint --deep --lock-edges <path> --lock-graph).
+    edges_path = os.environ.get("KB_LOCKCHECK_EDGES")
+    if _LOCKCHECK and edges_path:
+        try:
+            n = _lockcheck.export_edges(edges_path)
+            sys.stderr.write(
+                f"[lockcheck] exported {n} lock-order edges to {edges_path}\n")
+        except OSError as e:
+            sys.stderr.write(f"[lockcheck] edge export failed: {e}\n")
+
+
 _DEADLINE_DEFAULT = 240.0
 
 

@@ -1,0 +1,690 @@
+"""kblint v2 (interprocedural tier) self-tests: KB112–KB115 on fixture
+programs, the baseline workflow, the content-hash cache, and the
+differential guarantee that the deep driver reports a superset of the v1
+syntactic findings on the existing rule fixtures.
+
+The fixtures are dict-of-sources programs (relpath -> code) fed through
+``deep_analyze_sources``, so each test states its whole program inline.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from tools.kblint import rules  # noqa: F401  -- registers the rules
+from tools.kblint.cache import LintCache
+from tools.kblint.core import (Baseline, Finding, deep_analyze_paths,
+                               deep_analyze_sources, lint_paths, lint_source,
+                               normalize_message)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = "kubebrain_tpu/x.py"
+TPU = "kubebrain_tpu/storage/tpu/x.py"
+
+
+def deep_ids(sources, **kw):
+    res = deep_analyze_sources(sources, **kw)
+    return [f.rule_id for f in res.findings]
+
+
+# ------------------------------------------------------------------- KB112
+def test_kb112_two_hop_blocking_under_lock():
+    # lock held -> helper -> helper -> time.sleep: exactly the indirection
+    # that launders lexical KB102 invisibly
+    src = (
+        "import time\n"
+        "import threading\n"
+        "class S:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "    def serve(self):\n"
+        "        with self._lock:\n"
+        "            self._refresh()\n"
+        "    def _refresh(self):\n"
+        "        self._backoff()\n"
+        "    def _backoff(self):\n"
+        "        time.sleep(0.5)\n"
+    )
+    res = deep_analyze_sources({PKG: src})
+    assert [f.rule_id for f in res.findings] == ["KB112"]
+    (f,) = res.findings
+    # the witness names the whole chain and the blocking terminal
+    assert "S.serve" in f.message and "S._refresh" in f.message
+    assert "S._backoff" in f.message and "time.sleep" in f.message
+    assert f.line == 8  # reported at the call site under the lock
+
+
+def test_kb112_direct_blocking_stays_kb102():
+    # one-hop lexical blocking is the syntactic tier's finding; the deep
+    # tier owns only the transitive shape (the differential test below
+    # asserts the union covers both)
+    src = (
+        "import time\nimport threading\n"
+        "_mod_lock = threading.Lock()\n"
+        "def f():\n"
+        "    with _mod_lock:\n"
+        "        time.sleep(1)\n"
+    )
+    assert deep_ids({PKG: src}) == []
+    assert [f.rule_id for f in lint_source(src, PKG)] == ["KB102"]
+
+
+def test_kb112_executor_ref_not_flagged():
+    # passing a blocking function AS A REFERENCE under a lock defers its
+    # execution to another context — must not flag
+    src = (
+        "import time\nimport threading\n"
+        "class S:\n"
+        "    def __init__(self, pool):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self._pool = pool\n"
+        "    def _slow(self):\n"
+        "        time.sleep(1)\n"
+        "    def kick(self):\n"
+        "        with self._lock:\n"
+        "            self._pool.submit(self._slow)\n"
+    )
+    assert deep_ids({PKG: src}) == []
+
+
+def test_kb112_cross_module_chain():
+    helper = (
+        "import urllib.request\n"
+        "def fetch(url):\n"
+        "    return urllib.request.urlopen(url)\n"
+    )
+    caller = (
+        "import threading\n"
+        "from kubebrain_tpu.helper import fetch\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "    def poll(self):\n"
+        "        with self._lock:\n"
+        "            return fetch('http://x')\n"
+    )
+    ids = deep_ids({"kubebrain_tpu/helper.py": helper,
+                    "kubebrain_tpu/caller.py": caller})
+    assert ids == ["KB112"]
+
+
+def test_kb112_unresolved_call_is_documented_false_negative():
+    """A blocking call behind dynamic dispatch the resolver cannot see is
+    a FALSE NEGATIVE by design — the engine must not guess, but it must
+    COUNT the blind spot (stats.unresolved_calls) so a clean report reads
+    "clean modulo N unresolved calls", never "proven clean"."""
+    src = (
+        "import time\nimport threading\n"
+        "class S:\n"
+        "    def __init__(self, strategy):\n"
+        "        self._lock = threading.Lock()\n"
+        "        self.strategy = strategy\n"  # type unknown statically
+        "    def serve(self):\n"
+        "        with self._lock:\n"
+        "            self.strategy.refresh()\n"  # may block — unresolvable
+    )
+    res = deep_analyze_sources({PKG: src})
+    assert [f.rule_id for f in res.findings] == []  # the documented miss
+    assert res.stats["unresolved_calls"] >= 1  # ...but it is accounted
+
+
+def test_kb112_suppressible_on_flagged_line():
+    src = (
+        "import time\nimport threading\n"
+        "class S:\n"
+        "    def __init__(self):\n"
+        "        self._lock = threading.Lock()\n"
+        "    def serve(self):\n"
+        "        with self._lock:\n"
+        "            self._refresh()  # kblint: disable=KB112 -- bounded\n"
+        "    def _refresh(self):\n"
+        "        time.sleep(0.5)\n"
+    )
+    assert deep_ids({PKG: src}) == []
+
+
+# ------------------------------------------------------------------- KB113
+def test_kb113_two_hop_host_sync_from_jit():
+    src = (
+        "import jax\n"
+        "def _hop2(y):\n"
+        "    return y.block_until_ready()\n"
+        "def _hop1(y):\n"
+        "    return _hop2(y)\n"
+        "@jax.jit\n"
+        "def kernel(x):\n"
+        "    return _hop1(x)\n"
+    )
+    res = deep_analyze_sources({PKG: src})
+    assert [f.rule_id for f in res.findings] == ["KB113"]
+    (f,) = res.findings
+    assert "kernel" in f.message and "_hop1" in f.message
+    assert f.line == 3  # at the sync op, chain in the message
+
+
+def test_kb113_jit_value_wrapping_counts_as_entry():
+    # jax.jit(f) / shard_map(f, ...) wrap references, not decorators
+    src = (
+        "import jax\n"
+        "def body(x):\n"
+        "    return float(x)\n"  # float() of a traced param
+        "run = jax.jit(body)\n"
+    )
+    assert deep_ids({PKG: src}) == ["KB113"]
+
+
+def test_kb113_float_on_host_value_not_flagged():
+    # float() on a host constant inside traced code is static math
+    src = (
+        "import jax\n"
+        "@jax.jit\n"
+        "def kernel(x):\n"
+        "    scale = float(1e-9)\n"
+        "    return x * scale\n"
+    )
+    assert deep_ids({PKG: src}) == []
+
+
+def test_kb113_untraced_helper_not_flagged():
+    src = (
+        "def helper(y):\n"
+        "    return y.block_until_ready()\n"
+        "def driver(y):\n"
+        "    return helper(y)\n"
+    )
+    assert deep_ids({PKG: src}) == []
+
+
+# ------------------------------------------------------------------- KB114
+LAUNDERED = (
+    "import numpy as np\n"
+    "def _grab(x):\n"
+    "    return np.asarray(x)\n"
+    "def leak(mirror):\n"
+    "    alias = mirror.keys_dev\n"
+    "    return _grab(alias)\n"
+)
+
+
+def test_kb114_catches_alias_wrapper_laundering_v1_provably_misses():
+    """THE acceptance fixture: a device pull laundered through an alias
+    plus a wrapper function. v1's KB111 is name-based and sees neither
+    (np.asarray(x) on a plain parameter, alias without the _dev suffix at
+    the conversion site) — prove v1 misses it AND v2 catches it."""
+    v1 = [f.rule_id for f in lint_source(LAUNDERED, TPU)]
+    assert "KB111" not in v1  # v1 provably blind to this shape
+    res = deep_analyze_sources({TPU: LAUNDERED})
+    assert [f.rule_id for f in res.findings] == ["KB114"]
+    (f,) = res.findings
+    assert "_grab" in f.message and f.line == 6  # the laundering call site
+
+
+def test_kb114_direct_lexical_pull_still_caught_by_both():
+    src = ("import numpy as np\n"
+           "def leak(mirror):\n"
+           "    return np.asarray(mirror.keys_dev)\n")
+    assert [f.rule_id for f in lint_source(src, TPU)] == ["KB111"]
+    assert deep_ids({TPU: src}) == ["KB114"]
+
+
+def test_kb114_allowlisted_funnel_and_private_helper_allowed():
+    # _host_pull may convert; a helper reachable ONLY from allowed
+    # functions inherits the license (it IS the materialization path)
+    src = (
+        "import numpy as np\n"
+        "def _only_helper(x):\n"
+        "    return np.asarray(x)\n"
+        "def _host_pull(x_dev):\n"
+        "    return _only_helper(x_dev)\n"
+    )
+    assert deep_ids({TPU: src}) == []
+
+
+def test_kb114_scoped_to_storage_tpu():
+    assert deep_ids({PKG: LAUNDERED}) == []
+
+
+def test_kb114_method_boundary_laundering_caught():
+    """Review regression: methods' param indexes must line up with
+    explicit call args (the receiver is not a param), or laundering
+    through a METHOD — which is what the whole TpuScanner surface is —
+    goes silently unflagged while the plain-function twin is caught."""
+    src = ("import numpy as np\n"
+           "class S:\n"
+           "    def _grab(self, x):\n"
+           "        return np.asarray(x)\n"
+           "    def leak(self, mirror):\n"
+           "        alias = mirror.keys_dev\n"
+           "        return self._grab(alias)\n")
+    assert deep_ids({TPU: src}) == ["KB114"]
+
+
+def test_kb114_attribute_store_does_not_taint_receiver():
+    """Review regression: `self._mirror = <device value>` must not taint
+    `self` itself — that poisoning made every later self-touching call
+    arg read as a device value (18 false positives on engine.py)."""
+    src = ("import jax.numpy as jnp\nimport numpy as np\n"
+           "class S:\n"
+           "    def build(self, host_rows):\n"
+           "        self._mirror = jnp.asarray(host_rows)\n"
+           "        return np.asarray(host_rows)\n"  # host data: no escape
+           )
+    assert deep_ids({TPU: src}) == []
+
+
+def test_kb113_project_forwarder_into_trace_wrapper():
+    """Review regression: a kernel entering tracing through the project's
+    own wrapper (`_maybe_shard_map(partial(kernel, ...))`) is traced just
+    as surely as one passed to shard_map directly."""
+    src = ("import jax\nfrom functools import partial\n"
+           "def _maybe_shard_map(f, mesh):\n"
+           "    return jax.shard_map(f, mesh=mesh)\n"
+           "def kernel(x):\n"
+           "    return x.block_until_ready()\n"
+           "def driver(x_dev, mesh):\n"
+           "    g = _maybe_shard_map(partial(kernel, x_dev), mesh)\n"
+           "    return g(x_dev)\n")
+    assert deep_ids({PKG: src}) == ["KB113"]
+
+
+def test_kb113_self_attr_float_in_jit_method_not_flagged():
+    # the receiver is not a tracer: float(self.scale_host) is host math
+    src = ("import jax\n"
+           "class K:\n"
+           "    @jax.jit\n"
+           "    def kern(self):\n"
+           "        return float(self.scale_host)\n")
+    assert deep_ids({PKG: src}) == []
+
+
+def test_kb114_jit_kernel_result_taint_flows():
+    # the result of a @jax.jit function is a device value; converting it
+    # two assignments later is an escape
+    src = (
+        "import jax\nimport numpy as np\n"
+        "@jax.jit\n"
+        "def _kernel(x):\n"
+        "    return x\n"
+        "def use(x):\n"
+        "    out = _kernel(x)\n"
+        "    tmp = out\n"
+        "    return np.asarray(tmp)\n"
+    )
+    ids = deep_ids({TPU: src})
+    assert "KB114" in ids
+
+
+# ------------------------------------------------------------------- KB115
+ABBA = (
+    "import threading\n"
+    "class AB:\n"
+    "    def __init__(self):\n"
+    "        self._alock = threading.Lock()\n"
+    "        self._block = threading.Lock()\n"
+    "    def fwd(self):\n"
+    "        with self._alock:\n"
+    "            with self._block:\n"
+    "                pass\n"
+    "    def rev(self):\n"
+    "        with self._block:\n"
+    "            self.other()\n"
+    "    def other(self):\n"
+    "        with self._alock:\n"
+    "            pass\n"
+)
+
+
+def test_kb115_static_abba_cycle():
+    res = deep_analyze_sources({PKG: ABBA})
+    assert [f.rule_id for f in res.findings] == ["KB115"]
+    (f,) = res.findings
+    assert "AB._alock" in f.message and "AB._block" in f.message
+    assert res.lock_graph["static_edge_count"] == 2
+    assert res.lock_graph["cycles"] == 1
+
+
+def test_kb115_ordered_nesting_clean():
+    src = (
+        "import threading\n"
+        "class AB:\n"
+        "    def __init__(self):\n"
+        "        self._alock = threading.Lock()\n"
+        "        self._block = threading.Lock()\n"
+        "    def fwd(self):\n"
+        "        with self._alock:\n"
+        "            with self._block:\n"
+        "                pass\n"
+    )
+    res = deep_analyze_sources({PKG: src})
+    assert [f.rule_id for f in res.findings] == []
+    assert res.lock_graph["static_edge_count"] == 1
+
+
+def test_kb115_runtime_cross_check_measures_coverage_gap():
+    """The lockcheck cross-check: runtime observed one of the two static
+    edges -> coverage 0.5, the unobserved edge is the runtime detector's
+    measurable gap, and a runtime-only edge (dynamic dispatch the static
+    graph missed) is reported as static blindness."""
+    src = (
+        "import threading\n"
+        "class AB:\n"
+        "    def __init__(self):\n"
+        "        self._alock = threading.Lock()\n"      # line 4
+        "        self._block = threading.Lock()\n"      # line 5
+        "        self._clock = threading.Lock()\n"      # line 6
+        "    def fwd(self):\n"
+        "        with self._alock:\n"
+        "            with self._block:\n"
+        "                pass\n"
+        "    def fwd2(self):\n"
+        "        with self._block:\n"
+        "            with self._clock:\n"
+        "                pass\n"
+    )
+    # lockcheck keys sites as parentdir/file.py:line of the Lock() call
+    runtime = [("kubebrain_tpu/x.py:4", "kubebrain_tpu/x.py:5"),   # seen
+               ("kubebrain_tpu/x.py:6", "kubebrain_tpu/x.py:4")]   # static-miss
+    res = deep_analyze_sources({PKG: src}, runtime_lock_edges=runtime)
+    lg = res.lock_graph
+    assert lg["static_edge_count"] == 2
+    assert lg["runtime_edges_mapped"] == 2
+    assert lg["coverage"] == pytest.approx(0.5)
+    assert len(lg["static_edges_unobserved"]) == 1
+    assert "_block" in lg["static_edges_unobserved"][0]
+    assert len(lg["runtime_only_edges"]) == 1
+    assert "_clock" in lg["runtime_only_edges"][0]
+
+
+def test_kb115_empty_runtime_export_reports_zero_coverage():
+    """Review regression: an exported-but-empty edge set ([]) is real data
+    — a detector that nested nothing — and must report coverage 0.0 with
+    every static edge unobserved, not silently skip the cross-check."""
+    res = deep_analyze_sources({PKG: ABBA}, runtime_lock_edges=[])
+    lg = res.lock_graph
+    assert lg["runtime_edges"] == 0
+    assert lg["coverage"] == 0.0
+    assert len(lg["static_edges_unobserved"]) == lg["static_edge_count"]
+
+
+def test_kb115_cross_check_from_live_lockcheck_export(tmp_path):
+    """End-to-end: run util/lockcheck.py on real nested locks, export its
+    edges, and map them onto the static graph of the same source."""
+    from kubebrain_tpu.util import lockcheck
+    src_py = (
+        "import threading\n"
+        "class AB:\n"
+        "    def __init__(self):\n"
+        "        self._alock = threading.Lock()\n"
+        "        self._block = threading.Lock()\n"
+        "    def fwd(self):\n"
+        "        with self._alock:\n"
+        "            with self._block:\n"
+        "                pass\n"
+    )
+    # materialize under a path lockcheck attributes to the project
+    # (…/kubebrain_tpu/<file>), then exercise the nesting under the shim
+    mod_dir = tmp_path / "kubebrain_tpu"
+    mod_dir.mkdir()
+    mod_file = mod_dir / "abba_fixture.py"
+    mod_file.write_text(src_py)
+    was_installed = lockcheck.installed()  # a KB_LOCKCHECK=1 session's shim
+    if not was_installed:
+        lockcheck.install()
+    try:
+        ns: dict = {}
+        exec(compile(src_py, str(mod_file), "exec"), ns)
+        ab = ns["AB"]()
+        ab.fwd()
+        out = tmp_path / "edges.json"
+        n = lockcheck.export_edges(str(out))
+    finally:
+        if not was_installed:
+            lockcheck.uninstall()
+            lockcheck.reset()
+    assert n >= 1
+    runtime = [tuple(e) for e in
+               json.loads(out.read_text())["edges"]]
+    assert ("kubebrain_tpu/abba_fixture.py:4",
+            "kubebrain_tpu/abba_fixture.py:5") in runtime
+    res = deep_analyze_sources({"kubebrain_tpu/abba_fixture.py": src_py},
+                               runtime_lock_edges=runtime)
+    assert res.lock_graph["coverage"] == pytest.approx(1.0)
+
+
+# ------------------------------------------------- differential (v2 ⊇ v1)
+#: representative per-rule fixtures from the v1 suite: the deep driver
+#: must report every syntactic finding these produce (running both tiers),
+#: i.e. v2 is a superset of v1 on the existing corpus
+V1_CORPUS = [
+    ("kubebrain_tpu/endpoint/x1.py",
+     "import time\nasync def f():\n    time.sleep(1)\n", {"KB101"}),
+    ("kubebrain_tpu/b/x2.py",
+     "import jax\ndef f(self):\n    with self._lock:\n        jax.device_put(1)\n",
+     {"KB102"}),
+    ("kubebrain_tpu/b/x3.py", "try:\n    x = 1\nexcept:\n    pass\n",
+     {"KB103"}),
+    ("kubebrain_tpu/ops/x4.py",
+     "import jax\n@jax.jit\ndef kernel(x):\n    return jax.device_get(x)\n",
+     {"KB104", "KB113"}),  # v2 adds the traced-context finding
+    ("kubebrain_tpu/server/etcd/x5.py",
+     "def f(rev):\n    return rev + 1\n", {"KB105"}),
+    ("kubebrain_tpu/server/etcd/x6.py",
+     "def f(self, s, e):\n    return self.backend.list_(s, e)\n", {"KB106"}),
+    ("kubebrain_tpu/sched/x7.py", "def f(x):\n    print(x)\n", {"KB107"}),
+    ("kubebrain_tpu/backend/x8.py",
+     "import time\ndef f(ttl):\n    return time.time() + ttl\n", {"KB108"}),
+    ("kubebrain_tpu/storage/tpu/x9.py",
+     "from kubebrain_tpu.ops.scan_pallas import scan_mask_pallas\n"
+     "def fast(kt):\n    return scan_mask_pallas(kt)\n", {"KB109"}),
+    ("kubebrain_tpu/workload/x10.py",
+     "import random\ndef jitter():\n    return random.random()\n", {"KB110"}),
+    ("kubebrain_tpu/storage/tpu/x11.py",
+     "import jax\ndef leak(mask):\n    return jax.device_get(mask)\n",
+     {"KB111", "KB114"}),  # v2 adds the taint escape
+]
+
+
+def test_differential_v2_superset_of_v1_on_corpus(tmp_path):
+    """Write the v1 fixtures as a tree, run the v1 sweep and the full deep
+    driver over it, and assert per-file: v2's findings ⊇ v1's, with the
+    expected ids exactly."""
+    for rel, src, _ in V1_CORPUS:
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(src)
+    v1 = lint_paths(["kubebrain_tpu"], root=str(tmp_path))
+    v1_by_file = {}
+    for f in v1:
+        v1_by_file.setdefault(f.path.replace("\\", "/"), set()).add(f.rule_id)
+    deep = deep_analyze_paths(str(tmp_path), ["kubebrain_tpu"])
+    v2_by_file = {k: set(v) for k, v in v1_by_file.items()}  # no set sharing
+    for f in deep.findings:
+        v2_by_file.setdefault(f.path.replace("\\", "/"), set()).add(f.rule_id)
+    for rel, _, expected in V1_CORPUS:
+        got_v1 = v1_by_file.get(rel, set())
+        got_v2 = v2_by_file.get(rel, set())
+        assert got_v1 <= got_v2, (rel, got_v1, got_v2)   # superset guarantee
+        assert got_v2 == expected, (rel, got_v2)         # and nothing noisy
+
+
+# ---------------------------------------------------------------- baseline
+def test_baseline_pins_and_detects_stale(tmp_path):
+    f1 = Finding("a.py", 10, 0, "KB112", "blocking at a.py:44 via x")
+    f2 = Finding("b.py", 20, 0, "KB114", "escape via y")
+    bpath = tmp_path / "baseline.json"
+    Baseline.write(str(bpath), [f1])
+    bl = Baseline.load(str(bpath))
+    # line drift inside the message must not un-pin the finding
+    drifted = Finding("a.py", 11, 0, "KB112", "blocking at a.py:61 via x")
+    new, pinned, stale = bl.split([drifted, f2])
+    assert [f.rule_id for f in new] == ["KB114"]
+    assert [f.rule_id for f in pinned] == ["KB112"]
+    assert stale == []
+    # nothing fires -> the entry is reported stale, not silently kept
+    new, pinned, stale = bl.split([])
+    assert new == [] and pinned == [] and len(stale) == 1
+
+
+def test_baseline_write_preserves_justifications(tmp_path):
+    f1 = Finding("a.py", 10, 0, "KB112", "blocking via x")
+    bpath = tmp_path / "baseline.json"
+    Baseline.write(str(bpath), [f1])
+    data = json.loads(bpath.read_text())
+    data["findings"][0]["why"] = "checkpoint fsync is deliberate"
+    bpath.write_text(json.dumps(data))
+    prev = Baseline.load(str(bpath))
+    Baseline.write(str(bpath), [f1], previous=prev)
+    assert json.loads(bpath.read_text())["findings"][0]["why"] == \
+        "checkpoint fsync is deliberate"
+
+
+def test_normalize_message_masks_line_refs():
+    assert normalize_message("x at a.py:12 and b.py:9") == \
+        normalize_message("x at a.py:99 and b.py:1")
+    # KB114's "at line N" form must mask too, or baselined KB114 entries
+    # churn whenever a blank line shifts the converting helper
+    assert normalize_message("via _grab() which converts its arg at line 12") \
+        == normalize_message("via _grab() which converts its arg at line 99")
+
+
+def test_taint_solver_survives_recursive_function():
+    """Review regression: a self-recursive function that host-converts a
+    swapped parameter must not crash the solver (dict mutated during
+    iteration) — the deep tier must return a verdict, not a traceback."""
+    src = ("import numpy as np\n"
+           "def f(a, b):\n"
+           "    np.asarray(a)\n"
+           "    return f(b, a)\n")
+    res = deep_analyze_sources({TPU: src})  # must not raise
+    assert isinstance(res.findings, list)
+
+
+# ------------------------------------------------------------------- cache
+def _make_corpus(root, n=40):
+    os.makedirs(os.path.join(root, "kubebrain_tpu"), exist_ok=True)
+    open(os.path.join(root, "kubebrain_tpu", "__init__.py"), "w").close()
+    for i in range(n):
+        with open(os.path.join(root, "kubebrain_tpu", f"m{i:03d}.py"),
+                  "w") as f:
+            f.write("import threading\n")
+            for j in range(12):
+                f.write(
+                    f"def f{j}(x):\n"
+                    f"    y = x + {j}\n"
+                    f"    return f{(j + 1) % 12}(y) if y < 0 else y\n")
+
+
+def test_cache_cold_warm_speedup_and_hit_accounting(tmp_path):
+    """The satellite's cold/warm assertion: a warm run re-parses nothing
+    and is measurably faster than the cold run on a 40-file corpus."""
+    root = str(tmp_path)
+    _make_corpus(root)
+    cache = LintCache(os.path.join(root, ".kblint_cache"))
+    t0 = time.monotonic()
+    cold = deep_analyze_paths(root, ["kubebrain_tpu"], cache=cache)
+    cold_s = time.monotonic() - t0
+    assert cold.stats["files_parsed"] == 41
+    assert cold.stats["files_from_cache"] == 0
+    t0 = time.monotonic()
+    warm = deep_analyze_paths(root, ["kubebrain_tpu"], cache=cache)
+    warm_s = time.monotonic() - t0
+    assert warm.stats["files_parsed"] == 0          # nothing re-analyzed
+    assert warm.stats["files_from_cache"] == 41
+    # the functional guarantee is the two counters above; the timing
+    # assertion only guards against a pathological cache (reading entries
+    # slower than parsing) — with 3x headroom so host-load noise between
+    # two ~100ms runs cannot flake an otherwise-green build
+    assert warm_s < cold_s * 3, (warm_s, cold_s)
+    # identical verdicts from cached summaries (JSON round-trip fidelity)
+    assert [f.format() for f in warm.findings] == \
+        [f.format() for f in cold.findings]
+    assert warm.stats["resolved_calls"] == cold.stats["resolved_calls"]
+
+
+def test_cache_invalidates_on_content_change(tmp_path):
+    root = str(tmp_path)
+    _make_corpus(root, n=3)
+    cache = LintCache(os.path.join(root, ".kblint_cache"))
+    deep_analyze_paths(root, ["kubebrain_tpu"], cache=cache)
+    # edit one file: exactly that file re-parses
+    with open(os.path.join(root, "kubebrain_tpu", "m000.py"), "a") as f:
+        f.write("def extra():\n    return 1\n")
+    res = deep_analyze_paths(root, ["kubebrain_tpu"], cache=cache)
+    assert res.stats["files_parsed"] == 1
+    assert res.stats["files_from_cache"] == 3
+
+
+def test_cache_invalidates_on_engine_change(tmp_path):
+    """rules.py (or any engine module) edits rotate the engine key: every
+    entry written under the old key misses AND is garbage-collected."""
+    root = str(tmp_path)
+    _make_corpus(root, n=2)
+    cache_dir = os.path.join(root, ".kblint_cache")
+    cache = LintCache(cache_dir)
+    deep_analyze_paths(root, ["kubebrain_tpu"], cache=cache)
+    n_before = len(os.listdir(cache_dir))
+    assert n_before == 3
+    stale = LintCache(cache_dir)
+    stale.engine = "deadbeefdeadbeef"  # what a rules.py edit produces
+    res = deep_analyze_paths(root, ["kubebrain_tpu"], cache=stale)
+    assert res.stats["files_parsed"] == 3  # all misses under the new key
+    names = os.listdir(cache_dir)
+    assert all(n.startswith("deadbeef") for n in names)  # old entries GC'd
+
+
+def test_cache_distinguishes_same_content_different_paths(tmp_path):
+    """Two identical sources at different paths scope differently (KB107
+    fires in sched/, not in backend/) — the cache must never cross-serve."""
+    src = "def f(x):\n    print(x)\n"
+    cache = LintCache(os.path.join(str(tmp_path), ".kblint_cache"))
+    sched = lint_source(src, "kubebrain_tpu/sched/a.py")
+    for rel, expected in [("kubebrain_tpu/sched/a.py", ["KB107"]),
+                          ("kubebrain_tpu/backend/a.py", [])]:
+        d = os.path.join(str(tmp_path), *os.path.dirname(rel).split("/"))
+        os.makedirs(d, exist_ok=True)
+        with open(os.path.join(str(tmp_path), rel), "w") as f:
+            f.write(src)
+    del sched
+    out = lint_paths(["kubebrain_tpu"], root=str(tmp_path), cache=cache)
+    assert [f.rule_id for f in out] == ["KB107"]
+    out2 = lint_paths(["kubebrain_tpu"], root=str(tmp_path), cache=cache)
+    assert [f.rule_id for f in out2] == ["KB107"]  # warm run, same verdict
+
+
+# ------------------------------------------------------------ CLI / repo
+def test_cli_deep_clean_on_this_repo():
+    """The acceptance invariant: python -m tools.kblint --deep over the
+    shipped tree reports ZERO non-baselined findings, inside the budget.
+    (--no-cache so a poisoned cache can never fake a pass in CI.)"""
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.kblint", "kubebrain_tpu", "tools",
+         "tests", "--deep", "--no-cache"],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "kblint-deep:" in proc.stdout
+
+
+def test_cli_list_rules_includes_deep_tier():
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.kblint", "--list-rules"],
+        capture_output=True, text=True, cwd=REPO,
+    )
+    assert proc.returncode == 0
+    for rid in ("KB112", "KB113", "KB114", "KB115"):
+        assert rid in proc.stdout
+
+
+def test_deep_stats_account_unresolved_calls_on_repo():
+    """Blind-spot accounting on the real tree: the engine knows how much
+    it cannot see, and says so."""
+    res = deep_analyze_paths(REPO)
+    assert res.stats["functions"] > 800
+    assert res.stats["resolved_calls"] > 1500
+    assert res.stats["unresolved_calls"] > 0  # honesty, not omniscience
+    assert res.stats["lock_edges"] > 10
+    assert res.lock_graph["cycles"] == 0

@@ -1,6 +1,12 @@
 #!/usr/bin/env bash
 # The offline CI entry point (mirrored by .github/workflows/check.yml):
-#   1. make lint        — kblint project invariants + native lint
+#   1. make lint        — kblint project invariants (syntactic KB101-KB111
+#                         + the --deep interprocedural tier KB112-KB115,
+#                         zero non-baselined findings, <60s budget
+#                         enforced) + native lint, then the kblint engine
+#                         self-tests (rule fixtures, differential corpus,
+#                         cache cold/warm) — a lint-engine regression
+#                         should fail before anything else runs
 #   2. make typecheck   — mypy (or compileall fallback)
 #   3. scheduler gate   — sched semantics + query-batched scan tests
 #                         (batched == sequential byte-identical, incl. the
@@ -29,8 +35,10 @@ set -uo pipefail
 
 cd "$(dirname "$0")/.."
 
-echo "=== [1/8] make lint"
+echo "=== [1/8] make lint (syntactic + deep interprocedural, 60s budget)"
 make lint || exit 1
+env JAX_PLATFORMS=cpu python -m pytest tests/test_kblint.py \
+    tests/test_kblint_deep.py -q -m 'not slow' -p no:cacheprovider || exit 1
 
 echo "=== [2/8] make typecheck"
 make typecheck || exit 1

@@ -1,13 +1,34 @@
-"""CLI: ``python -m tools.kblint [paths...] [--list-rules]``."""
+"""CLI: ``python -m tools.kblint [paths...] [--deep] [--list-rules]``.
+
+Two tiers (docs/static_analysis.md):
+
+- default: the syntactic per-file rules KB101–KB111 over ``paths``
+- ``--deep``: additionally builds the whole-program call graph over
+  ``kubebrain_tpu/ + tools/ + bench.py`` and runs the interprocedural
+  rules KB112–KB115, filtered through tools/kblint/baseline.json and held
+  to a wall-clock budget (CI fails if the analysis outgrows it).
+
+Both tiers share the content-hash cache in ``.kblint_cache/`` (disable
+with ``KBLINT_CACHE=0``), so incremental runs only re-analyze edited
+files.
+"""
 
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import sys
+import time
 
 from . import rules  # noqa: F401  -- importing registers the rules
-from .core import RULES, lint_paths
+from .cache import LintCache
+from .core import (Baseline, DEEP_ROOTS, RULES, deep_analyze_paths,
+                   lint_paths)
+
+DEFAULT_BASELINE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "baseline.json")
+DEFAULT_BUDGET = 60.0  # seconds: the stated CI wall-clock budget
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -15,25 +36,116 @@ def main(argv: list[str] | None = None) -> int:
         prog="kblint", description="kubebrain-tpu project-invariant linter"
     )
     parser.add_argument("paths", nargs="*", default=["kubebrain_tpu"],
-                        help="files or directories to lint")
+                        help="files or directories to lint (syntactic tier)")
     parser.add_argument("--list-rules", action="store_true",
                         help="print the rule catalogue and exit")
     parser.add_argument("--root", default=os.getcwd(),
                         help="repo root for relative paths (default: cwd)")
+    parser.add_argument("--deep", action="store_true",
+                        help="run the interprocedural tier (KB112-KB115) "
+                             "over kubebrain_tpu/ + tools/ + bench.py")
+    parser.add_argument("--baseline", default=DEFAULT_BASELINE,
+                        help="baseline JSON pinning pre-existing deep "
+                             "findings (default: tools/kblint/baseline.json)")
+    parser.add_argument("--write-baseline", action="store_true",
+                        help="rewrite the baseline from the current deep "
+                             "findings (preserves justifications)")
+    parser.add_argument("--budget", type=float, default=DEFAULT_BUDGET,
+                        help="wall-clock budget in seconds for the whole "
+                             "run; exceeded = nonzero exit (default 60)")
+    parser.add_argument("--lock-edges", default="",
+                        help="JSON file of runtime lock-order edges "
+                             "(util/lockcheck.py export) to cross-check "
+                             "against the static KB115 graph; defaults to "
+                             "$KBLINT_LOCK_EDGES on --deep runs")
+    parser.add_argument("--lock-graph", action="store_true",
+                        help="print the static lock-order graph and the "
+                             "runtime cross-check report")
+    parser.add_argument("--stats", action="store_true",
+                        help="print resolution/propagation statistics")
+    parser.add_argument("--no-cache", action="store_true",
+                        help="bypass .kblint_cache/ for this run")
     args = parser.parse_args(argv)
 
     if args.list_rules:
+        from .contexts import DEEP_RULES
         for rid in sorted(RULES):
             print(f"{rid}  {RULES[rid].summary}")
+        for rid in sorted(DEEP_RULES):
+            print(f"{rid}  {DEEP_RULES[rid]} [--deep]")
         return 0
 
-    findings = lint_paths(args.paths or ["kubebrain_tpu"], root=args.root)
+    if not args.deep and (args.lock_edges or args.lock_graph or args.stats
+                          or args.write_baseline):
+        # a typo'd CI line must not pass green while doing none of the work
+        # (only EXPLICIT flags trigger this — the KBLINT_LOCK_EDGES env
+        # fallback is read later, on --deep runs only, so an exported env
+        # var cannot fail an ordinary syntactic run)
+        print("kblint: --lock-edges/--lock-graph/--stats/--write-baseline "
+              "require --deep", file=sys.stderr)
+        return 2
+    if args.deep and not args.lock_edges:
+        args.lock_edges = os.environ.get("KBLINT_LOCK_EDGES", "")
+
+    t0 = time.monotonic()
+    cache = None if args.no_cache else LintCache.from_env(args.root)
+    findings = lint_paths(args.paths or ["kubebrain_tpu"], root=args.root,
+                          cache=cache)
+    failed = False
     for f in findings:
         print(f.format())
     if findings:
         print(f"kblint: {len(findings)} finding(s)", file=sys.stderr)
-        return 1
-    return 0
+        failed = True
+
+    if args.deep:
+        runtime_edges = None
+        if args.lock_edges:
+            try:
+                with open(args.lock_edges, encoding="utf-8") as fh:
+                    runtime_edges = [tuple(e) for e in
+                                     json.load(fh).get("edges", [])]
+            except (OSError, ValueError) as e:
+                print(f"kblint: unreadable --lock-edges file: {e}",
+                      file=sys.stderr)
+                return 2
+        result = deep_analyze_paths(args.root, DEEP_ROOTS, cache=cache,
+                                    runtime_lock_edges=runtime_edges)
+        baseline = Baseline.load(args.baseline)
+        new, pinned, stale = baseline.split(result.findings)
+        if args.write_baseline:
+            Baseline.write(args.baseline, result.findings, previous=baseline)
+            print(f"kblint-deep: wrote {len(result.findings)} finding(s) to "
+                  f"{args.baseline}")
+            new = []
+        for f in new:
+            print(f.format())
+        if new:
+            print(f"kblint-deep: {len(new)} non-baselined finding(s)",
+                  file=sys.stderr)
+            failed = True
+        if stale and not args.write_baseline:  # the write just cleaned them
+            print(f"kblint-deep: note: {len(stale)} stale baseline "
+                  f"entr{'y' if len(stale) == 1 else 'ies'} no longer "
+                  f"fire(s) — clean with --write-baseline", file=sys.stderr)
+        s = result.stats
+        print(f"kblint-deep: {s['files']} modules, {s['functions']} "
+              f"functions, {s['resolved_calls']} calls resolved / "
+              f"{s['unresolved_calls']} unresolved / {s['fn_refs']} fn-refs,"
+              f" {len(pinned)} baselined, {s['lock_edges']} lock edges, "
+              f"{s['elapsed_seconds']}s")
+        if args.stats:
+            print(json.dumps(s, indent=1, sort_keys=True))
+        if args.lock_graph:
+            print(json.dumps(result.lock_graph, indent=1, sort_keys=True))
+
+    elapsed = time.monotonic() - t0
+    if args.budget and elapsed > args.budget:
+        print(f"kblint: BUDGET EXCEEDED: {elapsed:.1f}s > {args.budget:.0f}s"
+              " — the analysis must stay inside the CI wall-clock budget",
+              file=sys.stderr)
+        return 2
+    return 1 if failed else 0
 
 
 if __name__ == "__main__":

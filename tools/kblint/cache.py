@@ -1,0 +1,121 @@
+"""Content-hash-keyed cache of per-file kblint results (.kblint_cache/).
+
+Incremental ``make lint``: the expensive per-file work — AST parse,
+syntactic rule sweep, and the deep tier's ModuleSummary extraction — is a
+pure function of (file content, kblint engine source), so it is cached
+under a key of both hashes. Editing a source file invalidates exactly that
+file; editing ANY kblint module (rules.py included) rotates the engine key
+and invalidates everything. The whole-program propagation phase is cheap
+(graph stitching + fixpoints) and always re-runs.
+
+Entries are JSON (no pickle: a poisoned cache must not execute), one file
+per (engine, content) pair, garbage-collected whenever the engine key
+rotates. Disable with ``KBLINT_CACHE=0``; relocate with
+``KBLINT_CACHE_DIR``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import os
+
+_ENGINE_SOURCES = ("core.py", "rules.py", "graph.py", "contexts.py",
+                   "cache.py")
+
+
+def engine_key() -> str:
+    """Hash of the kblint engine's own source files — any rule or engine
+    change invalidates every cached entry."""
+    h = hashlib.sha256()
+    here = os.path.dirname(os.path.abspath(__file__))
+    for name in _ENGINE_SOURCES:
+        try:
+            with open(os.path.join(here, name), "rb") as f:
+                h.update(f.read())
+        except OSError:
+            h.update(b"missing:" + name.encode())
+    return h.hexdigest()[:16]
+
+
+def content_key(relpath: str, src: str) -> str:
+    """Key of (path, content): the rules scope by path and the deep
+    summaries bake the module name in, so identical bytes at two paths
+    (every empty __init__.py) must NOT share an entry."""
+    h = hashlib.sha256()
+    h.update(relpath.replace("\\", "/").encode())
+    h.update(b"\0")
+    h.update(src.encode("utf-8", "replace"))
+    return h.hexdigest()[:24]
+
+
+@dataclasses.dataclass
+class CacheStats:
+    hits: int = 0
+    misses: int = 0
+    writes: int = 0
+
+
+class LintCache:
+    """get/put of per-file results keyed by (engine, content)."""
+
+    def __init__(self, cache_dir: str) -> None:
+        self.dir = cache_dir
+        self.engine = engine_key()
+        self.stats = CacheStats()
+        self._gc_done = False
+
+    @classmethod
+    def from_env(cls, root: str) -> "LintCache | None":
+        if os.environ.get("KBLINT_CACHE", "1") in ("0", "off", "no"):
+            return None
+        cache_dir = os.environ.get("KBLINT_CACHE_DIR") or os.path.join(
+            root, ".kblint_cache")
+        return cls(cache_dir)
+
+    def _path(self, relpath: str, src: str) -> str:
+        return os.path.join(
+            self.dir, f"{self.engine}-{content_key(relpath, src)}.json")
+
+    def get(self, relpath: str, src: str) -> dict | None:
+        try:
+            with open(self._path(relpath, src), encoding="utf-8") as f:
+                entry = json.load(f)
+        except (OSError, ValueError):
+            self.stats.misses += 1
+            return None
+        self.stats.hits += 1
+        return entry
+
+    def put(self, relpath: str, src: str, entry: dict) -> None:
+        try:
+            os.makedirs(self.dir, exist_ok=True)
+            self._gc_stale()
+            tmp = self._path(relpath, src) + f".tmp.{os.getpid()}"
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(entry, f, separators=(",", ":"))
+            os.replace(tmp, self._path(relpath, src))
+            self.stats.writes += 1
+        except OSError:
+            pass  # a read-only tree degrades to uncached, never to failure
+
+    def _gc_stale(self) -> None:
+        """Drop entries written by a different engine version (rules.py
+        edits would otherwise accrete dead cache files forever)."""
+        if self._gc_done:
+            return
+        self._gc_done = True
+        try:
+            for name in os.listdir(self.dir):
+                stale_entry = (name.endswith(".json")
+                               and not name.startswith(self.engine))
+                # a killed writer leaves .json.tmp.<pid> orphans behind
+                orphan_tmp = ".json.tmp." in name
+                if stale_entry or orphan_tmp:
+                    try:
+                        os.unlink(os.path.join(self.dir, name))
+                    except OSError:
+                        pass
+        except OSError:
+            pass

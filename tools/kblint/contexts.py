@@ -1,0 +1,613 @@
+"""Execution-context propagation + the interprocedural rules KB112–KB115.
+
+Contexts propagated along the :class:`~tools.kblint.graph.ProjectGraph`:
+
+- **blocking reachability** — can this function (transitively) execute a
+  call that blocks the thread? (KB112: such a call reachable from inside
+  a ``with <lock>:`` region is the static twin of util/lockcheck.py's
+  runtime sleep-under-lock detector.)
+- **jit/shard_map tracing** — is this function's body executed under JAX
+  tracing, directly (decorator) or because a traced function calls it /
+  wraps a reference to it? (KB113: host sync reachable from traced code.)
+- **device-array taint** — which values are device arrays, across
+  aliases, returns, and parameter passing? (KB114: a taint-carrying value
+  host-converted outside the KB111 materialization allowlist — the
+  alias/wrapper laundering a name-based rule misses by design.)
+- **async-event-loop** — reachable from a coroutine body without an
+  executor hop (reported in stats; KB101 stays the lexical tier).
+- **lock-acquisition order** — the static lock-order graph (KB115),
+  cycle-checked and cross-checked against lockcheck's runtime-observed
+  edges so the runtime detector's coverage gap becomes a number.
+
+Every propagation is an over-approximation ON RESOLVED EDGES ONLY: calls
+the resolver cannot see (``stats.unresolved_calls``) are accounted, not
+guessed, so a clean report means "clean modulo N blind spots", and N is
+printed next to the verdict.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Iterable
+
+from .core import Finding
+from .rules import _BLOCKING_CALLS, _BLOCKING_MODULES, _HOST_TRANSFER_ALLOWED
+from .graph import (CallSite, FunctionSummary, ProjectGraph, _TRACE_WRAPPERS)
+
+#: rules implemented on the interprocedural engine
+DEEP_RULES = {
+    "KB112": "blocking call transitively reachable while a lock is held",
+    "KB113": "host sync transitively reachable from jit/shard_map-traced code",
+    "KB114": "device-array taint escaping to host outside the KB111 allowlist",
+    "KB115": "static lock-acquisition-order graph must be acyclic",
+}
+
+#: sync op kinds that are a host sync in ANY traced context, regardless of
+#: operand taint (they have no legitimate traced use)
+_ALWAYS_SYNC_OPS = {"block_until_ready", "device_get", "item"}
+
+
+def _blocking_name(name: str) -> str | None:
+    if name in _BLOCKING_CALLS:
+        return name
+    root = name.split(".", 1)[0]
+    if root in _BLOCKING_MODULES:
+        return name
+    return None
+
+
+@dataclasses.dataclass
+class DeepResult:
+    findings: list[Finding]
+    stats: dict[str, Any]
+    lock_graph: dict[str, Any]
+
+
+def _fn_label(qn: str) -> str:
+    """pkg.mod::Class.meth -> Class.meth (short display form)."""
+    return qn.rsplit("::", 1)[-1]
+
+
+def _chain_str(chain: list[str]) -> str:
+    return " -> ".join(_fn_label(q) for q in chain)
+
+
+# ---------------------------------------------------------------- blocking
+
+
+def _blocking_witness(graph: ProjectGraph) -> dict[str, tuple[list[str], str]]:
+    """fn qualname -> (call chain ending at the blocking fn, detail).
+    BFS from directly-blocking functions up the reverse call graph so the
+    recorded chain is a shortest witness."""
+    witness: dict[str, tuple[list[str], str]] = {}
+    frontier: list[str] = []
+    for qn, fs in graph.functions.items():
+        for cs in fs.calls:
+            if cs.is_ref:
+                continue
+            b = _blocking_name(cs.name)
+            if b:
+                witness[qn] = ([qn], f"{b}() at {fs.relpath}:{cs.line}")
+                frontier.append(qn)
+                break
+        else:
+            for op in fs.sync_ops:
+                if op.op == "block_until_ready":
+                    witness[qn] = ([qn], f"block_until_ready() at "
+                                         f"{fs.relpath}:{op.line}")
+                    frontier.append(qn)
+                    break
+    while frontier:
+        nxt: list[str] = []
+        for qn in frontier:
+            chain, detail = witness[qn]
+            for caller in graph.callers.get(qn, ()):
+                if caller in witness:
+                    continue
+                # only real calls propagate; a bare reference passed around
+                # executes later, in a context this edge does not witness
+                for cs, targets in graph.calls.get(caller, ()):
+                    if not cs.is_ref and qn in targets:
+                        witness[caller] = ([caller] + chain, detail)
+                        nxt.append(caller)
+                        break
+        frontier = nxt
+    return witness
+
+
+def _kb112(graph: ProjectGraph,
+           blocking: dict[str, tuple[list[str], str]]) -> Iterable[Finding]:
+    """A call made while lexically holding a lock, whose (transitive)
+    callee reaches a blocking call. Direct blocking-under-lock stays
+    KB102's lexical finding; KB112 is the multi-hop twin."""
+    for qn, fs in graph.functions.items():
+        if not fs.relpath.replace("\\", "/").startswith("kubebrain_tpu/"):
+            continue
+        for cs, targets in graph.calls.get(qn, ()):
+            if cs.is_ref or not cs.under_locks:
+                continue
+            for tgt in targets:
+                w = blocking.get(tgt)
+                if w is None:
+                    continue
+                chain, detail = w
+                held = cs.under_locks[-1]
+                yield Finding(
+                    fs.relpath, cs.line, cs.col, "KB112",
+                    f"blocking call reachable while holding {held}: "
+                    f"{_fn_label(qn)} -> {_chain_str(chain)} reaches {detail}")
+                break  # one finding per call site
+
+
+# ------------------------------------------------------------------ traced
+
+
+def _trace_forwarders(graph: ProjectGraph) -> set[str]:
+    """Project functions that forward one of their OWN parameters into a
+    trace wrapper (``def _maybe_shard_map(f, ...): return shard_map(f,
+    ...)``): a reference passed into one of these enters tracing just as
+    surely as one passed to ``jax.jit`` directly. Transitive — a
+    forwarder's forwarder forwards."""
+    fwd: set[str] = set()
+    changed = True
+    while changed:
+        changed = False
+        for qn, fs in graph.functions.items():
+            if qn in fwd:
+                continue
+            resolved = graph.calls.get(qn, ())
+            for cs in fs.calls:
+                if not cs.is_ref or cs.name not in fs.params:
+                    continue
+                hit = cs.ref_of in _TRACE_WRAPPERS
+                if not hit:
+                    # the wrapping call may itself resolve to a forwarder
+                    for cs2, targets in resolved:
+                        if (not cs2.is_ref and cs2.name == cs.ref_of
+                                and set(targets) & fwd):
+                            hit = True
+                            break
+                if hit:
+                    fwd.add(qn)
+                    changed = True
+                    break
+    return fwd
+
+
+def _traced_set(graph: ProjectGraph) -> dict[str, list[str]]:
+    """fn qualname -> witness chain from a jit/shard_map entry. Entries are
+    decorator-marked functions plus references passed into a trace wrapper
+    (``jax.jit(f)``, ``shard_map(f, ...)``, ``pl.pallas_call(body)(...)``)
+    — directly OR through a project forwarder like ``_maybe_shard_map``.
+    Inside a traced function both calls AND bare references propagate —
+    the ``_maybe_shard_map(partial(kernel, ...))`` idiom wraps-and-calls."""
+    forwarders = _trace_forwarders(graph)
+    traced: dict[str, list[str]] = {}
+    frontier: list[str] = []
+    for qn, fs in graph.functions.items():
+        if fs.jit_entry:
+            traced[qn] = [qn]
+            frontier.append(qn)
+    for qn, fs in graph.functions.items():
+        resolved = graph.calls.get(qn, ())
+        for cs, targets in resolved:
+            if not cs.is_ref:
+                continue
+            entering = cs.ref_of in _TRACE_WRAPPERS
+            if not entering and cs.ref_of:
+                for cs2, tgts2 in resolved:
+                    if (not cs2.is_ref and cs2.name == cs.ref_of
+                            and set(tgts2) & forwarders):
+                        entering = True
+                        break
+            if entering:
+                for tgt in targets:
+                    if tgt not in traced:
+                        traced[tgt] = [tgt]
+                        frontier.append(tgt)
+    while frontier:
+        nxt: list[str] = []
+        for qn in frontier:
+            chain = traced[qn]
+            for cs, targets in graph.calls.get(qn, ()):
+                for tgt in targets:
+                    if tgt in traced:
+                        continue
+                    traced[tgt] = chain + [tgt]
+                    nxt.append(tgt)
+        frontier = nxt
+    return traced
+
+
+def _kb113(graph: ProjectGraph, traced: dict[str, list[str]],
+           taint: "_TaintSolver") -> Iterable[Finding]:
+    for qn, chain in traced.items():
+        fs = graph.functions[qn]
+        for op in fs.sync_ops:
+            flag = op.op in _ALWAYS_SYNC_OPS
+            if not flag:
+                # float()/np.asarray()/... only when the operand is a
+                # traced value: device-tainted, or a parameter (parameters
+                # of a traced function ARE tracers)
+                definite, params = taint.eval_atoms(fs, op.atoms)
+                flag = definite or bool(params)
+            if flag:
+                via = (f" (traced via {_chain_str(chain)})"
+                       if len(chain) > 1 or chain[0] != qn else
+                       f" (jit entry {_fn_label(qn)!r})")
+                yield Finding(
+                    fs.relpath, op.line, 0, "KB113",
+                    f"host sync {op.op} reachable under jit/shard_map "
+                    f"tracing{via}")
+
+
+# ------------------------------------------------------------------- taint
+
+
+class _TaintSolver:
+    """Interprocedural device-taint fixpoint over function summaries.
+
+    Per-function interface: ``returns_device`` (calling it yields a device
+    value), ``param_returns`` (params whose taint flows to the return),
+    ``param_escapes`` (params whose taint reaches a host conversion inside
+    the function), and the list of *definite* escapes (host conversions of
+    values device-tainted no matter the caller)."""
+
+    def __init__(self, graph: ProjectGraph) -> None:
+        self.graph = graph
+        self.returns_device: dict[str, bool] = {}
+        self.param_returns: dict[str, set[int]] = {}
+        self.param_escapes: dict[str, dict[int, tuple[int, str]]] = {}
+        self.definite_escapes: dict[str, list[tuple[int, str, str]]] = {}
+        self._call_index: dict[str, dict[tuple[str, int], list[str]]] = {}
+        for qn in graph.functions:
+            self.returns_device[qn] = graph.functions[qn].jit_entry
+            self.param_returns[qn] = set()
+            self.param_escapes[qn] = {}
+            self.definite_escapes[qn] = []
+            idx: dict[tuple[str, int], list[str]] = {}
+            for cs, targets in graph.calls.get(qn, ()):
+                if not cs.is_ref:
+                    idx[(cs.name, cs.line)] = targets
+            self._call_index[qn] = idx
+        self._solve()
+
+    # -- atom evaluation ---------------------------------------------------
+    def eval_atoms(self, fs: FunctionSummary,
+                   atoms: list[str]) -> tuple[bool, set[int]]:
+        """(definitely tainted, params whose taint would make it so)."""
+        definite = False
+        params: set[int] = set()
+        seen: set[str] = set()
+
+        def walk(atom_list: list[str]) -> None:
+            nonlocal definite
+            for a in atom_list:
+                if a in seen:
+                    continue
+                seen.add(a)
+                if a == "dev":
+                    definite = True
+                elif a.startswith("param:"):
+                    params.add(int(a.split(":", 1)[1]))
+                elif a.startswith("var:"):
+                    walk(fs.assigns.get(a.split(":", 1)[1], []))
+                elif a.startswith("callname:"):
+                    _, name, line = a.split(":", 2)
+                    for tgt in self._call_index[fs.qualname].get(
+                            (name, int(line)), ()):
+                        if self.returns_device.get(tgt):
+                            definite = True
+                        elif self.param_returns.get(tgt):
+                            # the callee pipes some param to its return:
+                            # taint depends on the matching args
+                            cs = self._site(fs, name, int(line))
+                            if cs is not None:
+                                for i in self.param_returns[tgt]:
+                                    walk(cs.arg_atoms.get(str(i), []))
+        walk(atoms)
+        return definite, params
+
+    def _site(self, fs: FunctionSummary, name: str,
+              line: int) -> CallSite | None:
+        for cs in fs.calls:
+            if not cs.is_ref and cs.name == name and cs.line == line:
+                return cs
+        return None
+
+    # -- fixpoint ----------------------------------------------------------
+    def _solve(self) -> None:
+        for _ in range(12):  # summaries converge in a few rounds
+            changed = False
+            for qn, fs in self.graph.functions.items():
+                # returns
+                definite, params = self.eval_atoms(fs, fs.returns)
+                if definite and not self.returns_device[qn]:
+                    self.returns_device[qn] = True
+                    changed = True
+                if not params <= self.param_returns[qn]:
+                    self.param_returns[qn] |= params
+                    changed = True
+                # own escapes
+                esc: list[tuple[int, str, str]] = []
+                for e in fs.escapes:
+                    d, p = self.eval_atoms(fs, e.atoms)
+                    if d:
+                        esc.append((e.line, e.conv, "device value"))
+                    for i in p:
+                        if i not in self.param_escapes[qn]:
+                            self.param_escapes[qn][i] = (e.line, e.conv)
+                            changed = True
+                # escapes through callees: tainted arg into a param the
+                # callee converts (the wrapper-laundering path)
+                for cs, targets in self.graph.calls.get(qn, ()):
+                    if cs.is_ref:
+                        continue
+                    for tgt in targets:
+                        if self._allowed(tgt):
+                            continue  # _host_pull(x) is the sanctioned funnel
+                        # snapshot: a self-recursive fn (tgt == qn) would
+                        # otherwise mutate the dict mid-iteration
+                        for i, (eline, conv) in list(self.param_escapes.get(
+                                tgt, {}).items()):
+                            atoms = cs.arg_atoms.get(str(i), [])
+                            if not atoms:
+                                continue
+                            d, p = self.eval_atoms(fs, atoms)
+                            if d:
+                                esc.append((
+                                    cs.line, conv,
+                                    f"via {_fn_label(tgt)}() which converts "
+                                    f"its arg at line {eline}"))
+                            for j in p:
+                                if j not in self.param_escapes[qn]:
+                                    self.param_escapes[qn][j] = (cs.line, conv)
+                                    changed = True
+                if esc != self.definite_escapes[qn]:
+                    self.definite_escapes[qn] = esc
+                    changed = True
+            if not changed:
+                break
+
+    def _allowed(self, qn: str) -> bool:
+        return self.graph.functions[qn].name in _HOST_TRANSFER_ALLOWED
+
+
+def _allowlist_closure(graph: ProjectGraph) -> set[str]:
+    """Functions allowed to host-convert device data: the named KB111
+    materialization points, plus helpers reachable ONLY from allowed
+    functions (a private helper of `_host_pull` inherits its license; a
+    helper any stray path can reach does not)."""
+    allowed = {qn for qn, fs in graph.functions.items()
+               if fs.name in _HOST_TRANSFER_ALLOWED}
+    changed = True
+    while changed:
+        changed = False
+        for qn, fs in graph.functions.items():
+            if qn in allowed:
+                continue
+            callers = graph.callers.get(qn, set())
+            if callers and callers <= allowed:
+                allowed.add(qn)
+                changed = True
+    return allowed
+
+
+def _kb114(graph: ProjectGraph, taint: _TaintSolver) -> Iterable[Finding]:
+    allowed = _allowlist_closure(graph)
+    for qn, fs in graph.functions.items():
+        rp = fs.relpath.replace("\\", "/")
+        if not rp.startswith("kubebrain_tpu/storage/tpu/"):
+            continue
+        if qn in allowed:
+            continue
+        for line, conv, how in taint.definite_escapes.get(qn, ()):
+            yield Finding(
+                fs.relpath, line, 0, "KB114",
+                f"device-array taint escapes to host through {conv} in "
+                f"{_fn_label(qn)!r} ({how}); only the named materialization "
+                f"points (_host_pull and friends) may pull device data")
+
+
+# -------------------------------------------------------------- lock order
+
+
+def _acquired_closure(graph: ProjectGraph) -> dict[str, dict[str, list[str]]]:
+    """fn -> {lock_id: witness chain of functions leading to the acquire}."""
+    acq: dict[str, dict[str, list[str]]] = {
+        qn: {} for qn in graph.functions}
+    for qn, fs in graph.functions.items():
+        for a in fs.acquires:
+            acq[qn].setdefault(a.lock_id, [qn])
+    changed = True
+    while changed:
+        changed = False
+        for qn, fs in graph.functions.items():
+            for cs, targets in graph.calls.get(qn, ()):
+                if cs.is_ref:
+                    continue
+                for tgt in targets:
+                    for lock_id, chain in acq.get(tgt, {}).items():
+                        if lock_id not in acq[qn]:
+                            acq[qn][lock_id] = [qn] + chain
+                            changed = True
+    return acq
+
+
+def _lock_edges(graph: ProjectGraph,
+                acquired: dict[str, dict[str, list[str]]]
+                ) -> dict[tuple[str, str], tuple[str, int, str]]:
+    """(held, acquired) -> (relpath, line, witness description)."""
+    edges: dict[tuple[str, str], tuple[str, int, str]] = {}
+    for qn, fs in graph.functions.items():
+        for a in fs.acquires:
+            for held in a.under_locks:
+                if held != a.lock_id:
+                    edges.setdefault(
+                        (held, a.lock_id),
+                        (fs.relpath, a.line, f"nested with in {_fn_label(qn)}"))
+        for cs, targets in graph.calls.get(qn, ()):
+            if cs.is_ref or not cs.under_locks:
+                continue
+            for tgt in targets:
+                for lock_id, chain in acquired.get(tgt, {}).items():
+                    for held in cs.under_locks:
+                        if held != lock_id:
+                            edges.setdefault(
+                                (held, lock_id),
+                                (fs.relpath, cs.line,
+                                 f"{_fn_label(qn)} -> {_chain_str(chain)}"))
+    return edges
+
+
+def _find_cycles(edges: Iterable[tuple[str, str]]) -> list[list[str]]:
+    """Elementary cycles via SCC + DFS (the graphs here are tiny)."""
+    adj: dict[str, set[str]] = {}
+    for a, b in edges:
+        adj.setdefault(a, set()).add(b)
+        adj.setdefault(b, set())
+    cycles: list[list[str]] = []
+    seen_keys: set[tuple[str, ...]] = set()
+
+    def dfs(start: str, node: str, path: list[str], visited: set[str]) -> None:
+        for nxt in sorted(adj.get(node, ())):
+            if nxt == start and len(path) > 1:
+                key = tuple(sorted(path))
+                if key not in seen_keys:
+                    seen_keys.add(key)
+                    cycles.append(list(path))
+            elif nxt not in visited and nxt > start:
+                # only explore nodes > start so each cycle is found once,
+                # rooted at its smallest node
+                visited.add(nxt)
+                dfs(start, nxt, path + [nxt], visited)
+                visited.discard(nxt)
+
+    for start in sorted(adj):
+        dfs(start, start, [start], {start})
+    return cycles
+
+
+def _runtime_site_map(graph: ProjectGraph) -> dict[str, str]:
+    """lockcheck creation-site string ('pkg/file.py:NN') -> static lock id.
+    lockcheck keys sites as basename(dirname)/basename(file):line."""
+    out: dict[str, str] = {}
+    for lock_id, (rp, line) in graph.lock_sites.items():
+        rp = rp.replace("\\", "/")
+        parts = rp.split("/")
+        site = (f"{parts[-2]}/{parts[-1]}:{line}" if len(parts) >= 2
+                else f"{parts[-1]}:{line}")
+        out[site] = lock_id
+    return out
+
+
+def _kb115(graph: ProjectGraph,
+           runtime_edges: list[tuple[str, str]] | None
+           ) -> tuple[list[Finding], dict[str, Any]]:
+    acquired = _acquired_closure(graph)
+    edges = _lock_edges(graph, acquired)
+    findings: list[Finding] = []
+    for cyc in _find_cycles(edges.keys()):
+        chain = cyc + [cyc[0]]
+        first = edges.get((cyc[0], cyc[1])) or next(iter(edges.values()))
+        findings.append(Finding(
+            first[0], first[1], 0, "KB115",
+            "static lock-order cycle (potential ABBA deadlock): "
+            + " -> ".join(chain) + f"; first edge via {first[2]}"))
+
+    report: dict[str, Any] = {
+        "static_edges": sorted(f"{a} -> {b}" for a, b in edges),
+        "static_edge_count": len(edges),
+        "cycles": len(findings),
+        "lock_sites": len(graph.lock_sites),
+    }
+    if runtime_edges is not None:
+        site_map = _runtime_site_map(graph)
+        mapped: list[tuple[str, str]] = []
+        unmapped = 0
+        for a, b in runtime_edges:
+            la, lb = site_map.get(a), site_map.get(b)
+            if la and lb:
+                mapped.append((la, lb))
+            else:
+                unmapped += 1
+        static_set = set(edges.keys())
+        runtime_set = set(mapped)
+        report.update({
+            "runtime_edges": len(runtime_edges),
+            "runtime_edges_mapped": len(mapped),
+            "runtime_edges_unmapped_sites": unmapped,
+            # the runtime detector's coverage gap, now measurable: static
+            # edges no runtime run has ever exercised
+            "static_edges_unobserved": sorted(
+                f"{a} -> {b}" for a, b in static_set - runtime_set),
+            # static blindness: orders the runtime saw that resolution
+            # missed (unresolved calls / dynamic dispatch)
+            "runtime_only_edges": sorted(
+                f"{a} -> {b}" for a, b in runtime_set - static_set),
+            "coverage": (len(static_set & runtime_set) / len(static_set)
+                         if static_set else 1.0),
+        })
+    return findings, report
+
+
+# ------------------------------------------------------------------ driver
+
+
+def analyze(graph: ProjectGraph,
+            runtime_lock_edges: list[tuple[str, str]] | None = None
+            ) -> DeepResult:
+    """Run all context propagations and the KB112–KB115 rules."""
+    blocking = _blocking_witness(graph)
+    traced = _traced_set(graph)
+    taint = _TaintSolver(graph)
+
+    findings: list[Finding] = []
+    findings.extend(_kb112(graph, blocking))
+    findings.extend(_kb113(graph, traced, taint))
+    findings.extend(_kb114(graph, taint))
+    kb115, lock_graph = _kb115(graph, runtime_lock_edges)
+    findings.extend(kb115)
+
+    # suppression pragmas (flagged line or the comment line above it)
+    by_rel = {ms.relpath: ms for ms in graph.modules.values()}
+    kept: list[Finding] = []
+    for f in findings:
+        ms = by_rel.get(f.path)
+        if ms is not None:
+            if f.rule_id in ms.file_disabled:
+                continue
+            if f.rule_id in ms.disabled_lines.get(str(f.line), []):
+                continue
+        kept.append(f)
+    kept.sort(key=lambda f: (f.path, f.line, f.rule_id))
+
+    async_fns = _async_reachable(graph)
+    stats = dict(graph.stats.as_dict())
+    stats.update({
+        "blocking_reachable": len(blocking),
+        "traced_functions": len(traced),
+        "async_reachable": len(async_fns),
+        "lock_edges": lock_graph["static_edge_count"],
+    })
+    return DeepResult(findings=kept, stats=stats, lock_graph=lock_graph)
+
+
+def _async_reachable(graph: ProjectGraph) -> set[str]:
+    """Functions executing on the event loop: coroutines plus sync
+    functions they call directly (refs — executor thunks, callbacks —
+    excluded)."""
+    out = {qn for qn, fs in graph.functions.items() if fs.is_async}
+    frontier = list(out)
+    while frontier:
+        nxt = []
+        for qn in frontier:
+            for cs, targets in graph.calls.get(qn, ()):
+                if cs.is_ref:
+                    continue
+                for tgt in targets:
+                    if tgt not in out:
+                        out.add(tgt)
+                        nxt.append(tgt)
+        frontier = nxt
+    return out

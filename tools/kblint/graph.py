@@ -1,0 +1,840 @@
+"""Whole-program call graph for kblint's interprocedural tier.
+
+Two phases, split so the first is cacheable per file (.kblint_cache/):
+
+1. **Extraction** (:func:`extract_module`) — one AST walk per module
+   producing a JSON-serializable :class:`ModuleSummary`: every function's
+   call sites (with the lexical lock stack at each), lock acquisitions,
+   host-sync ops, device-taint atoms, jit/shard_map entry marks, import
+   and alias tables, and lock construction sites. Pure function of the
+   source text, so a content-hash cache key is sound.
+
+2. **Resolution** (:class:`ProjectGraph`) — stitches the summaries into a
+   project-wide call graph. Best-effort by design: module functions,
+   ``from``-imports, ``self.``/class-attribute methods (with attribute
+   types inferred from ``self.x = ClassName(...)`` assignments),
+   ``functools.partial``, module-level ``f = jax.jit(g)`` aliases, and a
+   unique-method-name fallback. Everything it cannot resolve is *counted*
+   (``stats.unresolved_calls``) rather than silently dropped — the
+   analysis over-reports its own blindness instead of faking closure.
+
+The context propagation and the KB112–KB115 rules live in contexts.py.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+from typing import Any, Iterable
+
+from .core import _DISABLE_FILE_RE, _DISABLE_RE
+from .rules import dotted_name, terminal_name
+
+#: functions treated as jit/trace entries when used as decorators or
+#: wrappers (value position): their argument's body executes under tracing
+_TRACE_WRAPPERS = {
+    "jax.jit", "jit", "pjit", "jax.pjit", "shard_map", "jax.shard_map",
+    "pl.pallas_call", "pallas_call", "jax.vmap", "vmap",
+}
+
+#: attribute names whose access on a device array yields host metadata,
+#: not a device value (x.shape is a static tuple, never a transfer)
+_UNTAINT_ATTRS = {"shape", "dtype", "ndim", "size", "sharding", "nbytes",
+                  "at", "devices"}
+
+#: host converters whose call on a device-tainted value is a device→host
+#: transfer (the KB111/KB114 escape set)
+_HOST_CONV_NAMES = {
+    "np.asarray", "numpy.asarray", "np.array", "numpy.array",
+    "np.copy", "numpy.copy", "float", "bytes",
+}
+_HOST_CONV_METHODS = {"tolist", "item"}
+
+_LOCK_NAME_RE = re.compile(r"lock$", re.IGNORECASE)
+# the suppression-pragma grammar is core.py's (one copy: a syntax change
+# there must not leave the deep tier parsing the old grammar)
+
+#: call-name roots that are NOT analysis blindness when unresolved
+#: (builtins + the external modules this codebase leans on); hoisted to a
+#: module constant — _counts_as_unresolved runs once per call site
+import builtins as _builtins
+_KNOWN_EXTERNAL_ROOTS = frozenset(dir(_builtins)) | frozenset({
+    "jax", "jnp", "np", "numpy", "pl", "functools", "threading", "time",
+    "os", "sys", "ast", "re", "grpc", "logging", "math", "json",
+    "collections", "dataclasses", "itertools", "struct", "queue",
+    "asyncio", "socket", "subprocess", "signal", "contextlib", "random",
+    "hashlib", "shutil", "tempfile", "traceback", "typing", "enum", "abc",
+    "io", "pickle", "base64", "zlib", "heapq", "bisect", "warnings",
+    "weakref", "string", "textwrap", "argparse", "concurrent", "http",
+    "urllib", "ssl", "select", "errno", "copy", "types", "inspect",
+    "importlib", "pathlib", "platform", "uuid", "secrets", "statistics",
+})
+
+
+# --------------------------------------------------------------- summaries
+
+
+@dataclasses.dataclass
+class CallSite:
+    """One call (or bare function reference) inside a function body."""
+
+    line: int
+    col: int
+    name: str                 # dotted callee expression ("self.x.range_")
+    under_locks: list[str]    # lock ids lexically held at this site
+    is_ref: bool = False      # a bare reference passed around, not a call
+    ref_of: str = ""          # for refs: the call the reference was passed to
+    arg_atoms: dict[str, list[str]] = dataclasses.field(default_factory=dict)
+    # taint atoms per positional arg index (str key for JSON)
+
+
+@dataclasses.dataclass
+class LockAcq:
+    lock_id: str              # normalized lock identity (see _lock_identity)
+    line: int
+    under_locks: list[str]    # locks already held when this one is taken
+
+
+@dataclasses.dataclass
+class SyncOp:
+    """A host-synchronization op (KB113's finding set)."""
+
+    line: int
+    op: str                   # "block_until_ready" | "item" | "device_get" |
+    #                           "float" | "np.asarray" | ...
+    atoms: list[str]          # taint atoms of the operand ([] = unknown)
+    under_locks: list[str] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class EscapeOp:
+    """A host conversion whose operand carries taint atoms (KB114)."""
+
+    line: int
+    conv: str                 # converter name (np.asarray, float, .item, ...)
+    atoms: list[str]
+
+
+@dataclasses.dataclass
+class FunctionSummary:
+    qualname: str             # "pkg.mod::Class.meth" / "pkg.mod::func"
+    name: str
+    relpath: str
+    module: str
+    line: int
+    cls: str | None = None
+    is_async: bool = False
+    jit_entry: bool = False   # decorated @jax.jit/@shard_map/partial-thereof
+    calls: list[CallSite] = dataclasses.field(default_factory=list)
+    acquires: list[LockAcq] = dataclasses.field(default_factory=list)
+    sync_ops: list[SyncOp] = dataclasses.field(default_factory=list)
+    escapes: list[EscapeOp] = dataclasses.field(default_factory=list)
+    # flow-insensitive local dataflow: var name -> union of source atoms
+    assigns: dict[str, list[str]] = dataclasses.field(default_factory=dict)
+    returns: list[str] = dataclasses.field(default_factory=list)
+    params: list[str] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class ModuleSummary:
+    module: str
+    relpath: str
+    imports: dict[str, str] = dataclasses.field(default_factory=dict)
+    from_imports: dict[str, list[str]] = dataclasses.field(default_factory=dict)
+    functions: dict[str, FunctionSummary] = dataclasses.field(default_factory=dict)
+    classes: dict[str, dict[str, Any]] = dataclasses.field(default_factory=dict)
+    aliases: dict[str, str] = dataclasses.field(default_factory=dict)
+    lock_sites: dict[str, list[Any]] = dataclasses.field(default_factory=dict)
+    # lock id -> [relpath, line] of the threading.Lock()/RLock() call, for
+    # the runtime (lockcheck) edge cross-check
+    disabled_lines: dict[str, list[str]] = dataclasses.field(default_factory=dict)
+    file_disabled: list[str] = dataclasses.field(default_factory=list)
+    parse_error: str | None = None
+
+    # -- JSON round-trip (the cache format) --------------------------------
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "ModuleSummary":
+        ms = cls(module=d["module"], relpath=d["relpath"],
+                 imports=d["imports"], from_imports=d["from_imports"],
+                 classes=d["classes"], aliases=d["aliases"],
+                 lock_sites=d["lock_sites"],
+                 disabled_lines=d["disabled_lines"],
+                 file_disabled=d["file_disabled"],
+                 parse_error=d.get("parse_error"))
+        for qn, fd in d["functions"].items():
+            fs = FunctionSummary(
+                qualname=fd["qualname"], name=fd["name"],
+                relpath=fd["relpath"], module=fd["module"], line=fd["line"],
+                cls=fd["cls"], is_async=fd["is_async"],
+                jit_entry=fd["jit_entry"],
+                calls=[CallSite(**c) for c in fd["calls"]],
+                acquires=[LockAcq(**a) for a in fd["acquires"]],
+                sync_ops=[SyncOp(**s) for s in fd["sync_ops"]],
+                escapes=[EscapeOp(**e) for e in fd["escapes"]],
+                assigns=fd["assigns"], returns=fd["returns"],
+                params=fd["params"])
+            ms.functions[qn] = fs
+        return ms
+
+
+# --------------------------------------------------------------- extraction
+
+
+def module_name_for(relpath: str) -> str:
+    rp = relpath.replace("\\", "/")
+    if rp.endswith("/__init__.py"):
+        rp = rp[: -len("/__init__.py")]
+    elif rp.endswith(".py"):
+        rp = rp[:-3]
+    return rp.replace("/", ".")
+
+
+def _resolve_relative(module: str, level: int, target: str | None,
+                      is_pkg: bool) -> str:
+    """``from ..a import b`` inside ``module`` -> absolute dotted module.
+    In a package ``__init__`` level 1 is the package itself; in a regular
+    module it is the containing package (one component stripped)."""
+    parts = module.split(".")
+    strip = level - 1 if is_pkg else level
+    base = parts[: len(parts) - strip] if strip <= len(parts) else []
+    if target:
+        base = base + target.split(".")
+    return ".".join(base)
+
+
+def _is_trace_decorator(dec: ast.expr) -> bool:
+    name = dotted_name(dec)
+    if name in _TRACE_WRAPPERS:
+        return True
+    if isinstance(dec, ast.Call):
+        fname = dotted_name(dec.func)
+        if fname in _TRACE_WRAPPERS:
+            return True
+        if fname in ("partial", "functools.partial") and dec.args:
+            return dotted_name(dec.args[0]) in _TRACE_WRAPPERS
+    return False
+
+
+def _lock_expr_id(expr: ast.expr, module: str, cls: str | None) -> str | None:
+    """Normalized identity for a lock-ish with-context expression, or None
+    if the expression is not lock-named. ``self._lock`` in class C ->
+    ``module::C._lock``; module-global ``_LK`` -> ``module::_LK``; other
+    receivers collapse to ``~attr`` (one global node per attribute name —
+    ambiguous, but deterministic)."""
+    name = terminal_name(expr)
+    if not name or not _LOCK_NAME_RE.search(name):
+        return None
+    if isinstance(expr, ast.Attribute):
+        recv = expr.value
+        if isinstance(recv, ast.Name) and recv.id in ("self", "cls") and cls:
+            return f"{module}::{cls}.{name}"
+        return f"~{name}"
+    return f"{module}::{name}"
+
+
+class _Extractor(ast.NodeVisitor):
+    """One pass over a module AST building the ModuleSummary."""
+
+    def __init__(self, module: str, relpath: str) -> None:
+        self.ms = ModuleSummary(module=module, relpath=relpath)
+        self.is_pkg = relpath.replace("\\", "/").endswith("/__init__.py")
+
+    # -- module structure --------------------------------------------------
+    def extract(self, tree: ast.Module) -> ModuleSummary:
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    self.ms.imports[a.asname or a.name.split(".", 1)[0]] = (
+                        a.name if a.asname else a.name.split(".", 1)[0])
+            elif isinstance(node, ast.ImportFrom):
+                mod = (_resolve_relative(self.ms.module, node.level,
+                                         node.module, self.is_pkg)
+                       if node.level else (node.module or ""))
+                for a in node.names:
+                    self.ms.from_imports[a.asname or a.name] = [mod, a.name]
+        self._extract_scope(tree.body, cls=None, prefix="")
+        body_fn = FunctionSummary(
+            qualname=f"{self.ms.module}::<module>", name="<module>",
+            relpath=self.ms.relpath, module=self.ms.module, line=1)
+        self._extract_body(tree.body, body_fn, cls=None, locks=[])
+        self.ms.functions[body_fn.qualname] = body_fn
+        return self.ms
+
+    def _extract_scope(self, body: list[ast.stmt], cls: str | None,
+                       prefix: str) -> None:
+        for node in body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._extract_function(node, cls, prefix)
+            elif isinstance(node, (ast.If, ast.Try)):
+                # module-level conditional defs (version/feature gates)
+                for sub_body in ([node.body] + [h.body for h in getattr(
+                        node, "handlers", [])] + [getattr(node, "orelse", [])]
+                        + [getattr(node, "finalbody", [])]):
+                    self._extract_scope(sub_body, cls, prefix)
+            elif isinstance(node, ast.ClassDef) and cls is None and not prefix:
+                bases = [dotted_name(b) for b in node.bases if dotted_name(b)]
+                info: dict[str, Any] = {"bases": bases, "methods": {},
+                                        "attr_types": {}, "line": node.lineno}
+                self.ms.classes[node.name] = info
+                for sub in node.body:
+                    if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                        qn = f"{self.ms.module}::{node.name}.{sub.name}"
+                        info["methods"][sub.name] = qn
+                        self._extract_function(sub, node.name, "")
+                self._infer_attr_types(node, info)
+            elif isinstance(node, ast.Assign) and cls is None and not prefix:
+                self._module_assign(node)
+
+    def _infer_attr_types(self, cnode: ast.ClassDef, info: dict) -> None:
+        """self.x = ClassName(...) anywhere in the class body -> x: ClassName
+        (a dotted constructor reference, resolved later)."""
+        for node in ast.walk(cnode):
+            if not (isinstance(node, ast.Assign)
+                    and isinstance(node.value, ast.Call)):
+                continue
+            ctor = dotted_name(node.value.func)
+            if not ctor or not ctor.split(".")[-1][:1].isupper():
+                continue
+            for tgt in node.targets:
+                if (isinstance(tgt, ast.Attribute)
+                        and isinstance(tgt.value, ast.Name)
+                        and tgt.value.id == "self"):
+                    info["attr_types"].setdefault(tgt.attr, ctor)
+
+    def _module_assign(self, node: ast.Assign) -> None:
+        """Module-level aliases worth resolving: ``g = f``,
+        ``g = jax.jit(f)``, ``g = functools.partial(f, ...)``, plus lock
+        construction sites (``_LK = threading.Lock()``)."""
+        if len(node.targets) != 1 or not isinstance(node.targets[0], ast.Name):
+            return
+        target = node.targets[0].id
+        value = node.value
+        if isinstance(value, ast.Call):
+            fname = dotted_name(value.func)
+            if fname in ("threading.Lock", "threading.RLock"):
+                lock_id = f"{self.ms.module}::{target}"
+                self.ms.lock_sites[lock_id] = [self.ms.relpath, node.lineno]
+                return
+            if fname in _TRACE_WRAPPERS or fname in ("partial",
+                                                     "functools.partial"):
+                if value.args:
+                    inner = dotted_name(value.args[0])
+                    if inner:
+                        self.ms.aliases[target] = inner
+                return
+        name = dotted_name(value)
+        if name:
+            self.ms.aliases[target] = name
+
+    # -- function bodies ---------------------------------------------------
+    def _extract_function(self, node: ast.FunctionDef | ast.AsyncFunctionDef,
+                          cls: str | None, prefix: str) -> None:
+        qn = (f"{self.ms.module}::{cls}.{node.name}" if cls
+              else f"{self.ms.module}::{prefix}{node.name}")
+        # params EXCLUDE the receiver: param index i must line up with
+        # explicit call-arg index i at bound-call sites (self._grab(x)
+        # passes x at position 0), or every method-boundary taint/param
+        # lookup in the solver is off by one — and `self` itself must not
+        # read as "param 0 is a tracer" in jit-entry methods
+        params = [a.arg for a in (node.args.posonlyargs + node.args.args)]
+        if cls is not None and params and params[0] in ("self", "cls"):
+            params = params[1:]
+        fs = FunctionSummary(
+            qualname=qn, name=node.name, relpath=self.ms.relpath,
+            module=self.ms.module, line=node.lineno, cls=cls,
+            is_async=isinstance(node, ast.AsyncFunctionDef),
+            jit_entry=any(_is_trace_decorator(d) for d in node.decorator_list),
+            params=params)
+        self.ms.functions[qn] = fs
+        # lock-construction sites inside methods (self._lock = Lock())
+        for sub in ast.walk(node):
+            if (isinstance(sub, ast.Assign) and isinstance(sub.value, ast.Call)
+                    and dotted_name(sub.value.func) in ("threading.Lock",
+                                                        "threading.RLock")):
+                for tgt in sub.targets:
+                    if (isinstance(tgt, ast.Attribute)
+                            and isinstance(tgt.value, ast.Name)
+                            and tgt.value.id == "self" and cls):
+                        lock_id = f"{self.ms.module}::{cls}.{tgt.attr}"
+                        self.ms.lock_sites[lock_id] = [self.ms.relpath,
+                                                       sub.lineno]
+        self._extract_body(node.body, fs, cls, locks=[])
+        # nested defs become their own functions, resolvable from the outer
+        # scope by name ("outer.<locals>.inner")
+        for sub in node.body:
+            self._extract_nested(sub, cls, f"{prefix}{node.name}.<locals>."
+                                 if not cls else f"{cls}.{node.name}.<locals>.")
+
+    def _extract_nested(self, node: ast.stmt, cls: str | None,
+                        prefix: str) -> None:
+        for sub in ast.walk(node):
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qn = f"{self.ms.module}::{prefix}{sub.name}"
+                if qn not in self.ms.functions:
+                    fs = FunctionSummary(
+                        qualname=qn, name=sub.name, relpath=self.ms.relpath,
+                        module=self.ms.module, line=sub.lineno, cls=None,
+                        is_async=isinstance(sub, ast.AsyncFunctionDef),
+                        jit_entry=any(_is_trace_decorator(d)
+                                      for d in sub.decorator_list),
+                        params=[a.arg for a in (sub.args.posonlyargs
+                                                + sub.args.args)])
+                    self.ms.functions[qn] = fs
+                    self._extract_body(sub.body, fs, cls, locks=[])
+
+    # taint atoms ----------------------------------------------------------
+    def _atoms(self, expr: ast.expr, fs: FunctionSummary) -> list[str]:
+        """Taint atoms of ``expr``: 'dev' (definitely a device value),
+        'param:<i>', 'var:<name>', 'call:<idx>' (the idx-th call site's
+        result). Flow-insensitive; resolution happens in contexts.py."""
+        out: set[str] = set()
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Attribute):
+                if node.attr in _UNTAINT_ATTRS:
+                    continue
+                if node.attr.endswith("_dev"):
+                    out.add("dev")
+            elif isinstance(node, ast.Name):
+                if node.id.endswith("_dev"):
+                    out.add("dev")
+                elif node.id in fs.params:
+                    out.add(f"param:{fs.params.index(node.id)}")
+                else:
+                    out.add(f"var:{node.id}")
+            elif isinstance(node, ast.Call):
+                name = dotted_name(node.func)
+                root = name.split(".", 1)[0]
+                if root in ("jnp",) or name.startswith("jax.numpy."):
+                    out.add("dev")
+                elif name == "jax.device_put":
+                    out.add("dev")
+                elif name:
+                    out.add(f"callname:{name}:{node.lineno}")
+        return sorted(out)
+
+    def _extract_body(self, body: list[ast.stmt], fs: FunctionSummary,
+                      cls: str | None, locks: list[str]) -> None:
+        """Walk statements in ``fs``'s own execution scope, tracking the
+        lexical lock stack; nested defs/lambdas are boundaries (their code
+        runs later, under different conditions)."""
+        for stmt in body:
+            self._extract_stmt(stmt, fs, cls, locks)
+
+    def _extract_stmt(self, node: ast.AST, fs: FunctionSummary,
+                      cls: str | None, locks: list[str]) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return  # boundary: handled by _extract_nested / _extract_scope
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            new_locks = list(locks)
+            for item in node.items:
+                lock_id = _lock_expr_id(item.context_expr, self.ms.module, cls)
+                # the context expression itself evaluates under the OUTER set
+                self._extract_expr(item.context_expr, fs, locks)
+                if lock_id is not None:
+                    fs.acquires.append(LockAcq(lock_id=lock_id,
+                                               line=node.lineno,
+                                               under_locks=list(new_locks)))
+                    new_locks.append(lock_id)
+            for sub in node.body:
+                self._extract_stmt(sub, fs, cls, new_locks)
+            return
+        if isinstance(node, ast.Assign):
+            atoms = self._atoms(node.value, fs)
+
+            def bind(tgt: ast.expr) -> None:
+                # only NAME bindings take the value's taint — an attribute
+                # or subscript store (self._mirror = <dev>) must not taint
+                # the receiver (`self`), or one device-valued attr store
+                # poisons every later use of the object
+                if isinstance(tgt, ast.Name):
+                    fs.assigns.setdefault(tgt.id, [])
+                    fs.assigns[tgt.id] = sorted(
+                        set(fs.assigns[tgt.id]) | set(atoms))
+                elif isinstance(tgt, (ast.Tuple, ast.List)):
+                    for el in tgt.elts:
+                        bind(el)
+                elif isinstance(tgt, ast.Starred):
+                    bind(tgt.value)
+
+            for t in node.targets:
+                bind(t)
+        elif isinstance(node, ast.Return) and node.value is not None:
+            fs.returns = sorted(set(fs.returns)
+                                | set(self._atoms(node.value, fs)))
+        # expressions inside this statement (calls, sync ops, escapes);
+        # non-stmt non-expr children (except handlers, withitems, etc.)
+        # recurse generically so their bodies keep the lock stack
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef, ast.Lambda)):
+                continue
+            if isinstance(child, ast.expr):
+                self._extract_expr(child, fs, locks)
+            else:
+                self._extract_stmt(child, fs, cls, locks)
+
+    def _extract_expr(self, expr: ast.expr, fs: FunctionSummary,
+                      locks: list[str]) -> None:
+        # lambda bodies execute later — prune them from this walk
+        in_lambda: set[int] = set()
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Lambda):
+                for sub in ast.walk(node):
+                    if sub is not node:
+                        in_lambda.add(id(sub))
+        for node in ast.walk(expr):
+            if id(node) in in_lambda or not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func)
+            if isinstance(node.func, ast.Attribute) and not name:
+                # chained receiver we cannot express as a dotted name
+                name = f"?.{node.func.attr}"
+            if not name:
+                continue
+            arg_atoms = {}
+            for i, a in enumerate(node.args):
+                atoms = self._atoms(a, fs)
+                if atoms:
+                    arg_atoms[str(i)] = atoms
+            fs.calls.append(CallSite(
+                line=node.lineno, col=node.col_offset, name=name,
+                under_locks=list(locks), arg_atoms=arg_atoms))
+            # bare project-function references passed as arguments (executor
+            # thunks, shard_map wrapping, Thread targets): recorded as refs
+            for a in (*node.args, *(kw.value for kw in node.keywords)):
+                rname = dotted_name(a)
+                if rname and not rname[:1].isupper():
+                    fs.calls.append(CallSite(
+                        line=getattr(a, "lineno", node.lineno),
+                        col=getattr(a, "col_offset", 0), name=rname,
+                        under_locks=list(locks), is_ref=True, ref_of=name))
+                elif (isinstance(a, ast.Call)
+                      and dotted_name(a.func) in ("partial",
+                                                  "functools.partial")
+                      and a.args):
+                    pname = dotted_name(a.args[0])
+                    if pname:
+                        fs.calls.append(CallSite(
+                            line=a.lineno, col=a.col_offset, name=pname,
+                            under_locks=list(locks), is_ref=True,
+                            ref_of=name))
+            # host-sync ops / escapes
+            tail = terminal_name(node.func)
+            operand_atoms: list[str] = []
+            if node.args:
+                operand_atoms = self._atoms(node.args[0], fs)
+            if tail == "block_until_ready" and isinstance(node.func,
+                                                          ast.Attribute):
+                recv_atoms = self._atoms(node.func.value, fs)
+                fs.sync_ops.append(SyncOp(line=node.lineno,
+                                          op="block_until_ready",
+                                          atoms=recv_atoms,
+                                          under_locks=list(locks)))
+            elif name in ("jax.device_get", "device_get"):
+                fs.sync_ops.append(SyncOp(line=node.lineno, op="device_get",
+                                          atoms=operand_atoms,
+                                          under_locks=list(locks)))
+                # device_get's operand is a device array BY CONTRACT —
+                # the escape is definite no matter where the value came from
+                fs.escapes.append(EscapeOp(line=node.lineno,
+                                           conv="jax.device_get",
+                                           atoms=["dev"]))
+            elif (tail in _HOST_CONV_METHODS
+                  and isinstance(node.func, ast.Attribute)):
+                recv_atoms = self._atoms(node.func.value, fs)
+                fs.sync_ops.append(SyncOp(line=node.lineno, op=tail,
+                                          atoms=recv_atoms,
+                                          under_locks=list(locks)))
+                fs.escapes.append(EscapeOp(line=node.lineno, conv=f".{tail}",
+                                           atoms=recv_atoms))
+            elif name in _HOST_CONV_NAMES:
+                fs.sync_ops.append(SyncOp(line=node.lineno, op=name,
+                                          atoms=operand_atoms,
+                                          under_locks=list(locks)))
+                fs.escapes.append(EscapeOp(line=node.lineno, conv=name,
+                                           atoms=operand_atoms))
+
+
+def _suppression_maps(src: str) -> tuple[dict[str, list[str]], list[str]]:
+    """(line -> rules suppressed for findings ON that line, file-level
+    rules). A finding on line N is covered by a pragma on N itself or on a
+    pure comment line N-1 (the deep tier does not honor with/def-header
+    pragmas — a chain finding has no single enclosing block)."""
+    lines = src.splitlines()
+    per_line: dict[str, list[str]] = {}
+    file_off: list[str] = []
+    for i, line in enumerate(lines[:20]):
+        m = _DISABLE_FILE_RE.search(line)
+        if m:
+            file_off.extend(r.strip() for r in m.group(1).split(",")
+                            if r.strip())
+    for i, line in enumerate(lines, start=1):
+        m = _DISABLE_RE.search(line)
+        if not m:
+            continue
+        rules = [r.strip() for r in m.group(1).split(",") if r.strip()]
+        per_line.setdefault(str(i), []).extend(rules)
+        # a pragma on a pure comment line covers the line below
+        if line.lstrip().startswith("#"):
+            per_line.setdefault(str(i + 1), []).extend(rules)
+    return per_line, file_off
+
+
+def extract_module(src: str, relpath: str,
+                   module: str | None = None) -> ModuleSummary:
+    """Phase 1: the cacheable per-file summary (pure in ``src``)."""
+    module = module or module_name_for(relpath)
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as e:
+        ms = ModuleSummary(module=module, relpath=relpath,
+                           parse_error=f"{e.msg} (line {e.lineno})")
+        return ms
+    ms = _Extractor(module, relpath).extract(tree)
+    ms.disabled_lines, ms.file_disabled = _suppression_maps(src)
+    return ms
+
+
+# --------------------------------------------------------------- resolution
+
+
+@dataclasses.dataclass
+class GraphStats:
+    files: int = 0
+    functions: int = 0
+    resolved_calls: int = 0
+    unresolved_calls: int = 0
+    fn_refs: int = 0
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class ProjectGraph:
+    """The resolved whole-program view over a set of ModuleSummaries."""
+
+    def __init__(self, summaries: Iterable[ModuleSummary]) -> None:
+        self.modules: dict[str, ModuleSummary] = {}
+        self.functions: dict[str, FunctionSummary] = {}
+        self.stats = GraphStats()
+        for ms in summaries:
+            self.modules[ms.module] = ms
+            for qn, fs in ms.functions.items():
+                self.functions[qn] = fs
+        self.stats.files = len(self.modules)
+        self.stats.functions = len(self.functions)
+        # method name -> defining class qualnames (unique-name fallback)
+        self._methods_by_name: dict[str, list[str]] = {}
+        for ms in self.modules.values():
+            for cname, cinfo in ms.classes.items():
+                for mname, qn in cinfo["methods"].items():
+                    self._methods_by_name.setdefault(mname, []).append(qn)
+        # callee edges: qualname -> list[(CallSite, [callee qualnames])]
+        self.calls: dict[str, list[tuple[CallSite, list[str]]]] = {}
+        self.callers: dict[str, set[str]] = {}
+        self._resolve_all()
+        # lock construction site index (for the lockcheck cross-check)
+        self.lock_sites: dict[str, tuple[str, int]] = {}
+        for ms in self.modules.values():
+            for lock_id, (rp, line) in ms.lock_sites.items():
+                self.lock_sites[lock_id] = (rp, line)
+
+    # -- name resolution ---------------------------------------------------
+    def _resolve_all(self) -> None:
+        for qn, fs in self.functions.items():
+            resolved_list: list[tuple[CallSite, list[str]]] = []
+            for cs in fs.calls:
+                raw = self._resolve_call(fs, cs)
+                targets = [t for t in raw if t in self.functions]
+                resolved_list.append((cs, targets))
+                if cs.is_ref:
+                    self.stats.fn_refs += 1
+                elif raw:
+                    self.stats.resolved_calls += 1
+                elif self._counts_as_unresolved(cs.name):
+                    self.stats.unresolved_calls += 1
+                for t in targets:
+                    self.callers.setdefault(t, set()).add(qn)
+            self.calls[qn] = resolved_list
+
+    @staticmethod
+    def _counts_as_unresolved(name: str) -> bool:
+        """Only attribute calls and non-builtin names count as analysis
+        blindness; ``len()``/``jnp.where()`` are not project calls."""
+        root = name.split(".", 1)[0].lstrip("?")
+        return root not in _KNOWN_EXTERNAL_ROOTS
+
+    def _project_module(self, dotted: str) -> ModuleSummary | None:
+        """The summary for dotted module ``a.b.c``, trying package
+        __init__ resolution (a.b.c may be a name inside package a.b)."""
+        return self.modules.get(dotted)
+
+    def _lookup_in_module(self, mod: str, attr: str,
+                          _seen: set[tuple[str, str]] | None = None
+                          ) -> list[str]:
+        seen = _seen if _seen is not None else set()
+        if (mod, attr) in seen:  # re-export cycles (pkg __init__ fan-outs)
+            return []
+        seen.add((mod, attr))
+        ms = self._project_module(mod)
+        if ms is None:
+            return []
+        qn = f"{mod}::{attr}"
+        if qn in ms.functions:
+            return [qn]
+        if attr in ms.aliases:
+            return self._resolve_dotted(ms, ms.aliases[attr])
+        if attr in ms.classes:
+            init = ms.classes[attr]["methods"].get("__init__")
+            # a project class without __init__ (dataclasses, exceptions) is
+            # KNOWN — resolved to a bodiless constructor, not a blind spot
+            return [init] if init else ["<ctor>"]
+        if attr in ms.from_imports:
+            m2, a2 = ms.from_imports[attr]
+            return self._lookup_in_module(m2, a2, seen)
+        return []
+
+    def _resolve_dotted(self, ms: ModuleSummary, name: str,
+                        cls: str | None = None,
+                        fs: FunctionSummary | None = None) -> list[str]:
+        """Resolve a dotted expression name to project function qualnames."""
+        parts = name.split(".")
+        head = parts[0]
+
+        # self.method(...) / self.attr.method(...)
+        if head == "self" and cls is not None:
+            return self._resolve_self_chain(ms, cls, parts[1:])
+
+        # plain module-scope name (local aliases are covered by the
+        # fn-ref CallSites the extractor records at the aliasing call)
+        if len(parts) == 1:
+            return self._lookup_in_module(ms.module, head)
+
+        # imported module attribute: mod.f(...) / pkg.sub.f(...)
+        if head in ms.imports:
+            mod = ms.imports[head]
+            target_mod = ".".join([mod] + parts[1:-1])
+            return self._lookup_in_module(target_mod, parts[-1])
+        # from-imported object with attribute: obj.method(...)
+        if head in ms.from_imports:
+            m2, a2 = ms.from_imports[head]
+            ms2 = self._project_module(m2)
+            if ms2 is not None and a2 in ms2.classes and len(parts) == 2:
+                qn = ms2.classes[a2]["methods"].get(parts[1])
+                return [qn] if qn else []
+            if len(parts) >= 2:
+                return self._lookup_in_module(f"{m2}.{a2}"
+                                              if self._project_module(f"{m2}.{a2}")
+                                              else m2, parts[-1])
+        # ClassName.method(...) in the same module
+        if head in ms.classes and len(parts) == 2:
+            qn = ms.classes[head]["methods"].get(parts[1])
+            return [qn] if qn else []
+        return []
+
+    def _class_info(self, ms: ModuleSummary,
+                    cls_ref: str) -> tuple[ModuleSummary, dict] | None:
+        """Find the class info for a (possibly imported) class reference."""
+        parts = cls_ref.split(".")
+        if parts[0] in ms.classes and len(parts) == 1:
+            return ms, ms.classes[parts[0]]
+        if parts[0] in ms.from_imports:
+            m2, a2 = ms.from_imports[parts[0]]
+            ms2 = self._project_module(m2)
+            if ms2 is not None and a2 in ms2.classes:
+                return ms2, ms2.classes[a2]
+        if parts[0] in ms.imports and len(parts) >= 2:
+            mod = ".".join([ms.imports[parts[0]]] + parts[1:-1])
+            ms2 = self._project_module(mod)
+            if ms2 is not None and parts[-1] in ms2.classes:
+                return ms2, ms2.classes[parts[-1]]
+        return None
+
+    def _method_on_class(self, ms: ModuleSummary, cls: str,
+                         meth: str) -> list[str]:
+        """Method lookup with a best-effort project MRO walk."""
+        seen: set[str] = set()
+        queue: list[tuple[ModuleSummary, str]] = [(ms, cls)]
+        while queue:
+            cur_ms, cur_cls = queue.pop(0)
+            key = f"{cur_ms.module}::{cur_cls}"
+            if key in seen:
+                continue
+            seen.add(key)
+            cinfo = cur_ms.classes.get(cur_cls)
+            if cinfo is None:
+                continue
+            qn = cinfo["methods"].get(meth)
+            if qn:
+                return [qn]
+            for base in cinfo["bases"]:
+                found = self._class_info(cur_ms, base)
+                if found:
+                    base_ms, base_info = found
+                    # recover the class NAME for the queue
+                    for bname, binfo in base_ms.classes.items():
+                        if binfo is base_info:
+                            queue.append((base_ms, bname))
+                            break
+        return []
+
+    def _resolve_self_chain(self, ms: ModuleSummary, cls: str,
+                            rest: list[str]) -> list[str]:
+        """self.a.b.meth(...) via inferred attribute types."""
+        if not rest:
+            return []
+        if len(rest) == 1:
+            return self._method_on_class(ms, cls, rest[0])
+        cinfo = ms.classes.get(cls)
+        cur = cinfo["attr_types"].get(rest[0]) if cinfo else None
+        cur_ms = ms
+        for hop in rest[1:-1]:
+            if cur is None:
+                return []
+            found = self._class_info(cur_ms, cur)
+            if not found:
+                return []
+            cur_ms, cinfo2 = found
+            cur = cinfo2["attr_types"].get(hop)
+        if cur is None:
+            return []
+        found = self._class_info(cur_ms, cur)
+        if not found:
+            return []
+        final_ms, final_info = found
+        for cname, cinfo3 in final_ms.classes.items():
+            if cinfo3 is final_info:
+                return self._method_on_class(final_ms, cname, rest[-1])
+        return []
+
+    def _resolve_call(self, fs: FunctionSummary, cs: CallSite) -> list[str]:
+        ms = self.modules[fs.module]
+        name = cs.name
+        if name.startswith("?."):
+            # chained receiver: fall back to unique method name
+            return self._unique_method(name[2:])
+        # nested function in the same enclosing def
+        if "." not in name:
+            host = fs.qualname.rsplit("::", 1)[-1]
+            nested = f"{fs.module}::{host}.<locals>.{name}"
+            if nested in self.functions:
+                return [nested]
+        targets = self._resolve_dotted(ms, name, cls=fs.cls, fs=fs)
+        if targets:
+            return targets
+        # obj.method(...) where the method name is uniquely project-defined
+        if "." in name:
+            return self._unique_method(name.split(".")[-1])
+        return []
+
+    def _unique_method(self, meth: str) -> list[str]:
+        cands = self._methods_by_name.get(meth, [])
+        if len(cands) == 1 and cands[0] in self.functions:
+            return [cands[0]]
+        return []

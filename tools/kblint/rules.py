@@ -367,7 +367,8 @@ class ScanDispatchOnlyInAssemblyPoints(Rule):
         )
 
     def check(self, tree: ast.Module, src: str) -> Iterable[tuple[ast.AST, str]]:
-        def scan(body: list[ast.stmt], func_name: str | None):
+        def scan(body: list[ast.stmt],
+                 func_name: str | None) -> Iterator[tuple[ast.AST, str]]:
             allowed = func_name in _SCAN_DISPATCH_ALLOWED
             for node in walk_same_scope(body):
                 if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
@@ -555,7 +556,8 @@ class HostTransferOnlyAtMaterializationPoints(Rule):
         return relpath.replace("\\", "/").startswith("kubebrain_tpu/storage/tpu/")
 
     def check(self, tree: ast.Module, src: str) -> Iterable[tuple[ast.AST, str]]:
-        def scan(body: list[ast.stmt], func_name: str | None):
+        def scan(body: list[ast.stmt],
+                 func_name: str | None) -> Iterator[tuple[ast.AST, str]]:
             allowed = func_name in _HOST_TRANSFER_ALLOWED
             for node in walk_same_scope(body):
                 if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):

@@ -1,10 +1,16 @@
 """`make typecheck` entry point.
 
-Runs mypy over the typed core (kubebrain_tpu/storage, ops, server/service)
-when mypy is installed; in containers without it (this repo must not pip
-install anything) it degrades to a full-tree bytecode compilation pass so
-the target still catches syntax/obvious-name breakage instead of silently
-no-opping. Exit 0 = clean under whichever checker ran.
+Runs mypy over the typed core when mypy is installed — CI installs a
+PINNED version (MYPY_PIN, mirrored by .github/workflows/check.yml) so the
+verdict cannot drift with upstream releases; in containers without it
+(this repo must not pip install anything) it degrades to a full-tree
+bytecode compilation pass so the target still catches syntax/obvious-name
+breakage instead of silently no-opping. Exit 0 = clean under whichever
+checker ran.
+
+The typed set: storage/, ops/, server/service (since PR 1), plus the
+strict-ish per-package ratchets in mypy.ini for sched/, lease/, and
+tools/kblint (disallow_incomplete_defs + no_implicit_optional).
 """
 
 from __future__ import annotations
@@ -16,15 +22,28 @@ import subprocess
 import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+#: the version CI installs (check.yml); keep the two in sync
+MYPY_PIN = "1.11.2"
 TYPED_PACKAGES = [
     "kubebrain_tpu/storage",
     "kubebrain_tpu/ops",
     "kubebrain_tpu/server/service",
+    "kubebrain_tpu/sched",
+    "kubebrain_tpu/lease",
+    "tools/kblint",
 ]
 
 
 def main() -> int:
     if importlib.util.find_spec("mypy") is not None:
+        try:
+            import mypy.version
+            if mypy.version.__version__ != MYPY_PIN:
+                print(f"typecheck: warning: mypy {mypy.version.__version__} "
+                      f"!= pinned {MYPY_PIN} (CI installs the pin; local "
+                      "verdicts may differ)", file=sys.stderr)
+        except Exception:
+            pass
         cmd = [sys.executable, "-m", "mypy", "--config-file",
                os.path.join(REPO, "mypy.ini"), *TYPED_PACKAGES]
         print("typecheck: mypy", " ".join(TYPED_PACKAGES))
