@@ -110,6 +110,33 @@ def pack_bound(key: bytes) -> np.ndarray:
     return keyops.pack_one(key, WIDTH)
 
 
+def key_encoding_info(chunks: np.ndarray, sample: int = 200_000) -> dict:
+    """Schema-stamped mirror-compression stats for a (sorted) packed-key
+    dataset: what the serving mirror would store per row under the
+    order-preserving prefix/dictionary encoding (docs/compression.md) —
+    the capacity-unlock fields BENCH/MULTICHIP JSONs track across rounds."""
+    from kubebrain_tpu.ops import keys as keyops
+    from kubebrain_tpu.storage.tpu.encode import build_encoding
+
+    stride = max(1, len(chunks) // sample)
+    u8 = keyops.chunks_to_u8(np.asarray(chunks[::stride]))
+    w = u8.shape[1]
+    nz = (u8[:, ::-1] != 0).argmax(axis=1)
+    lens = np.where((u8 != 0).any(axis=1), w - nz, 0).astype(np.int64)
+    enc = build_encoding(u8, lens, raw_width=w)
+    enc_w = enc.width if enc is not None else w
+    # per-row device bytes: key column + rev hi/lo (8B) + tomb/ttl flags (2B)
+    return {
+        "schema": "kubebrain-keyenc/v1",
+        "raw_key_bytes_per_row": w,
+        "encoded_key_bytes_per_row": enc_w,
+        "mirror_bytes_per_row": enc_w + 10,
+        "raw_mirror_bytes_per_row": w + 10,
+        "key_compression_ratio": round(w / enc_w, 3),
+        "dict_entries": len(enc.boundaries) if enc is not None else 0,
+    }
+
+
 def cpu_scan(chunks, rh, rl, tomb, start, end, qhi, qlo) -> int:
     """The same visibility algorithm, vectorized numpy (CPU baseline)."""
     def lex_less(keys, bound):
@@ -1137,6 +1164,25 @@ def bench_cluster() -> None:
     }))
 
 
+#: timed serve passes per measurement point in multichip_phase — the
+#: fastest pass is reported (least cross-process interference on shared
+#: CPU boxes; on a quiet TPU host the passes agree within noise)
+_SERVE_PASSES = 3
+
+
+def _serve_best(serve_fn, sched):
+    """Best-of-N timed serves: every pass must return identical results
+    (asserted — a best-of measurement must not hide a divergence)."""
+    best = None
+    for _ in range(_SERVE_PASSES):
+        results, rows, dt = serve_fn(sched)
+        if best is not None:
+            assert results == best[0], "serve passes diverged"
+        if best is None or dt < best[2]:
+            best = (results, rows, dt)
+    return best
+
+
 def multichip_phase(mesh_sizes, n_keys=20_000, n_req=64, depth=4, batch=8,
                     partitions=0, use_pallas=None, threads=8):
     """Serve the SAME scan workload through the request scheduler over the
@@ -1200,6 +1246,37 @@ def multichip_phase(mesh_sizes, n_keys=20_000, n_req=64, depth=4, batch=8,
     }
     baseline_fps = None
     kernel = None
+
+    def _serve(sched):
+        results: list = [None] * n_req
+        rows = [0] * n_req
+        pending = iter(range(n_req))
+        lock = threading.Lock()
+
+        def worker():
+            while True:
+                with lock:
+                    try:
+                        i = next(pending)
+                    except StopIteration:
+                        return
+                kind, s, e = reqs[i]
+                if kind == "count":
+                    res = sched.count(s, e, client=f"c{i % 4}")
+                    rows[i] = res[0]
+                else:
+                    res = sched.list_(s, e, 0, 0, client=f"c{i % 4}")
+                    rows[i] = len(res.kvs)
+                results[i] = fingerprint(kind, res)
+
+        pool = [threading.Thread(target=worker) for _ in range(threads)]
+        t0 = time.monotonic()
+        for t in pool:
+            t.start()
+        for t in pool:
+            t.join()
+        return results, rows, time.monotonic() - t0
+
     try:
         for ndev in mesh_sizes:
             mesh = make_mesh(n_devices=ndev)
@@ -1219,36 +1296,23 @@ def multichip_phase(mesh_sizes, n_keys=20_000, n_req=64, depth=4, batch=8,
                     expect.append(fingerprint(kind, backend.list_(s, e)))
             report["mirror_partitions"][str(ndev)] = \
                 backend.scanner._mirror.partitions
+            # mirror-compression capacity unlock (kubebrain-keyenc/v1):
+            # identical at every mesh size — one dictionary, sharded rows
+            report["key_encoding"] = {
+                "schema": "kubebrain-keyenc/v1",
+                **backend.scanner.encoding_stats()}
+            report["mirror_bytes_per_row"] = \
+                report["key_encoding"].get("mirror_bytes_per_row", 0.0)
+            report["key_compression_ratio"] = \
+                report["key_encoding"].get("key_compression_ratio", 1.0)
 
-            results: list = [None] * n_req
-            rows = [0] * n_req
-            pending = iter(range(n_req))
-            lock = threading.Lock()
-
-            def worker():
-                while True:
-                    with lock:
-                        try:
-                            i = next(pending)
-                        except StopIteration:
-                            return
-                    kind, s, e = reqs[i]
-                    if kind == "count":
-                        res = sched.count(s, e, client=f"c{i % 4}")
-                        rows[i] = res[0]
-                    else:
-                        res = sched.list_(s, e, 0, 0, client=f"c{i % 4}")
-                        rows[i] = len(res.kvs)
-                    results[i] = fingerprint(kind, res)
-
+            # warm serve off the clock: the timed pass must not pay the
+            # Q-gridded batch kernel's first compile (the sequential oracle
+            # above never launches it — it only warms the single-query path)
+            _serve(sched)
+            batched0 = sched.batched  # cumulative — report the timed delta
             b0, _ = TRANSFER_METER.snapshot()
-            pool = [threading.Thread(target=worker) for _ in range(threads)]
-            t0 = time.monotonic()
-            for t in pool:
-                t.start()
-            for t in pool:
-                t.join()
-            dt = time.monotonic() - t0
+            results, rows, dt = _serve_best(_serve, sched)
             b1, _ = TRANSFER_METER.snapshot()
 
             mism = sum(1 for a, b in zip(results, expect) if a != b)
@@ -1260,9 +1324,33 @@ def multichip_phase(mesh_sizes, n_keys=20_000, n_req=64, depth=4, batch=8,
             elif expect != baseline_fps:
                 report["byte_identical"] = False
             report["rows_per_sec"][str(ndev)] = round(sum(rows) / dt)
-            report["batched_riders"][str(ndev)] = sched.batched
+            report["batched_riders"][str(ndev)] = round(
+                (sched.batched - batched0) / _SERVE_PASSES)
             report["host_transfer_bytes_per_req"][str(ndev)] = round(
-                (b1 - b0) / n_req)
+                (b1 - b0) / n_req / _SERVE_PASSES)
+            backend.close()
+
+        # RAW-mirror control at the smallest mesh: the prefix-encoded scan
+        # must serve at equal-or-better p50 than the raw layout it
+        # replaces (byte-identity asserted against the same oracle)
+        if report["key_encoding"].get("encoded"):
+            mesh = make_mesh(n_devices=mesh_sizes[0])
+            kw = {} if use_pallas is None else {"use_pallas": use_pallas}
+            store = TpuKvStorage(inner, mesh=mesh, partitions=partitions,
+                                 encode_keys=False, **kw)
+            backend = Backend(store, BackendConfig(event_ring_capacity=8192))
+            sched = ensure_scheduler(
+                backend, SchedConfig(depth=depth, batch=batch))
+            for kind, s, e in reqs:  # publish + compile off the clock
+                backend.count(s, e) if kind == "count" else backend.list_(s, e)
+            _serve(sched)  # warm the batched path off the clock (as above)
+            results, rows, dt = _serve_best(_serve, sched)
+            assert results == baseline_fps, \
+                "raw-control results diverged from the encoded mirror"
+            report["rows_per_sec_raw_control"] = round(sum(rows) / dt)
+            report["encoded_vs_raw"] = round(
+                report["rows_per_sec"][str(mesh_sizes[0])]
+                / max(1, report["rows_per_sec_raw_control"]), 3)
             backend.close()
     finally:
         inner.close()
@@ -1517,6 +1605,10 @@ def main() -> None:
     qlo = np.uint32(read_rev & np.uint64(0xFFFFFFFF))
     print(f"[bench] dataset: {n_keys} keys x {revs} revs = {n} rows "
           f"({chunks.nbytes/1e9:.2f} GB keys) in {time.time()-t0:.1f}s", file=sys.stderr)
+    keyenc_info = key_encoding_info(chunks)
+    print(f"[bench] key encoding: {keyenc_info['encoded_key_bytes_per_row']}B/row "
+          f"vs {keyenc_info['raw_key_bytes_per_row']}B raw = "
+          f"{keyenc_info['key_compression_ratio']}x", file=sys.stderr)
 
     # ---- CPU baseline (vectorized numpy, same algorithm)
     t0 = time.time()
@@ -1905,6 +1997,11 @@ def main() -> None:
             "cpu_numpy_rows_per_sec": round(cpu_rate),
             "device": str(dev),
             "kernel": "pallas" if use_pallas else "jnp",
+            # mirror-compression capacity unlock on this dataset's keyspace
+            # (kubebrain-keyenc/v1; tracked across BENCH rounds)
+            "mirror_bytes_per_row": keyenc_info["mirror_bytes_per_row"],
+            "key_compression_ratio": keyenc_info["key_compression_ratio"],
+            "key_encoding": keyenc_info,
             **({"stage_breakdown": stage_breakdown,
                 "trace_overhead": round(trace_overhead, 4)}
                if trace_on else {}),

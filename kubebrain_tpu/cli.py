@@ -52,6 +52,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "only): the mirror's 20M-row keyspace shards across "
                         "this many chips so per-chip HBM bounds the dataset; "
                         "0 = every visible device (docs/multichip.md)")
+    p.add_argument("--key-encoding", choices=("encoded", "raw"), default="",
+                   help="mirror key layout (--storage=tpu): 'encoded' = "
+                        "order-preserving prefix/dictionary compression of "
+                        "the device key column (docs/compression.md), "
+                        "'raw' = full-width packed keys; default follows "
+                        "KB_ENCODE_KEYS (encoded)")
     p.add_argument("--scan-partitions", type=int, default=0,
                    help="mirror partition count, decoupled from the mesh "
                         "size (must be a multiple of --mesh-part; each "
@@ -176,6 +182,8 @@ def validate_args(args) -> None:
         raise SystemExit("--mesh-part and --scan-partitions must be >= 0")
     if (mesh_part or scan_parts) and args.storage != "tpu":
         raise SystemExit("--mesh-part/--scan-partitions require --storage=tpu")
+    if getattr(args, "key_encoding", "") and args.storage != "tpu":
+        raise SystemExit("--key-encoding requires --storage=tpu")
     if mesh_part and scan_parts and scan_parts % mesh_part:
         raise SystemExit(
             f"--scan-partitions {scan_parts} must be a multiple of "
@@ -238,6 +246,8 @@ def build_endpoint(args):
             inner_kw = {}
         if args.use_pallas:
             inner_kw["use_pallas"] = True
+        if getattr(args, "key_encoding", ""):
+            inner_kw["encode_keys"] = args.key_encoding == "encoded"
         # multichip sharded serving (docs/multichip.md): an explicit mesh
         # flag builds the partition mesh HERE, so the flag errors surface at
         # boot, not on the first scan; no flags = today's every-device mesh

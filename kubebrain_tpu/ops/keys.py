@@ -67,6 +67,18 @@ def chunks_to_bytes(chunks: np.ndarray, lens: np.ndarray) -> list[bytes]:
     return [out[i, : lens[i]].tobytes() for i in range(len(out))]
 
 
+def u8_void(rows: np.ndarray) -> np.ndarray:
+    """uint8[N, W] → void[N] scalar view: rows compare as raw bytes
+    (memcmp order), so one ``np.searchsorted``/``np.unique`` resolves many
+    key probes at once. Zero-padded NUL-free keys keep the padded compare
+    equal to true byte order — the invariant the whole packed layout
+    (and the encoded layout, storage/tpu/encode.py) rests on."""
+    rows = np.ascontiguousarray(rows)
+    n, w = rows.shape
+    assert w > 0, "void view of zero-width rows"
+    return rows.view(f"V{w}").reshape(n)
+
+
 def gather_arena(arena: np.ndarray, offsets: np.ndarray, perm: np.ndarray):
     """Reorder variable-length records of a byte arena by ``perm``.
 

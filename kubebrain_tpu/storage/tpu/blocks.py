@@ -23,13 +23,18 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec
 
 from ...ops import keys as keyops
+from .encode import EncodeOverflow, KeyEncoding, build_encoding
 
 TTL_PREFIX = b"/events/"
 
 
 @dataclass
 class Mirror:
-    # device (sharded over "part" on axis 0)
+    # device (sharded over "part" on axis 0). With a live ``encoding`` the
+    # key columns hold ENCODED rows (storage/tpu/encode.py: code chunk +
+    # stripped suffix, C' << C chunks) whose lexicographic order equals
+    # raw byte order — the kernels compare them unchanged; ``lens_host``
+    # then holds encoded-suffix byte lengths.
     keys_dev: jax.Array     # uint32[P, N, C]
     rh_dev: jax.Array       # uint32[P, N]
     rl_dev: jax.Array       # uint32[P, N]
@@ -47,6 +52,8 @@ class Mirror:
     val_offsets: list[np.ndarray]  # uint64[nv+1]
     snapshot_ts: int
     max_rev: int
+    key_width: int = 0              # RAW packed key width (bytes)
+    encoding: KeyEncoding | None = None
 
     @property
     def partitions(self) -> int:
@@ -56,7 +63,16 @@ class Mirror:
     def rows(self) -> int:
         return int(self.n_valid.sum())
 
+    @property
+    def raw_key_width(self) -> int:
+        """RAW packed key width in bytes (the width decoded keys pad to);
+        falls back to the stored chunk width for pre-encoding mirrors."""
+        return self.key_width or self.keys_host.shape[2] * 4
+
     def user_key(self, p: int, i: int) -> bytes:
+        if self.encoding is not None:
+            return self.encoding.decode_one(
+                self.keys_host[p, i], int(self.lens_host[p, i]))
         row = keyops.chunks_to_u8(self.keys_host[p, i : i + 1])[0]
         return row[: int(self.lens_host[p, i])].tobytes()
 
@@ -64,10 +80,23 @@ class Mirror:
         o = self.val_offsets[p]
         return self.val_arena[p][int(o[i]) : int(o[i + 1])].tobytes()
 
+    def decoded_keys(self, p: int, rows: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+        """(raw_u8, raw_lens) for row indices of one partition — the ONE
+        decode funnel (kblint KB116): encoded key bytes only turn back into
+        raw bytes here, sized by the caller's visible-row set."""
+        if self.encoding is not None:
+            return self.encoding.decode_rows(
+                self.keys_host[p][rows], self.lens_host[p][rows])
+        return (keyops.chunks_to_u8(self.keys_host[p][rows]),
+                self.lens_host[p][rows])
+
     def materialize(self, p: int, rows: np.ndarray):
         """Bulk (keys, values, revisions) for sorted row indices of one
-        partition — one vectorized unpack instead of per-row slicing."""
-        keys = keyops.chunks_to_bytes(self.keys_host[p][rows], self.lens_host[p][rows])
+        partition — one vectorized unpack instead of per-row slicing.
+        Decoding (when the mirror is encoded) happens here, for exactly the
+        visible rows — never for the whole mirror."""
+        k_u8, k_lens = self.decoded_keys(p, rows)
+        keys = [k_u8[i, : int(k_lens[i])].tobytes() for i in range(len(k_u8))]
         o = self.val_offsets[p].astype(np.int64)
         arena = self.val_arena[p]
         values = [arena[o[i] : o[i + 1]].tobytes() for i in map(int, rows)]
@@ -82,19 +111,26 @@ class Mirror:
 
     def flat_arrays(self):
         """Valid rows of every partition, concatenated in order:
-        (keys_u8[N, W], lens, revs, tomb, arena, offsets)."""
+        (keys_u8[N, W], lens, revs, tomb, arena, offsets). Always RAW-domain
+        keys — an encoded mirror decodes every valid row here, which is why
+        this path only backs full-rebuild maintenance, never serving."""
         parts_u8, parts_lens, parts_revs, parts_tomb = [], [], [], []
         arenas, lens_list = [], []
         for p in range(self.partitions):
             nv = int(self.n_valid[p])
-            parts_u8.append(keyops.chunks_to_u8(self.keys_host[p, :nv]))
-            parts_lens.append(self.lens_host[p, :nv])
+            k_u8, k_lens = self.decoded_keys(p, np.arange(nv))
+            parts_u8.append(k_u8)
+            parts_lens.append(np.asarray(k_lens, np.int32))
             parts_revs.append(self.revs_host[p, :nv])
             parts_tomb.append(self.tomb_host[p, :nv])
             arenas.append(self.val_arena[p][: int(self.val_offsets[p][nv])])
             o = self.val_offsets[p].astype(np.int64)
             lens_list.append(o[1 : nv + 1] - o[:nv])
-        keys_u8 = np.concatenate(parts_u8) if parts_u8 else np.zeros((0, 4), np.uint8)
+        # empty-mirror fallback: the RAW key width the caller will merge
+        # against, never a hardcoded 4 (a non-default --key-width mirror
+        # used to come back as uint8[0, 4] and poison the rebuild concat)
+        keys_u8 = (np.concatenate(parts_u8) if parts_u8
+                   else np.zeros((0, self.raw_key_width), np.uint8))
         arena = np.concatenate(arenas) if arenas else np.zeros(0, np.uint8)
         row_lens = np.concatenate(lens_list) if lens_list else np.zeros(0, np.int64)
         offsets = np.zeros(len(row_lens) + 1, dtype=np.uint64)
@@ -187,13 +223,22 @@ def build_mirror_from_arrays(
     key_width: int,
     snapshot_ts: int,
     n_parts: int | None = None,
+    encode: bool = False,
 ) -> Mirror:
-    """Sorted row arrays → partitioned, padded, device-resident Mirror.
+    """Sorted RAW row arrays → partitioned, padded, device-resident Mirror.
 
     ``n_parts`` decouples the partition count from the mesh size
     (--scan-partitions): P must be a multiple of the mesh's ``part`` axis so
     ``PartitionSpec("part")`` places P//N contiguous partitions per device.
-    Default: one partition per mesh device."""
+    Default: one partition per mesh device.
+
+    ``encode=True`` builds an order-preserving prefix dictionary from the
+    snapshot keys (storage/tpu/encode.py) and stores ENCODED rows — the
+    device key column shrinks from ``key_width`` to ``encoding.width``
+    bytes per row while every kernel compare stays byte-order-exact.
+    Partition borders, TTL flags, and the user-key-aligned split are
+    computed from the RAW keys (encoded order equals raw order, so the
+    split is identical either way)."""
     if n_parts is None:
         n_parts = int(np.prod(mesh.devices.shape)) if mesh is not None else 1
     n = len(keys_u8)
@@ -201,6 +246,15 @@ def build_mirror_from_arrays(
         padded = np.zeros((n, key_width), dtype=np.uint8)
         padded[:, : keys_u8.shape[1]] = keys_u8[:, :key_width]
         keys_u8 = padded
+
+    encoding = build_encoding(keys_u8, lens, raw_width=key_width) \
+        if (encode and n) else None
+    if encoding is not None:
+        # cannot overflow: the dictionary was built from these very keys
+        store_u8, store_lens = encoding.encode_keys(keys_u8, lens)
+        store_width = encoding.width
+    else:
+        store_u8, store_lens, store_width = keys_u8, lens, key_width
 
     # user-key-aligned balanced split offsets (vectorized boundary detect)
     if n:
@@ -217,7 +271,7 @@ def build_mirror_from_arrays(
     counts = [splits[i + 1] - splits[i] for i in range(n_parts)]
     n_max = padded_capacity(max(counts) if counts else 0)
 
-    c = key_width // 4
+    c = store_width // 4
     keys_h = np.zeros((n_parts, n_max, c), dtype=np.uint32)
     lens_h = np.zeros((n_parts, n_max), dtype=np.int32)
     revs_h = np.zeros((n_parts, n_max), dtype=np.uint64)
@@ -231,11 +285,11 @@ def build_mirror_from_arrays(
         lo, hi = splits[p], splits[p + 1]
         nv = hi - lo
         if nv:
-            keys_h[p, :nv] = keyops.bytes_to_chunks(keys_u8[lo:hi])
-            lens_h[p, :nv] = lens[lo:hi]
+            keys_h[p, :nv] = keyops.bytes_to_chunks(store_u8[lo:hi])
+            lens_h[p, :nv] = store_lens[lo:hi]
             revs_h[p, :nv] = revs[lo:hi]
             tomb_h[p, :nv] = tomb[lo:hi]
-            pref = keys_u8[lo:hi, : len(ttl_pref)]
+            pref = keys_u8[lo:hi, : len(ttl_pref)]  # TTL flag: RAW prefix
             ttl_h[p, :nv] = (pref == ttl_pref).all(axis=1) & (lens[lo:hi] >= len(ttl_pref))
         arenas.append(arena[off64[lo] : off64[hi]].copy())
         offs.append((off64[lo : hi + 1] - off64[lo]).astype(np.uint64))
@@ -258,6 +312,7 @@ def build_mirror_from_arrays(
         n_valid=n_valid, val_arena=arenas, val_offsets=offs,
         snapshot_ts=snapshot_ts,
         max_rev=int(revs.max()) if n else 0,
+        key_width=key_width, encoding=encoding,
     )
 
 
@@ -267,11 +322,12 @@ def build_mirror(
     key_width: int,
     snapshot_ts: int,
     n_parts: int | None = None,
+    encode: bool = False,
 ) -> Mirror:
     """Python-row convenience path (tests / generic engines)."""
     return build_mirror_from_arrays(
         *rows_to_arrays(rows, key_width), mesh, key_width, snapshot_ts,
-        n_parts=n_parts,
+        n_parts=n_parts, encode=encode,
     )
 
 
@@ -368,10 +424,15 @@ def merge_partitions_incremental(
         rows_p = np.nonzero(row_part == p)[0]
         lo, hi = rows_p[0], rows_p[-1] + 1  # contiguous: delta is sorted
         nv = int(n_valid[p])
-        part_u8 = keyops.chunks_to_u8(mirror.keys_host[p, :nv])
+        # the merge runs in the RAW domain: decode the dirty partition (it
+        # is the only one paying the cost), merge with the raw delta, then
+        # re-encode against the PUBLISHED dictionary — a delta key that no
+        # longer fits (wrong bucket strip / suffix past the width budget)
+        # falls back to the full re-dictionary rebuild
+        part_u8, part_lens = mirror.decoded_keys(p, np.arange(nv))
         o = mirror.val_offsets[p].astype(np.int64)
         part = (
-            part_u8, mirror.lens_host[p, :nv], mirror.revs_host[p, :nv],
+            part_u8, np.asarray(part_lens, np.int32), mirror.revs_host[p, :nv],
             mirror.tomb_host[p, :nv],
             mirror.val_arena[p][: o[nv]], mirror.val_offsets[p][: nv + 1],
         )
@@ -384,10 +445,18 @@ def merge_partitions_incremental(
         mn = len(mk)
         if mn > cap:
             return None  # overflow: rebalance via full rebuild
-        keys_h[p, :mn] = keyops.bytes_to_chunks(
-            np.ascontiguousarray(mk[:, :key_width])
-        )
-        lens_h[p, :mn] = ml
+        if mirror.encoding is not None:
+            try:
+                enc_u8, enc_lens = mirror.encoding.encode_keys(mk, ml)
+            except EncodeOverflow:
+                return None  # suffix-width budget overflow: re-dictionary
+            keys_h[p, :mn] = keyops.bytes_to_chunks(enc_u8)
+            lens_h[p, :mn] = enc_lens
+        else:
+            keys_h[p, :mn] = keyops.bytes_to_chunks(
+                np.ascontiguousarray(mk[:, :key_width])
+            )
+            lens_h[p, :mn] = ml
         revs_h[p, :mn] = mr
         tomb_h[p, :mn] = mt
         n_valid[p] = mn
@@ -422,4 +491,5 @@ def merge_partitions_incremental(
         n_valid=n_valid, val_arena=arenas, val_offsets=offs,
         snapshot_ts=snapshot_ts,
         max_rev=max(mirror.max_rev, int(d_revs.max())),
+        key_width=mirror.key_width, encoding=mirror.encoding,
     )

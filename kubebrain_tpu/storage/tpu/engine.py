@@ -305,6 +305,19 @@ def _survivor_indices(mask, nv, size):
     return idx
 
 
+def _resolve_key_encoding(encode_keys: bool | None) -> bool:
+    """Flag/env resolution for the order-preserving key encoding
+    (storage/tpu/encode.py). Default ON: the encoded mirror is
+    byte-identical to the raw one by construction (shared materialization
+    funnel) and the key column is the HBM bound on dataset size;
+    KB_ENCODE_KEYS=0 / --key-encoding=raw opts back into the raw layout."""
+    if encode_keys is not None:
+        return encode_keys
+    import os
+
+    return os.environ.get("KB_ENCODE_KEYS", "1").lower() not in ("0", "false", "no")
+
+
 def _resolve_scan_kernel(use_pallas: bool | None) -> str:
     """Flag/env resolution for the scan kernel choice. Mosaic lowering needs
     a real TPU backend; everywhere else the Pallas path runs interpreted
@@ -379,6 +392,7 @@ class TpuScanner(Scanner):
         host_limit_threshold: int = 1024,
         use_pallas: bool | None = None,
         partitions: int = 0,
+        encode_keys: bool | None = None,
     ):
         super().__init__(store, get_compact_revision, retry_min_revision, compact_history, max_workers)
         self._mesh = mesh if mesh is not None else make_mesh()
@@ -395,6 +409,7 @@ class TpuScanner(Scanner):
         self._merge_threshold = merge_threshold
         self._host_limit_threshold = host_limit_threshold
         self._scan_kernel = _resolve_scan_kernel(use_pallas)
+        self._encode = _resolve_key_encoding(encode_keys)
         # static mesh arg for the kernel dispatch: only the Pallas path needs
         # it (shard_map); None keeps the jnp path's jit cache key mesh-free
         self._kernel_mesh = self._mesh if self._scan_kernel != "jnp" else None
@@ -411,7 +426,10 @@ class TpuScanner(Scanner):
         """Per-shard HBM accounting: a ``kb_mirror_bytes{device=}`` callback
         gauge per mesh device, sampled at scrape time from the live mirror's
         addressable shards — makes the "per-chip HBM bounds the dataset, not
-        the whole mirror" claim observable on /metrics."""
+        the whole mirror" claim observable on /metrics. The companion
+        ``kb_mirror_raw_bytes{device=}`` gauge reports what the SAME shard
+        would cost with raw (un-encoded) keys, so the prefix-encoding HBM
+        saving is scrape-visible as a ratio of the two series."""
         if metrics is None or self._mesh is None:
             return
         for d in self._mesh.devices.flat:
@@ -420,10 +438,18 @@ class TpuScanner(Scanner):
                 functools.partial(self._mirror_device_bytes, str(d)),
                 device=str(d),
             )
+            metrics.register_gauge_fn(
+                "kb.mirror.raw.bytes",
+                functools.partial(self._mirror_device_bytes, str(d), True),
+                device=str(d),
+            )
 
-    def _mirror_device_bytes(self, device: str) -> float:
+    def _mirror_device_bytes(self, device: str,
+                             raw_equivalent: bool = False) -> float:
         """Bytes of mirror columns resident on ``device`` (shard metadata
-        only — sampling never copies device data)."""
+        only — sampling never copies device data). ``raw_equivalent``
+        rescales the key column to the raw packed width, i.e. the bytes an
+        un-encoded mirror of the same rows would hold."""
         mirror = self._mirror
         if mirror is None:
             return 0.0
@@ -432,8 +458,44 @@ class TpuScanner(Scanner):
                     mirror.tomb_dev, mirror.ttl_dev, mirror.n_valid_dev):
             for s in getattr(arr, "addressable_shards", ()):
                 if str(s.device) == device:
-                    total += int(s.data.size) * s.data.dtype.itemsize
+                    nbytes = int(s.data.size) * s.data.dtype.itemsize
+                    if (raw_equivalent and arr is mirror.keys_dev
+                            and mirror.encoding is not None):
+                        nbytes = (nbytes // mirror.encoding.chunks
+                                  * (mirror.raw_key_width // 4))
+                    total += nbytes
         return float(total)
+
+    def encoding_stats(self) -> dict:
+        """Mirror footprint of the PUBLISHED mirror for bench reports:
+        per-row device bytes and the key-compression ratio (raw packed key
+        bytes / stored key bytes; 1.0 when the mirror is raw)."""
+        mirror = self._mirror
+        if mirror is None:
+            return {}
+        rows = mirror.rows
+        stored_w = mirror.keys_host.shape[2] * 4
+        per_row = stored_w + 8 + 2  # key chunks + rev hi/lo + tomb/ttl flags
+        cap = mirror.keys_host.shape[0] * mirror.keys_host.shape[1]
+        return {
+            "rows": rows,
+            # exact per-valid-row bytes — same definition as
+            # bench.key_encoding_info, so BENCH and MULTICHIP JSONs track
+            # one comparable "mirror_bytes_per_row" series; the padded
+            # variant (includes pow2 partition-capacity rounding) is what
+            # the device actually holds
+            "mirror_bytes_per_row": float(per_row),
+            "mirror_bytes_per_row_padded": round(per_row * cap / rows, 2)
+            if rows else 0.0,
+            "key_bytes_per_row": stored_w,
+            "raw_key_bytes_per_row": mirror.raw_key_width,
+            "key_compression_ratio": round(mirror.raw_key_width / stored_w, 3),
+            "encoded": mirror.encoding is not None,
+            "dict_entries": (len(mirror.encoding.boundaries)
+                             if mirror.encoding is not None else 0),
+            "suffix_width": (mirror.encoding.suffix_width
+                             if mirror.encoding is not None else 0),
+        }
 
     # ------------------------------------------------------------ write feed
     def record_version_rows(self, rows: list[tuple[bytes, int, bytes]]) -> None:
@@ -481,7 +543,7 @@ class TpuScanner(Scanner):
         if arrays is not None:
             self._mirror = build_mirror_from_arrays(
                 *arrays, self._mesh, self._kw, snapshot,
-                n_parts=self._partitions or None,
+                n_parts=self._partitions or None, encode=self._encode,
             )
         else:
             rows: list[tuple[bytes, int, bytes]] = []
@@ -490,7 +552,8 @@ class TpuScanner(Scanner):
                 if rev != 0:
                     rows.append((ukey, rev, value))
             self._mirror = build_mirror(rows, self._mesh, self._kw, snapshot,
-                                        n_parts=self._partitions or None)
+                                        n_parts=self._partitions or None,
+                                        encode=self._encode)
         self._delta = _DeltaIndex()
         self._force_rebuild = False
         self._pallas_cache = None  # old mirror's device copies must not pin
@@ -510,9 +573,13 @@ class TpuScanner(Scanner):
             self._mirror, sorted_delta, self._mesh, self._kw, ts
         )
         if m is None:
+            # full re-dictionary rebuild: flat_arrays decodes to RAW rows,
+            # merge there, and build_mirror_from_arrays derives a FRESH
+            # dictionary sized to the merged keyspace
             merged = merge_sorted_arrays(self._mirror.flat_arrays(), sorted_delta)
             m = build_mirror_from_arrays(*merged, self._mesh, self._kw, ts,
-                                         n_parts=self._partitions or None)
+                                         n_parts=self._partitions or None,
+                                         encode=self._encode)
         self._mirror = m
         self._delta = _DeltaIndex()
         self._pallas_cache = None  # re-layout lazily on the next pallas query
@@ -524,11 +591,27 @@ class TpuScanner(Scanner):
         self._ensure_published(full=True)
 
     # -------------------------------------------------------------- queries
-    def _query_bounds(self, start: bytes, end: bytes):
-        s = jnp.asarray(keyops.pack_one(keyops.canonicalize_bound(start), self._kw))
-        unbounded = not end
-        e = jnp.asarray(keyops.pack_one(keyops.canonicalize_bound(end) if end else b"", self._kw))
-        return s, e, jnp.asarray(unbounded)
+    def _bound_rows(self, mirror: Mirror, start: bytes, end: bytes):
+        """Packed numpy bound rows in the MIRROR'S compare domain — raw
+        chunks for a raw mirror, dictionary-encoded bounds for an encoded
+        one (encode.KeyEncoding.encode_*_bound: exact by the bound-mapping
+        proof, so kernels compare them against encoded rows unchanged).
+        The one packing point the single and query-batched paths share."""
+        encoding = mirror.encoding if mirror is not None else None
+        if encoding is not None:
+            enc_s = encoding.encode_start_bound(keyops.canonicalize_bound(start))
+            enc_e = (encoding.encode_end_bound(keyops.canonicalize_bound(end))
+                     if end else np.zeros(encoding.width, np.uint8))
+            return (keyops.bytes_to_chunks(enc_s[None])[0],
+                    keyops.bytes_to_chunks(enc_e[None])[0], not end)
+        s_row = keyops.pack_one(keyops.canonicalize_bound(start), self._kw)
+        e_row = keyops.pack_one(
+            keyops.canonicalize_bound(end) if end else b"", self._kw)
+        return s_row, e_row, not end
+
+    def _query_bounds(self, mirror: Mirror, start: bytes, end: bytes):
+        s_row, e_row, unbounded = self._bound_rows(mirror, start, end)
+        return jnp.asarray(s_row), jnp.asarray(e_row), jnp.asarray(unbounded)
 
     def _shard_put(self, arr):
         if self._mesh is None:
@@ -578,7 +661,7 @@ class TpuScanner(Scanner):
         """Visibility (mask [P, N] device array, counts [P]) through the
         selected kernel — the one assembly point so count/range/stream can't
         diverge and can't silently miss the kernel dispatch."""
-        s, e, unb = self._query_bounds(start, end)
+        s, e, unb = self._query_bounds(mirror, start, end)
         qhi, qlo = keyops.split_revs(np.array([read_rev], dtype=np.uint64))
         qhi, qlo = jnp.asarray(qhi[0]), jnp.asarray(qlo[0])
         if self._scan_kernel == "jnp":
@@ -609,15 +692,12 @@ class TpuScanner(Scanner):
         while qpad < q:
             qpad *= 2
         padded = list(specs) + [specs[0]] * (qpad - q)
-        starts = np.stack([
-            keyops.pack_one(keyops.canonicalize_bound(s), self._kw)
-            for s, _e, _r in padded
-        ])
-        ends = np.stack([
-            keyops.pack_one(keyops.canonicalize_bound(e) if e else b"", self._kw)
-            for _s, e, _r in padded
-        ])
-        unbs = np.array([not e for _s, e, _r in padded])
+        # per-query bounds through the SAME packing point as the single
+        # path (`_bound_rows`): raw or dictionary-encoded per the mirror
+        rows = [self._bound_rows(mirror, s, e) for s, e, _r in padded]
+        starts = np.stack([r[0] for r in rows])
+        ends = np.stack([r[1] for r in rows])
+        unbs = np.array([r[2] for r in rows])
         qhi, qlo = keyops.split_revs(
             np.array([r for _s, _e, r in padded], dtype=np.uint64))
         if self._scan_kernel == "jnp":
@@ -893,28 +973,32 @@ class TpuScanner(Scanner):
         return total
 
     def _probe_views(self, mirror: Mirror) -> list:
-        """Per-partition void views of the packed key bytes (valid rows
-        only), identity-cached per mirror like `_pallas_layout`: void rows
-        compare as raw bytes, so one np.searchsorted resolves every probe
-        of a partition at once."""
+        """Per-partition void views of the STORED key bytes (valid rows
+        only, raw or encoded per the mirror), identity-cached per mirror
+        like `_pallas_layout`: void rows compare as raw bytes, so one
+        np.searchsorted resolves every probe of a partition at once."""
         cached = self._probe_cache
         if cached is not None and cached[0] is mirror:
             return cached[1]
+        w = mirror.keys_host.shape[2] * 4
         views = []
         for p in range(mirror.partitions):
             nv = int(mirror.n_valid[p])
             if nv == 0:
-                views.append(np.empty(0, dtype=f"V{self._kw}"))
+                views.append(np.empty(0, dtype=f"V{w}"))
                 continue
-            u8 = keyops.chunks_to_u8(mirror.keys_host[p, :nv])
-            views.append(np.ascontiguousarray(u8).view(f"V{self._kw}").reshape(-1))
+            views.append(keyops.u8_void(
+                keyops.chunks_to_u8(mirror.keys_host[p, :nv])))
         self._probe_cache = (mirror, views)
         return views
 
     def _host_visible_batch(self, mirror: Mirror, ukeys: list, read_rev: int) -> list:
         """Vectorized `_host_visible` over many keys: group probes by
         partition, one searchsorted pass per partition against the cached
-        byte view, then a per-group (short, ascending) revision pick."""
+        byte view (probes enter the mirror's compare domain — encoded
+        probes for an encoded mirror; a key the dictionary cannot express
+        is absent from the mirror by construction), then a per-group
+        (short, ascending) revision pick."""
         if not ukeys:
             return []
         views = self._probe_views(mirror)
@@ -922,15 +1006,24 @@ class TpuScanner(Scanner):
         for j, uk in enumerate(ukeys):
             by_part.setdefault(self._partition_of(mirror, uk), []).append(j)
         out = [False] * len(ukeys)
+        encoding = mirror.encoding
         for p, idxs in by_part.items():
             view = views[p]
             if view.shape[0] == 0:
                 continue
-            probes_u8 = keyops.chunks_to_u8(np.stack([
-                keyops.pack_one(ukeys[j], self._kw) for j in idxs
-            ]))
-            probes = np.ascontiguousarray(probes_u8).view(
-                f"V{self._kw}").reshape(-1)
+            if encoding is not None:
+                enc_probes = [(j, encoding.encode_probe(ukeys[j])) for j in idxs]
+                idxs = [j for j, pb in enc_probes if pb is not None]
+                if not idxs:
+                    continue  # none of these keys is expressible → absent
+                probes_u8 = np.stack([
+                    np.frombuffer(pb, np.uint8)
+                    for _j, pb in enc_probes if pb is not None])
+            else:
+                probes_u8 = keyops.chunks_to_u8(np.stack([
+                    keyops.pack_one(ukeys[j], self._kw) for j in idxs
+                ]))
+            probes = keyops.u8_void(probes_u8)
             lo = np.searchsorted(view, probes, side="left")
             hi = np.searchsorted(view, probes, side="right")
             revs = mirror.revs_host[p]
@@ -1029,7 +1122,7 @@ class TpuScanner(Scanner):
         s_user = coder.decode(start)[0] if coder.is_internal_key(start) else b""
         unbounded = not coder.is_internal_key(end)
         e_user = b"" if unbounded else coder.decode(end)[0]
-        s, e, unb = self._query_bounds(s_user, e_user)
+        s, e, unb = self._query_bounds(mirror, s_user, e_user)
         chi, clo = keyops.split_revs(np.array([compact_revision], dtype=np.uint64))
         thi, tlo = keyops.split_revs(np.array([ttl_cutoff], dtype=np.uint64))
         if self._scan_kernel == "jnp":
@@ -1067,8 +1160,11 @@ class TpuScanner(Scanner):
                 continue
             pmask = mask[p][:nv]
             keys_p = mirror.keys_host[p, :nv]
-            k_u8_all = keyops.chunks_to_u8(keys_p)
-            lens_all = mirror.lens_host[p, :nv]
+            # RAW key bytes: the store deletes below and the surviving-row
+            # rebuild both speak raw; version-chain grouping stays on the
+            # stored rows (encoded equality == raw equality — injective)
+            k_u8_all, lens_all = mirror.decoded_keys(p, np.arange(nv))
+            lens_all = np.asarray(lens_all, np.int32)
             revs_all = mirror.revs_host[p, :nv]
             tomb_all = mirror.tomb_host[p, :nv]
             # group structure (one group = one user key's version chain)
@@ -1114,17 +1210,22 @@ class TpuScanner(Scanner):
                     tomb_all[d_last].astype(np.uint8),
                 ))
             else:
+                # k_u8_all/lens_all already hold the decoded partition —
+                # slice them instead of re-decoding one row at a time
+                # through mirror.user_key
                 for i in victims:
                     i = int(i)
+                    uk = k_u8_all[i, : int(lens_all[i])].tobytes()
                     pending.append(
-                        coder.encode_object_key(mirror.user_key(p, i), int(revs_all[i]))
+                        coder.encode_object_key(uk, int(revs_all[i]))
                     )
                 for j, g in enumerate(dg):
                     li = int(d_last[j])
                     raw = coder.encode_rev_value(
                         int(d_rev[j]), deleted=bool(tomb_all[li])
                     )
-                    uk = mirror.user_key(p, int(group_starts[int(g)]))
+                    fi = int(group_starts[int(g)])
+                    uk = k_u8_all[fi, : int(lens_all[fi])].tobytes()
                     try:
                         store.del_current(coder.encode_revision_key(uk), raw)
                         stats.deleted_rev_records += 1
@@ -1185,7 +1286,7 @@ class TpuScanner(Scanner):
                 self._mirror = build_mirror_from_arrays(
                     *merged, self._mesh, self._kw,
                     self._store.get_timestamp_oracle(),
-                    n_parts=self._partitions or None,
+                    n_parts=self._partitions or None, encode=self._encode,
                 )
                 self._delta = _DeltaIndex()
                 self._pallas_cache = None
@@ -1348,10 +1449,12 @@ class _TrackedBatch(BatchWrite):
 
 def _tpu_factory(inner: str = "memkv", mesh=None, key_width: int = keyops.KEY_WIDTH,
                  use_pallas: bool | None = None, partitions: int = 0,
-                 **inner_kw) -> TpuKvStorage:
+                 encode_keys: bool | None = None, **inner_kw) -> TpuKvStorage:
     from .. import new_storage
 
     scanner_kw = {} if use_pallas is None else {"use_pallas": use_pallas}
+    if encode_keys is not None:
+        scanner_kw["encode_keys"] = encode_keys
     return TpuKvStorage(
         new_storage(inner, **inner_kw), mesh=mesh, key_width=key_width,
         partitions=partitions, **scanner_kw

@@ -1,0 +1,553 @@
+"""Differential tests for the order-preserving prefix/dictionary key
+encoding (storage/tpu/encode.py): the encoded mirror must serve
+Range/Count/stream/scan_batch BYTE-IDENTICALLY to the raw mirror it
+replaces — under live delta overlays, head and snapshot reads, adversarial
+bounds, both kernels, and multichip partitioning.
+
+Layers, bottom-up:
+
+- pure encoding: order preservation, encode/decode round-trip, and the
+  bound-mapping proof — for every mirror key ``k`` and every bound ``b``,
+  ``raw_compare(k, b) == encoded_compare(enc(k), enc_bound(b))``, i.e.
+  visibility is never widened or narrowed (the machine-checked form of the
+  case analysis in ``KeyEncoding._encode_bound``);
+- engine differential: an encoded and a raw backend over the SAME host
+  store, random op streams with tombstone chains, overlays, republish,
+  full re-dictionary rebuild on suffix-budget overflow;
+- kernel differential: pallas-interpret vs jnp on the encoded mirror;
+- multichip: P=N and P=2N encoded partitions, byte identity across mesh
+  sizes, partitions stay user-key-aligned.
+
+Runs on the 8-device virtual CPU mesh (conftest.py).
+"""
+
+import numpy as np
+import pytest
+
+from kubebrain_tpu.backend import Backend, BackendConfig
+from kubebrain_tpu.ops import keys as keyops
+from kubebrain_tpu.parallel.mesh import make_mesh
+from kubebrain_tpu.storage import new_storage
+from kubebrain_tpu.storage.tpu import blocks
+from kubebrain_tpu.storage.tpu.encode import (
+    CODE_BYTES,
+    EncodeOverflow,
+    build_encoding,
+)
+from kubebrain_tpu.storage.tpu.engine import TpuKvStorage
+
+WIDTH = keyops.KEY_WIDTH
+
+
+# --------------------------------------------------------------------- helpers
+def pack(keys, width=WIDTH):
+    """list[bytes] → (u8[N, width] zero-padded, lens int64[N])."""
+    u8 = np.zeros((len(keys), width), dtype=np.uint8)
+    lens = np.zeros(len(keys), dtype=np.int64)
+    for i, k in enumerate(keys):
+        u8[i, : len(k)] = np.frombuffer(k, np.uint8)
+        lens[i] = len(k)
+    return u8, lens
+
+
+def kube_keys(rng, n, namespaces=7, kinds=("pods", "services", "endpoints")):
+    """Sorted unique kube-shaped keys: /registry/<kind>/<ns>/<name>."""
+    out = set()
+    while len(out) < n:
+        kind = kinds[rng.integers(len(kinds))]
+        ns = b"ns-%02d" % rng.integers(namespaces)
+        name = rng.choice(np.frombuffer(b"abcdefghijk-0123456789", np.uint8),
+                          size=rng.integers(3, 24)).tobytes()
+        out.add(b"/registry/%s/%s/%s" % (kind.encode(), ns, name))
+    return sorted(out)
+
+
+def fixed_geq(rows_u8, bound_u8):
+    """Vectorized fixed-width lexicographic ``rows >= bound`` over uint8
+    rows — the compare the kernels compute on packed chunks."""
+    n, w = rows_u8.shape
+    assert bound_u8.shape == (w,)
+    neq = rows_u8 != bound_u8[None, :]
+    any_neq = neq.any(axis=1)
+    first = neq.argmax(axis=1)
+    gt = rows_u8[np.arange(n), first] > bound_u8[first]
+    return np.where(any_neq, gt, True)
+
+
+def raw_geq(keys_u8, lens, bound, width=WIDTH):
+    """The RAW mirror's compare: zero-padded fixed-width byte order on the
+    canonicalized bound, truncated at the pack width — exactly the single
+    packing point the raw engine uses (keyops.pack_one)."""
+    b = keyops.canonicalize_bound(bound)
+    b_u8 = np.zeros(width, dtype=np.uint8)
+    b_u8[: min(len(b), width)] = np.frombuffer(b[:width], np.uint8)
+    return fixed_geq(keys_u8, b_u8)
+
+
+def enc_geq(encoding, enc_u8, bound):
+    """The ENCODED mirror's compare: the dictionary-encoded bound against
+    encoded rows, same fixed-width byte order."""
+    v = encoding.encode_start_bound(keyops.canonicalize_bound(bound))
+    return fixed_geq(enc_u8, v)
+
+
+def adversarial_bounds(keys, encoding):
+    """Bounds engineered at every edge of the dictionary case analysis."""
+    bounds = [b"", b"/", b"/r", b"\xff", b" ", b"/registry/",
+              b"/registry/pods/", b"/zzz"]
+    for k in keys[:: max(1, len(keys) // 40)]:
+        bounds += [k, k + b"\x00", k + b"!", k[:-1], k[: len(k) // 2],
+                   k + b"z" * 300]          # suffix far past the width budget
+    for j, b in enumerate(encoding.boundaries[:32]):
+        bounds += [b, b[:-1], b + b"!", b + b"\xfe"]
+        if j + 1 < len(encoding.boundaries):
+            nxt = encoding.boundaries[j + 1]
+            mid = b + b"\x01"               # strictly between two entries
+            if b < mid < nxt:
+                bounds.append(mid)
+    for s in encoding.strips[:32]:
+        if s:
+            bounds += [s, s[:-1], s + b"~~~"]
+    return bounds
+
+
+# ------------------------------------------------------------- pure encoding
+def test_encoding_preserves_sort_order():
+    rng = np.random.default_rng(7)
+    keys = kube_keys(rng, 3000)
+    u8, lens = pack(keys)
+    enc = build_encoding(u8, lens, raw_width=WIDTH)
+    assert enc is not None and enc.width <= WIDTH // 3  # random-name keys
+    enc_u8, _sfx = enc.encode_keys(u8, lens)
+    rows = [enc_u8[i].tobytes() for i in range(len(enc_u8))]
+    # input keys are sorted and unique → encoded rows strictly increasing
+    assert all(a < b for a, b in zip(rows, rows[1:]))
+
+
+def test_encode_decode_roundtrip():
+    rng = np.random.default_rng(11)
+    keys = kube_keys(rng, 500)
+    u8, lens = pack(keys)
+    enc = build_encoding(u8, lens, raw_width=WIDTH)
+    enc_u8, sfx = enc.encode_keys(u8, lens)
+    raw, raw_lens = enc.decode_rows(keyops.bytes_to_chunks(enc_u8), sfx)
+    assert (raw_lens == lens).all()
+    assert (raw == u8).all()
+    # single-row decode agrees
+    chunks = keyops.bytes_to_chunks(enc_u8)
+    for i in (0, len(keys) // 2, len(keys) - 1):
+        assert enc.decode_one(chunks[i], int(sfx[i])) == keys[i]
+    # zero-row decode/encode must stay a no-op (an empty partition's
+    # materialize/compact path hits this; regression: the grouped decode
+    # once indexed into an empty code array)
+    raw0, lens0 = enc.decode_rows(chunks[:0], sfx[:0])
+    assert raw0.shape == (0, WIDTH) and len(lens0) == 0
+    enc0, sfx0 = enc.encode_keys(u8[:0], lens[:0])
+    assert enc0.shape == (0, enc.width) and len(sfx0) == 0
+
+
+def test_bound_encoding_never_widens_or_narrows():
+    """The proof test: for every mirror key and every adversarial bound,
+    the encoded-domain compare classifies the key exactly as the raw
+    packed compare does — visibility can neither widen nor narrow, for
+    start (geq) and end (less = not geq) bounds alike."""
+    rng = np.random.default_rng(13)
+    keys = kube_keys(rng, 2000)
+    u8, lens = pack(keys)
+    enc = build_encoding(u8, lens, raw_width=WIDTH)
+    enc_u8, _sfx = enc.encode_keys(u8, lens)
+    for bound in adversarial_bounds(keys, enc):
+        want = raw_geq(u8, lens, bound)
+        got = enc_geq(enc, enc_u8, bound)
+        diff = np.nonzero(want != got)[0]
+        assert diff.size == 0, (
+            f"bound {bound!r}: {diff.size} keys misclassified, "
+            f"first {keys[diff[0]]!r} raw_geq={bool(want[diff[0]])}")
+
+
+def test_encode_probe_exact_match_only():
+    rng = np.random.default_rng(17)
+    keys = kube_keys(rng, 400)
+    u8, lens = pack(keys)
+    enc = build_encoding(u8, lens, raw_width=WIDTH)
+    enc_u8, _sfx = enc.encode_keys(u8, lens)
+    rows = {enc_u8[i].tobytes(): keys[i] for i in range(len(keys))}
+    for i in range(0, len(keys), 37):
+        probe = enc.encode_probe(keys[i])
+        assert probe is not None and rows[probe] == keys[i]
+    # keys no dictionary bucket can express are absent by construction:
+    # probe may be None, or an encoded value matching no mirror row
+    for absent in (b"/other/tree/x", b"/registry/pods/ns-00/" + b"q" * 200):
+        probe = enc.encode_probe(absent)
+        assert probe is None or probe not in rows
+
+
+def test_encode_overflow_on_foreign_keys():
+    rng = np.random.default_rng(19)
+    keys = kube_keys(rng, 200)
+    u8, lens = pack(keys)
+    enc = build_encoding(u8, lens, raw_width=WIDTH)
+    # suffix past the width budget → EncodeOverflow, never silent truncation
+    long_key = keys[0][: keys[0].rindex(b"/") + 1] + b"x" * (enc.suffix_width + 1)
+    with pytest.raises(EncodeOverflow):
+        enc.encode_keys(*pack([long_key]))
+
+
+def test_empty_and_degenerate_dictionaries():
+    # no rows → no encoding
+    assert build_encoding(np.zeros((0, WIDTH), np.uint8),
+                          np.zeros(0, np.int64), raw_width=WIDTH) is None
+    # slash-free keys (no directory structure) → no gain → raw layout
+    u8, lens = pack([b"alpha", b"beta", b"gamma"])
+    assert build_encoding(u8, lens, raw_width=WIDTH) is None
+
+
+def test_kube_workload_compression_at_least_4x():
+    """The acceptance bar: >=4x fewer key bytes per row on the kube-shaped
+    workload-generator keyspace."""
+    rng = np.random.default_rng(23)
+    keys = sorted(
+        b"/registry/pods/ns-%02d/pod-%07d" % (i % 8, i) for i in range(20000))
+    del rng
+    u8, lens = pack(keys)
+    enc = build_encoding(u8, lens, raw_width=WIDTH)
+    assert enc is not None
+    assert WIDTH / enc.width >= 4.0, (WIDTH, enc.width)
+
+
+# ------------------------------------------------------- engine differential
+def make_backend(inner, encode, ndev=8, partitions=0, kernel="jnp",
+                 merge_threshold=8):
+    mesh = make_mesh(n_devices=ndev)
+    store = TpuKvStorage(inner, mesh=mesh, partitions=partitions,
+                         encode_keys=encode)
+    b = Backend(store, BackendConfig(event_ring_capacity=8192))
+    b.scanner._host_limit_threshold = 0   # always the device path
+    b.scanner._merge_threshold = merge_threshold
+    b.scanner._scan_kernel = kernel       # pin: ambient env must not flip
+    b.scanner._kernel_mesh = mesh if kernel != "jnp" else None
+    b.count(b"", b"")                     # publish the preloaded mirror
+    return b
+
+
+def make_pair(inner, ndev=8, partitions=0, kernel="jnp", merge_threshold=8):
+    """(encoded backend, raw backend) over the SAME host engine —
+    read-only differentials (the engine is single-writer: use
+    :func:`make_twin_stores` when the test mutates)."""
+    return [make_backend(inner, encode, ndev, partitions, kernel,
+                         merge_threshold) for encode in (True, False)]
+
+
+def make_twin_stores(n_keys, merge_threshold=8):
+    """Two INDEPENDENT host stores preloaded identically, wrapped encoded
+    and raw — mutation differentials drive the same op stream through
+    both backends, so each exercises its own live delta overlay."""
+    inners, bs, revs = [], [], {}
+    for encode in (True, False):
+        inner = new_storage("memkv")
+        loader = Backend(inner, BackendConfig(event_ring_capacity=65536))
+        for i in range(n_keys):
+            k = b"/registry/pods/ns-%02d/pod-%04d" % (i % 5, i)
+            revs[k] = loader.create(k, b"v%d" % i)
+        loader.close()
+        inners.append(inner)
+        bs.append(make_backend(inner, encode, merge_threshold=merge_threshold))
+    return inners, bs, revs
+
+
+def fp(res):
+    return [(kv.key, kv.value, kv.revision) for kv in res.kvs] + \
+        [(res.revision, res.count, res.more)]
+
+
+def assert_identical(be_enc, be_raw, ranges, revisions=(0,)):
+    assert be_enc.scanner._mirror.encoding is not None
+    assert be_raw.scanner._mirror.encoding is None
+    for rev in revisions:
+        for s, e in ranges:
+            r1, r2 = be_enc.list_(s, e, revision=rev), be_raw.list_(s, e, revision=rev)
+            assert fp(r1) == fp(r2), (s, e, rev)
+            assert be_enc.count(s, e, revision=rev) == be_raw.count(s, e, revision=rev)
+        # streamed reads through the same funnel
+        s, e = ranges[0]
+        _, it1 = be_enc.list_by_stream(s, e)
+        _, it2 = be_raw.list_by_stream(s, e)
+        flat1 = [kv for batch in it1 for kv in batch]
+        flat2 = [kv for batch in it2 for kv in batch]
+        assert [(kv.key, kv.value, kv.revision) for kv in flat1] == \
+            [(kv.key, kv.value, kv.revision) for kv in flat2]
+
+
+RANGES = [
+    (b"/registry/pods/ns-01/", b"/registry/pods/ns-010"),
+    (b"/registry/", b"/registry0"),
+    (b"/registry/pods/ns-01/k", b"/registry/pods/ns-01/q"),
+    (b"/registry/m", b"/registry/z"),       # between dictionary entries
+    (b"/a", b"/b"),                         # below every key
+    (b"/zzz", b"/zzzz"),                    # above every key
+    (b"/registry/pods/", b"/registry/pods/"),  # empty range (start == end)
+    (b"", b""),                             # unbounded
+]
+
+
+def test_differential_overlays_and_snapshots():
+    """Random op stream with tombstone chains driven identically through
+    an encoded and a raw backend (identical preloads → identical revision
+    sequences); byte-for-byte agreement at head and at snapshot revisions,
+    while deltas are live in the overlay AND after republish merges them
+    into the mirror."""
+    rng = np.random.default_rng(29)
+    inners, (be_enc, be_raw), live = make_twin_stores(600, merge_threshold=64)
+    try:
+        snapshots = []
+        for step in range(6):
+            keys = sorted(live)
+            for _ in range(40):
+                op = rng.integers(3)
+                k = keys[rng.integers(len(keys))]
+                if op == 0 and live.get(k):           # update (CAS)
+                    v = b"u%d" % rng.integers(1e6)
+                    r1 = be_enc.update(k, v, live[k])
+                    r2 = be_raw.update(k, v, live[k])
+                elif op == 1 and live.get(k):         # tombstone chain
+                    r1, _ = be_enc.delete(k)
+                    r2, _ = be_raw.delete(k)
+                    live[k] = 0
+                    if rng.integers(2):               # delete → recreate
+                        v = b"r%d" % rng.integers(1e6)
+                        r1 = be_enc.create(k, v)
+                        r2 = be_raw.create(k, v)
+                        live[k] = r1
+                else:                                 # fresh create
+                    k = b"/registry/pods/ns-%02d/new-%06d" % (
+                        rng.integers(5), rng.integers(1e6))
+                    if live.get(k):
+                        continue
+                    r1 = be_enc.create(k, b"n")
+                    r2 = be_raw.create(k, b"n")
+                    live[k] = r1
+                assert r1 == r2                       # identical rev streams
+                if op == 0:
+                    live[k] = r1
+            snapshots.append(be_enc.list_(b"", b"").revision)
+            assert_identical(be_enc, be_raw, RANGES,
+                             revisions=(0, *snapshots[-2:]))
+            if step == 3:
+                # force both to merge their overlays (dirty republish)
+                be_enc.scanner.publish()
+                be_raw.scanner.publish()
+    finally:
+        be_enc.close()
+        be_raw.close()
+        for inner in inners:
+            inner.close()
+
+
+def test_overflow_falls_back_to_full_redictionary():
+    """A delta key whose suffix exceeds the published width budget cannot
+    be re-encoded incrementally — the republish must fall back to the full
+    re-dictionary rebuild and keep serving byte-identically."""
+    inners, (be_enc, be_raw), _revs = make_twin_stores(64, merge_threshold=4)
+    try:
+        enc0 = be_enc.scanner._mirror.encoding
+        assert enc0 is not None
+        # suffix far past the published budget, same directory
+        long_name = b"/registry/pods/ns-00/" + b"x" * (enc0.suffix_width + 40)
+        for b in (be_enc, be_raw):
+            b.create(long_name, b"long")
+            for i in range(8):   # push past merge_threshold → republish
+                b.create(b"/registry/pods/ns-00/extra-%03d" % i, b"v")
+            b.scanner.publish()
+        enc1 = be_enc.scanner._mirror.encoding
+        assert enc1 is not None and enc1 is not enc0
+        assert enc1.suffix_width > enc0.suffix_width
+        assert_identical(be_enc, be_raw, RANGES)
+        got = be_enc.list_(b"/registry/pods/ns-00/x", b"/registry/pods/ns-00/y")
+        assert [kv.key for kv in got.kvs] == [long_name]
+    finally:
+        be_enc.close()
+        be_raw.close()
+        for inner in inners:
+            inner.close()
+
+
+def test_scan_batch_differential():
+    inner = new_storage("memkv")
+    loader = Backend(inner, BackendConfig(event_ring_capacity=16384))
+    for i in range(500):
+        loader.create(b"/registry/pods/ns-%02d/pod-%04d" % (i % 4, i), b"v%d" % i)
+    loader.close()
+    be_enc, be_raw = make_pair(inner)
+    try:
+        head = be_enc.list_(b"", b"").revision
+        specs = []
+        # unbounded (b"", b"") is excluded: scan_batch specs carry explicit
+        # Range bounds (the unbounded shape is covered by assert_identical)
+        for s, e in RANGES[:-1]:
+            specs.append(("range", s, e, head, 0))
+            specs.append(("count", s, e, head))
+        r1 = be_enc.scanner.scan_batch(specs)
+        r2 = be_raw.scanner.scan_batch(specs)
+        assert len(r1) == len(r2)
+        for a, b in zip(r1, r2):
+            assert not isinstance(a, BaseException), a
+            assert not isinstance(b, BaseException), b
+            assert a == b
+    finally:
+        be_enc.close()
+        be_raw.close()
+        inner.close()
+
+
+def test_pallas_interpret_vs_jnp_on_encoded_mirror():
+    """Kernel differential ON the encoded mirror: pallas-interpret and jnp
+    must agree on encoded chunk arrays exactly as they do on raw ones."""
+    inner = new_storage("memkv")
+    loader = Backend(inner, BackendConfig(event_ring_capacity=16384))
+    for i in range(400):
+        loader.create(b"/registry/jobs/ns-%02d/job-%04d" % (i % 3, i), b"j%d" % i)
+    loader.close()
+    be_jnp, _raw = make_pair(inner, kernel="jnp")
+    _raw.close()
+    be_pal, _raw2 = make_pair(inner, kernel="pallas_interpret")
+    _raw2.close()
+    try:
+        assert be_jnp.scanner._mirror.encoding is not None
+        assert be_pal.scanner._mirror.encoding is not None
+        for s, e in RANGES:
+            assert fp(be_jnp.list_(s, e)) == fp(be_pal.list_(s, e)), (s, e)
+            assert be_jnp.count(s, e) == be_pal.count(s, e)
+        head = be_jnp.list_(b"", b"").revision
+        specs = [("range", s, e, head, 0) for s, e in RANGES[:4]]
+        assert be_jnp.scanner.scan_batch(specs) == \
+            be_pal.scanner.scan_batch(specs)
+    finally:
+        be_jnp.close()
+        be_pal.close()
+        inner.close()
+
+
+# ------------------------------------------------------------------ multichip
+@pytest.mark.parametrize("ndev,partitions", [(1, 0), (8, 0), (8, 16)])
+def test_multichip_encoded_partition_identity(ndev, partitions):
+    """P=N and P=2N encoded partitions serve byte-identically to the
+    single-device raw oracle; partitions stay user-key-aligned (no user
+    key's version chain straddles a partition border)."""
+    inner = new_storage("memkv")
+    loader = Backend(inner, BackendConfig(event_ring_capacity=16384))
+    for i in range(300):
+        k = b"/registry/pods/ns-%02d/pod-%04d" % (i % 6, i)
+        r = loader.create(k, b"v%d" % i)
+        if i % 7 == 0:
+            loader.update(k, b"w%d" % i, r)
+    loader.close()
+
+    oracle = make_backend(inner, False, ndev=1)   # raw, single device
+    be_enc = make_backend(inner, True, ndev=ndev, partitions=partitions)
+    try:
+        m = be_enc.scanner._mirror
+        assert m.encoding is not None
+        assert m.keys_host.shape[2] * 4 == m.encoding.width < m.raw_key_width
+        if partitions:
+            assert m.partitions == partitions
+        assert_identical(be_enc, oracle, RANGES)
+        # user-key alignment: every partition's first raw key is strictly
+        # greater than the previous partition's last raw key
+        last = None
+        for p in range(m.partitions):
+            nv = int(m.n_valid[p])
+            if nv == 0:
+                continue
+            first = m.user_key(p, 0)
+            if last is not None:
+                assert first > last, (p, first, last)
+            last = m.user_key(p, nv - 1)
+    finally:
+        be_enc.close()
+        oracle.close()
+        inner.close()
+
+
+# ------------------------------------------------------ satellites/regressions
+def test_flat_arrays_empty_mirror_honors_key_width():
+    """Regression (ISSUE 9 satellite): the empty-mirror fallback used to
+    hardcode uint8[0, 4] whatever the configured key width, poisoning the
+    rebuild concat for non-default --key-width mirrors."""
+    for kw in (64, 128):
+        m = blocks.build_mirror([], mesh=None, key_width=kw, snapshot_ts=0)
+        keys_u8 = m.flat_arrays()[0]
+        assert keys_u8.shape == (0, kw), (kw, keys_u8.shape)
+
+
+def test_mirror_raw_bytes_gauge_exposes_compression():
+    """kb_mirror_raw_bytes{device=} companion gauge: raw-equivalent bytes
+    of each shard, so raw/encoded on /metrics is the scrape-visible HBM
+    saving."""
+    prom = pytest.importorskip("prometheus_client")  # noqa: F841
+    from kubebrain_tpu.metrics import new_metrics
+
+    inner = new_storage("memkv")
+    loader = Backend(inner, BackendConfig(event_ring_capacity=16384))
+    for i in range(2000):
+        loader.create(b"/registry/pods/ns-%02d/pod-%05d" % (i % 4, i), b"v")
+    loader.close()
+    be_enc, be_raw = make_pair(inner)
+    try:
+        metrics = new_metrics("")
+        be_enc.scanner.register_metrics(metrics)
+        _ctype, body = metrics.http_handler()()
+        enc_b, raw_b = {}, {}
+        for line in body.decode().splitlines():
+            if line.startswith("kb_mirror_bytes{"):
+                label, val = line.rsplit(" ", 1)
+                enc_b[label] = float(val)
+            elif line.startswith("kb_mirror_raw_bytes{"):
+                label, val = line.rsplit(" ", 1)
+                raw_b[label] = float(val)
+        assert len(enc_b) == 8 and len(raw_b) == 8
+        tot_enc, tot_raw = sum(enc_b.values()), sum(raw_b.values())
+        m = be_enc.scanner._mirror
+        stored_w = m.keys_host.shape[2] * 4
+        # key column shrinks by exactly raw/stored; other columns unchanged
+        key_bytes = m.keys_host.size * 4
+        assert tot_raw - tot_enc == key_bytes // stored_w * m.raw_key_width - key_bytes
+        assert tot_raw > tot_enc * 2   # the saving is visible, not noise
+    finally:
+        be_enc.close()
+        be_raw.close()
+        inner.close()
+
+
+def test_encoding_stats_schema():
+    inner = new_storage("memkv")
+    loader = Backend(inner, BackendConfig(event_ring_capacity=16384))
+    for i in range(2000):
+        loader.create(b"/registry/pods/ns-%02d/pod-%05d" % (i % 4, i), b"v")
+    loader.close()
+    be_enc, be_raw = make_pair(inner)
+    try:
+        st = be_enc.scanner.encoding_stats()
+        assert st["encoded"] and st["rows"] == 2000
+        assert st["key_compression_ratio"] >= 4.0
+        assert st["key_bytes_per_row"] * st["key_compression_ratio"] == \
+            pytest.approx(st["raw_key_bytes_per_row"], rel=1e-3)
+        st_raw = be_raw.scanner.encoding_stats()
+        assert not st_raw["encoded"]
+        assert st_raw["key_compression_ratio"] == 1.0
+    finally:
+        be_enc.close()
+        be_raw.close()
+        inner.close()
+
+
+def test_cli_key_encoding_flag():
+    from kubebrain_tpu.cli import build_parser, validate_args
+
+    p = build_parser()
+    ok = p.parse_args(["--storage", "tpu", "--key-encoding", "encoded"])
+    validate_args(ok)
+    validate_args(p.parse_args(["--storage", "tpu", "--key-encoding", "raw"]))
+    with pytest.raises(SystemExit):   # requires the tpu engine
+        validate_args(p.parse_args(["--key-encoding", "encoded"]))
+    with pytest.raises(SystemExit):   # choices enforced by argparse
+        p.parse_args(["--storage", "tpu", "--key-encoding", "zstd"])

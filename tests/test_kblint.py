@@ -572,10 +572,93 @@ def test_kb106_covers_batched_entry_points():
     assert ids(src2, EP) == ["KB106"]
 
 
+# ------------------------------------------------------------------- KB116
+def test_kb116_flags_decode_primitive_outside_funnels():
+    # a stray decode_rows materializes the full-width key column on the
+    # host outside the visible-row sizing — the unmetered decode path
+    src = ("def leak(mirror, rows):\n"
+           "    return mirror.encoding.decode_rows(rows, None)\n")
+    assert ids(src, TPU) == ["KB116"]
+    src2 = ("def peek(mirror, p, i):\n"
+            "    return mirror.encoding.decode_one(mirror.keys_host[p, i], 3)\n")
+    assert ids(src2, TPU) == ["KB116"]
+
+
+def test_kb116_flags_decoded_keys_outside_materialization_paths():
+    src = ("def dump_all(mirror, p, nv):\n"
+           "    return mirror.decoded_keys(p, range(nv))\n")
+    assert ids(src, TPU) == ["KB116"]
+
+
+def test_kb116_allows_the_funnel_chain():
+    src = ("import numpy as np\n"
+           "def decoded_keys(self, p, rows):\n"
+           "    return self.encoding.decode_rows(self.keys_host[p][rows], None)\n"
+           "def user_key(self, p, i):\n"
+           "    return self.encoding.decode_one(self.keys_host[p, i], 0)\n"
+           "def materialize(self, p, rows):\n"
+           "    return self.decoded_keys(p, rows)\n"
+           "def flat_arrays(self):\n"
+           "    return self.decoded_keys(0, [])\n"
+           "def merge_partitions_incremental(mirror, p):\n"
+           "    return mirror.decoded_keys(p, [])\n"
+           "def compact(self, start, end, rev):\n"
+           "    return self._mirror.decoded_keys(0, [])\n")
+    assert ids(src, TPU) == []
+
+
+def test_kb116_scoped_to_storage_tpu_and_exempts_encode_py():
+    src = "def f(enc, rows):\n    return enc.decode_rows(rows, None)\n"
+    assert ids(src, ANY) == []                       # outside storage/tpu/
+    assert ids(src, "kubebrain_tpu/storage/tpu/encode.py") == []
+
+
+# ------------------------------------------------------------------- KB117
+def test_kb117_flags_raw_bound_packing_outside_dispatch():
+    # packing a bound outside _bound_rows hands a RAW-domain bound to
+    # whatever kernel compare it reaches — wrong by construction against
+    # an encoded mirror
+    src = ("from kubebrain_tpu.ops import keys as keyops\n"
+           "def my_query(self, start):\n"
+           "    return keyops.pack_one(start, self._kw)\n")
+    assert ids(src, TPU) == ["KB117"]
+
+
+def test_kb117_flags_encoded_bound_helper_outside_dispatch():
+    src = ("def my_query(self, mirror, start):\n"
+           "    return mirror.encoding.encode_start_bound(start)\n")
+    assert ids(src, TPU) == ["KB117"]
+    src2 = ("def probe(self, mirror, k):\n"
+            "    return mirror.encoding.encode_probe(k)\n")
+    assert ids(src2, TPU) == ["KB117"]
+
+
+def test_kb117_allows_the_dispatch_funnels():
+    src = ("from kubebrain_tpu.ops import keys as keyops\n"
+           "def _bound_rows(self, mirror, start, end):\n"
+           "    if mirror.encoding is not None:\n"
+           "        return mirror.encoding.encode_start_bound(start)\n"
+           "    return keyops.pack_one(start, self._kw)\n"
+           "def _host_visible_batch(self, mirror, ukeys, rev):\n"
+           "    if mirror.encoding is not None:\n"
+           "        return [mirror.encoding.encode_probe(k) for k in ukeys]\n"
+           "    return [keyops.pack_one(k, self._kw) for k in ukeys]\n")
+    assert ids(src, TPU) == []
+
+
+def test_kb117_scoped_to_storage_tpu():
+    src = ("from kubebrain_tpu.ops import keys as keyops\n"
+           "def f(w):\n"
+           "    return keyops.pack_one(b'/registry/', w)\n")
+    assert ids(src, ANY) == []                       # e.g. parallel/step.py
+    assert ids(src, "kubebrain_tpu/storage/tpu/encode.py") == []
+
+
 # ------------------------------------------------------------ registry/CLI
 def test_registry_has_all_rules():
     assert set(RULES) == {"KB101", "KB102", "KB103", "KB104", "KB105", "KB106",
-                          "KB107", "KB108", "KB109", "KB110", "KB111"}
+                          "KB107", "KB108", "KB109", "KB110", "KB111",
+                          "KB116", "KB117"}
     for rule in RULES.values():
         assert rule.summary
 

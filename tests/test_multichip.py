@@ -174,7 +174,10 @@ def test_host_transfer_budget_bounded_by_visible_rows():
     try:
         n_pad = scanner._mirror.keys_host.shape[1]
         mask_bytes = P * n_pad            # bool [P, N] — the forbidden pull
-        key_bytes = scanner._mirror.keys_host.nbytes  # the unthinkable one
+        # the unthinkable pull, at RAW key width: the prefix-encoded mirror
+        # shrinks the stored column ~6x, which must not relax the absolute
+        # index-block budget asserted below
+        key_bytes = P * n_pad * scanner._mirror.raw_key_width
 
         def measured(fn):
             fn()  # warm: compile + bucket shapes off the meter's budget

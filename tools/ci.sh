@@ -23,11 +23,14 @@
 #                         op trace), SLO report schema, and a small-N
 #                         end-to-end replay through the real gRPC front
 #                         with client/server /metrics reconciliation
-#   7. multichip        — sharded serving on 8 simulated host devices
+#   7. multichip+encode — sharded serving on 8 simulated host devices
 #                         (conftest's xla_force_host_platform_device_count):
 #                         sharded-vs-single byte identity, O(visible-rows)
-#                         host transfer, dirty-shard-only republish, and
-#                         the served dry-run emitting multichip_rows_per_sec
+#                         host transfer, dirty-shard-only republish, the
+#                         served dry-run emitting multichip_rows_per_sec,
+#                         and the encoded-mirror differential suite
+#                         (encoded == raw byte-identity incl. overlays,
+#                         adversarial bounds, pallas-vs-jnp, P=N/P=2N)
 #   8. tier-1 pytest    — the ROADMAP.md verify command
 # Run from anywhere; operates on the repo this script lives in.
 
@@ -62,8 +65,9 @@ echo "=== [6/8] workload replay: determinism + SLO schema + small-N gRPC smoke"
 env JAX_PLATFORMS=cpu python -m pytest tests/test_workload.py -q -m 'not slow' \
     -p no:cacheprovider || exit 1
 
-echo "=== [7/8] multichip sharded serving: identity + transfer budget + served dry-run"
+echo "=== [7/8] multichip sharded serving + encoded mirror: identity + transfer budget + served dry-run"
 env JAX_PLATFORMS=cpu python -m pytest tests/test_multichip.py \
+    tests/test_encode.py \
     tests/test_graft_entry.py -q -m 'not slow' -p no:cacheprovider || exit 1
 
 echo "=== [8/8] tier-1 tests (ROADMAP.md verify, one definition: make test-tier1)"

@@ -15,6 +15,10 @@ Two tiers (see docs/static_analysis.md for the full catalogue):
 - KB109  scan kernels dispatch only from the _dev_mask assembly points
 - KB110  workload/ stays replayable (no unseeded RNG, no time.time())
 - KB111  storage/tpu/ device→host pulls only at named materialization points
+- KB116  encoded-key decode only through the decoded_keys/user_key funnels,
+  themselves only from the named materialization/rebuild paths
+- KB117  query-bound packing/encoding only inside the domain-dispatch
+  funnels — kernels never see a bound from the wrong key domain
 
 **Interprocedural** (``--deep``: whole-program call graph + context
 propagation over kubebrain_tpu/ + tools/ + bench.py; graph.py/contexts.py):

@@ -593,6 +593,142 @@ class HostTransferOnlyAtMaterializationPoints(Rule):
         yield from scan(tree.body, None)
 
 
+#: the decode primitives that turn ENCODED mirror rows back into raw key
+#: bytes (storage/tpu/encode.py), and the funnels allowed to call each
+#: tier: primitives only inside the Mirror decode funnel, the funnel only
+#: inside the named materialization/rebuild paths. Everything else must
+#: receive decoded bytes FROM those paths — a stray decode call is an
+#: unmetered host materialization of key bytes the compressed-mirror
+#: design exists to avoid (it dodges both the visible-row sizing and the
+#: transfer-budget accounting).
+_DECODE_PRIMITIVES = {"decode_rows", "decode_one"}
+_DECODE_PRIMITIVE_FUNNELS = {"decoded_keys", "user_key"}
+_DECODE_FUNNEL_CALLERS = {
+    "materialize", "flat_arrays", "merge_partitions_incremental", "compact",
+    "_materialize_visible",
+}
+
+
+@register
+class DecodeOnlyAtMaterializationFunnels(Rule):
+    """Decoded key bytes may only leave the encoded mirror through the
+    named funnels: ``KeyEncoding.decode_rows``/``decode_one`` inside
+    ``Mirror.decoded_keys``/``user_key``, and ``decoded_keys`` itself only
+    from the materialization/rebuild paths (``materialize``,
+    ``flat_arrays``, ``merge_partitions_incremental``, ``compact``). A
+    decode call anywhere else re-creates the full-width key column on the
+    host outside the visible-row sizing — the exact cost the
+    prefix-compressed mirror (docs/compression.md) removes."""
+
+    rule_id = "KB116"
+    summary = ("storage/tpu/: encoded-key decode only through the "
+               "decoded_keys/user_key funnels, themselves only from the "
+               "named materialization/rebuild paths")
+
+    def applies(self, relpath: str) -> bool:
+        p = relpath.replace("\\", "/")
+        # encode.py IS the implementation being confined; its internal
+        # delegation (decode_one → decode_rows) is the primitive itself
+        return (p.startswith("kubebrain_tpu/storage/tpu/")
+                and not p.endswith("/encode.py"))
+
+    def check(self, tree: ast.Module, src: str) -> Iterable[tuple[ast.AST, str]]:
+        def scan(body: list[ast.stmt],
+                 func_name: str | None) -> Iterator[tuple[ast.AST, str]]:
+            for node in walk_same_scope(body):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    yield from scan(node.body, node.name)
+                    continue
+                if isinstance(node, ast.ClassDef):
+                    yield from scan(node.body, None)
+                    continue
+                if not isinstance(node, ast.Call):
+                    continue
+                name = terminal_name(node.func)
+                where = f" (in {func_name!r})" if func_name else ""
+                if (name in _DECODE_PRIMITIVES
+                        and func_name not in _DECODE_PRIMITIVE_FUNNELS):
+                    yield node, (
+                        f"raw-key decode {name}(){where}: only the "
+                        "Mirror.decoded_keys/user_key funnels may call the "
+                        "decode primitives"
+                    )
+                elif (name == "decoded_keys"
+                        and func_name not in _DECODE_FUNNEL_CALLERS
+                        and func_name != "decoded_keys"):
+                    yield node, (
+                        f"decoded_keys(){where}: decoded key bytes only "
+                        "leave the mirror through the named materialization"
+                        "/rebuild paths (materialize, flat_arrays, "
+                        "merge_partitions_incremental, compact)"
+                    )
+
+        yield from scan(tree.body, None)
+
+
+#: the ONE dispatch point where raw query bounds meet the mirror's compare
+#: domain (raw packed chunks or dictionary-encoded rows), plus the host
+#: probe path that routes per-key through the same encoding check — every
+#: other function must pass bounds through them, never pack its own
+_BOUND_DOMAIN_FUNNELS = {"_bound_rows", "_host_visible_batch"}
+_RAW_BOUND_PACKERS = {"pack_one"}
+_ENCODED_BOUND_HELPERS = {"encode_start_bound", "encode_end_bound",
+                          "encode_probe"}
+
+
+@register
+class BoundDomainDispatchOnly(Rule):
+    """Raw-domain bound packing (``keyops.pack_one``) and encoded-domain
+    bound helpers (``encode_*_bound``/``encode_probe``) are only callable
+    inside the engine's domain-dispatch funnels (``_bound_rows``,
+    ``_host_visible_batch``) — the naming rule that makes it impossible to
+    hand a raw-domain bound to an encoded-mirror compare (or vice versa):
+    the only code that sees both domains is the dispatch that checks
+    ``mirror.encoding`` first."""
+
+    rule_id = "KB117"
+    summary = ("storage/tpu/: bound packing/encoding only inside the "
+               "domain-dispatch funnels (_bound_rows, _host_visible_batch) "
+               "— kernels must never see a bound from the wrong key domain")
+
+    def applies(self, relpath: str) -> bool:
+        p = relpath.replace("\\", "/")
+        return (p.startswith("kubebrain_tpu/storage/tpu/")
+                and not p.endswith("/encode.py"))
+
+    def check(self, tree: ast.Module, src: str) -> Iterable[tuple[ast.AST, str]]:
+        def scan(body: list[ast.stmt],
+                 func_name: str | None) -> Iterator[tuple[ast.AST, str]]:
+            for node in walk_same_scope(body):
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    yield from scan(node.body, node.name)
+                    continue
+                if isinstance(node, ast.ClassDef):
+                    yield from scan(node.body, None)
+                    continue
+                if not isinstance(node, ast.Call):
+                    continue
+                if func_name in _BOUND_DOMAIN_FUNNELS:
+                    continue
+                name = terminal_name(node.func)
+                where = f" (in {func_name!r})" if func_name else ""
+                if name in _RAW_BOUND_PACKERS:
+                    yield node, (
+                        f"raw-domain bound packing {name}(){where}: pack "
+                        "query bounds through _bound_rows so an encoded "
+                        "mirror never compares a raw-domain bound"
+                    )
+                elif name in _ENCODED_BOUND_HELPERS:
+                    yield node, (
+                        f"encoded-domain bound helper {name}(){where}: "
+                        "encode query bounds through _bound_rows/"
+                        "_host_visible_batch so a raw mirror never "
+                        "compares an encoded-domain bound"
+                    )
+
+        yield from scan(tree.body, None)
+
+
 _REV_TOKENS = {"rev", "revision"}
 
 
