@@ -4,7 +4,8 @@
 SHELL := /bin/bash  # test-tier1 needs pipefail
 
 .PHONY: all native test bench bench-all bench-smoke bench-cluster \
-        bench-multichip run clean protos lint typecheck check test-tier1
+        bench-multichip bench-write run clean protos lint typecheck check \
+        test-tier1
 
 all: native
 
@@ -70,20 +71,31 @@ bench-smoke:
 # Same seed => byte-identical op trace (self-checked every run).
 # MESH_PART/SCAN_PARTS drive a part-sharded server (STORAGE=tpu required;
 # docs/multichip.md), e.g.: make bench-cluster N=1000 STORAGE=tpu MESH_PART=8
+# SCENARIO=churn_heavy skews the trace to pod churn + a keepalive storm
+# (write-group commit exercised + asserted; docs/writes.md).
 N ?= 1000
 STORAGE ?= memkv
 MESH_PART ?= 0
 SCAN_PARTS ?= 0
+SCENARIO ?= cluster
 bench-cluster:
 	JAX_PLATFORMS=cpu KB_BENCH_METRIC=cluster KB_BENCH_NODES=$(N) \
 	    KB_WORKLOAD_STORAGE=$(STORAGE) KB_WORKLOAD_MESH_PART=$(MESH_PART) \
-	    KB_WORKLOAD_SCAN_PARTITIONS=$(SCAN_PARTS) python bench.py
+	    KB_WORKLOAD_SCAN_PARTITIONS=$(SCAN_PARTS) \
+	    KB_WORKLOAD_SCENARIO=$(SCENARIO) python bench.py
 
 # Multichip sharded serving curve (docs/multichip.md): the scan workload
 # served through the scheduler at mesh sizes 1..8, byte-identical across
 # sizes; KB_MULTICHIP_OUT=MULTICHIP_rNN.json writes the schema'd report.
 bench-multichip:
 	JAX_PLATFORMS=cpu KB_BENCH_METRIC=multichip python bench.py
+
+# Write-path group commit (docs/writes.md): write_txns_per_sec serial vs
+# grouped at 8-writer concurrency (grouped >= 1.5x asserted on CPU,
+# byte-identity vs the sequential oracle), plus the TPU-engine steady
+# state proving the incremental delta merge never takes a full rebuild.
+bench-write:
+	JAX_PLATFORMS=cpu KB_BENCH_METRIC=write python bench.py
 
 run: native
 	python -m kubebrain_tpu.cli --single-node --storage=tpu --inner-storage=native

@@ -1110,6 +1110,277 @@ def bench_sched() -> None:
     store.close()
 
 
+def bench_write() -> None:
+    """Write-path group commit bench (KB_BENCH_METRIC=write; BENCH_r06):
+    ``write_txns_per_sec`` serial vs grouped — the SAME mixed
+    create/update/delete workload at 8-writer concurrency through the
+    scheduler, once with group commit off (``write_batch=1``) and once on
+    (``write_batch=8``). Disjoint per-writer keyspaces make the runs
+    commute, so final (key, value) state must be identical; exact
+    byte-identity INCLUDING revisions is asserted separately with a
+    deterministic plugged-slot group vs a sequential oracle (the same
+    construction proof tests/test_write_batch.py pins).
+
+    The second half runs grouped writes over the TPU engine (CPU-sim jnp
+    kernel) with a concurrent reader crossing the merge threshold, and
+    asserts the steady state NEVER takes the full host rebuild:
+    ``full_rebuild_total == 0`` and ``merge_rows_total`` accounts every
+    delta row that left the overlay (merged + still-pending == committed
+    version rows since the initial publish).
+
+    Bars: grouped >= 1.5x serial is asserted ON CPU (the win is dispatch
+    and commit-path amortization, not device time); the TPU-engine merge
+    numbers carry a ``pending_tpu`` stamp off-TPU like the other phases."""
+    import random
+    import threading
+
+    from kubebrain_tpu.backend import Backend, BackendConfig
+    from kubebrain_tpu.sched import Lane, SchedConfig, ensure_scheduler
+    from kubebrain_tpu.storage import new_storage
+
+    writers = int(os.environ.get("KB_BENCH_WRITERS", 8))
+    ops_per_writer = int(os.environ.get("KB_BENCH_OPS", 400))
+    depth = int(os.environ.get("KB_SCHED_DEPTH", 1))
+    wbatch = int(os.environ.get("KB_SCHED_WRITE_BATCH", 8))
+
+    def writer_stream(w: int):
+        """Deterministic mixed stream for writer ``w`` over its own keys:
+        create -> update -> update -> delete -> recreate ... (4:2:1 mix)."""
+        rng = random.Random(1000 + w)
+        live: dict[bytes, int] = {}
+        ops = []
+        for step in range(ops_per_writer):
+            k = b"/registry/pods/w-%02d/p-%03d" % (w, rng.randrange(40))
+            if k not in live:
+                ops.append(("create", k, b"c%04d" % step))
+            elif rng.random() < 0.6:
+                ops.append(("update", k, b"u%04d" % step))
+            else:
+                ops.append(("delete", k))
+            # liveness tracking only; revisions resolve at run time
+            if ops[-1][0] == "delete":
+                live.pop(k)
+            else:
+                live[k] = 1
+        return ops
+
+    streams = [writer_stream(w) for w in range(writers)]
+
+    def run(write_batch: int):
+        store = new_storage("memkv")
+        backend = Backend(store, BackendConfig(event_ring_capacity=65536))
+        sched = ensure_scheduler(backend, SchedConfig(
+            depth=depth, write_batch=write_batch))
+        errs: list = []
+
+        def w_run(w: int):
+            try:
+                live: dict[bytes, int] = {}
+                for op in streams[w]:
+                    if op[0] == "create":
+                        live[op[1]] = sched.create(op[1], op[2],
+                                                   client=f"w{w}")
+                    elif op[0] == "update":
+                        live[op[1]] = sched.update(op[1], op[2],
+                                                   live[op[1]],
+                                                   client=f"w{w}")
+                    else:
+                        sched.delete(op[1], live.pop(op[1]),
+                                     client=f"w{w}")
+            except BaseException as e:  # pragma: no cover
+                errs.append(e)
+
+        threads = [threading.Thread(target=w_run, args=(w,))
+                   for w in range(writers)]
+        t0 = time.time()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        dt = time.time() - t0
+        assert not errs, errs[0]
+        state = sorted(
+            (kv.key, kv.value) for kv in
+            backend.list_(b"/registry/", b"/registry0", 0, 0).kvs)
+        riders = sched.write_batched
+        backend.close()
+        store.close()
+        return dt, state, riders
+
+    total_ops = writers * ops_per_writer
+    # warm up both paths (allocator/thread pools), then interleave
+    # serial/grouped rounds and take best-of-3 each: the 2-vCPU CI box's
+    # load swings dwarf the effect under test
+    run(1)
+    run(wbatch)
+    rounds = [(run(1), run(wbatch)) for _ in range(3)]
+    serial_dt, serial_state, _ = min(
+        (s for s, _ in rounds), key=lambda r: r[0])
+    grouped_dt, grouped_state, riders = min(
+        (g for _, g in rounds), key=lambda r: r[0])
+    assert grouped_state == serial_state, \
+        "grouped and serial runs must converge to the same (key,value) state"
+    assert riders > 0, "no write group ever formed at 8-writer concurrency"
+    serial_rate = total_ops / serial_dt
+    grouped_rate = total_ops / grouped_dt
+    speedup = grouped_rate / serial_rate
+    assert speedup >= 1.5, (
+        f"group commit {speedup:.2f}x serial is under the 1.5x bar "
+        f"({grouped_rate:.0f} vs {serial_rate:.0f} txns/s)")
+
+    # --- deterministic formation: byte-identity incl. revisions ----------
+    store = new_storage("memkv")
+    backend = Backend(store, BackendConfig(event_ring_capacity=8192))
+    sched = ensure_scheduler(backend, SchedConfig(depth=1, write_batch=8))
+    o_store = new_storage("memkv")
+    oracle = Backend(o_store, BackendConfig(event_ring_capacity=8192))
+    release = threading.Event()
+    sched.submit_async(release.wait, Lane.SYSTEM)
+    time.sleep(0.1)
+    keys = [b"/registry/pods/det/p-%d" % i for i in range(8)]
+    outs: dict = {}
+    det_errs: list = []
+
+    def det_create(i: int) -> None:
+        try:
+            outs[i] = sched.create(keys[i], b"v%d" % i, client=f"c{i}")
+        except BaseException as e:  # pragma: no cover
+            det_errs.append(e)
+
+    gthreads = [threading.Thread(target=det_create, args=(i,))
+                for i in range(8)]
+    for t in gthreads:
+        t.start()
+    time.sleep(0.3)
+    release.set()
+    for t in gthreads:
+        t.join(30)
+    assert not det_errs, det_errs[0]
+    assert sched.write_batched > 0, "plugged slot formed no write group"
+    for i in range(8):
+        oracle.create(keys[i], b"v%d" % i)
+    det_got = sorted(
+        (kv.key, kv.value) for kv in
+        backend.list_(b"/registry/pods/det/", b"/registry/pods/det0", 0, 0).kvs)
+    det_want = sorted(
+        (kv.key, kv.value) for kv in
+        oracle.list_(b"/registry/pods/det/", b"/registry/pods/det0", 0, 0).kvs)
+    # the dealt revision block is contiguous like the oracle's sequence
+    det_identical = det_got == det_want and \
+        sorted(outs.values()) == list(range(min(outs.values()),
+                                            min(outs.values()) + 8))
+    assert det_identical, "deterministic group diverged from the oracle"
+    backend.close()
+    store.close()
+    oracle.close()
+    o_store.close()
+
+    # --- TPU-engine steady state: incremental merge, no full rebuild -----
+    import jax  # noqa: F401  (forces backend init for platform_info)
+
+    t_store = new_storage("tpu", inner="memkv")
+    t_backend = Backend(t_store, BackendConfig(event_ring_capacity=65536))
+    t_sched = ensure_scheduler(t_backend, SchedConfig(
+        depth=depth, write_batch=wbatch))
+    sc = t_backend.scanner
+    sc._merge_threshold = 256
+    rng = random.Random(17)
+    seeded: dict[bytes, int] = {}
+    for w in range(writers):
+        for i in range(0, 40, 2):
+            k = b"/registry/pods/w-%02d/p-%03d" % (w, i)
+            seeded[k] = t_backend.create(k, b"seed")
+    sc.publish()
+    base_rows = len(sc._delta)  # 0 after publish
+    stop_reader = threading.Event()
+
+    def reader():
+        while not stop_reader.is_set():
+            t_backend.count(b"/registry/pods/", b"/registry/pods0")
+            time.sleep(0.005)
+
+    rt = threading.Thread(target=reader)
+    rt.start()
+    errs2: list = []
+
+    def t_writer(w: int):
+        try:
+            live = {k: r for k, r in seeded.items()
+                    if k.startswith(b"/registry/pods/w-%02d/" % w)}
+            lrng = random.Random(2000 + w)
+            for step in range(ops_per_writer):
+                k = b"/registry/pods/w-%02d/p-%03d" % (w, lrng.randrange(40))
+                if k not in live:
+                    live[k] = t_sched.create(k, b"c%04d" % step,
+                                             client=f"w{w}")
+                elif lrng.random() < 0.6:
+                    live[k] = t_sched.update(k, b"u%04d" % step, live[k],
+                                             client=f"w{w}")
+                else:
+                    t_sched.delete(k, live.pop(k), client=f"w{w}")
+        except BaseException as e:  # pragma: no cover
+            errs2.append(e)
+
+    tthreads = [threading.Thread(target=t_writer, args=(w,))
+                for w in range(writers)]
+    t0 = time.time()
+    for t in tthreads:
+        t.start()
+    for t in tthreads:
+        t.join()
+    tpu_dt = time.time() - t0
+    stop_reader.set()
+    rt.join(10)
+    assert not errs2, errs2[0]
+    # quiesce before sampling: publish() enters the merge path and blocks
+    # on the merge lock, so any in-flight write-kicked background merge
+    # finishes (and its counters land) before we read them; it also
+    # sweeps the delta tail, so pending is 0 and the accounting is exact
+    sc.publish()
+    merged = sc.merge_rows_total
+    pending = len(sc._delta)
+    full_rebuilds = sc.full_rebuild_total
+    assert sc.merge_bg_errors == 0, sc._merge_bg_last_error
+    assert full_rebuilds == 0, (
+        f"steady-state churn took {full_rebuilds} full host rebuilds — "
+        "the incremental merge must carry it")
+    assert sc.merge_count > 0, "writes never crossed the merge threshold"
+    assert merged + pending == total_ops - base_rows, (
+        f"merge accounting leak: {merged} merged + {pending} pending != "
+        f"{total_ops} committed rows")
+    on_tpu = jax.devices()[0].platform in ("tpu", "axon")
+    t_backend.close()
+    t_store.close()
+
+    print(json.dumps({
+        "metric": "write_txns_per_sec",
+        "value": round(grouped_rate),
+        "unit": "txns/sec",
+        "vs_baseline": round(speedup, 3),
+        "platform": platform_info(),
+        "detail": {
+            "writers": writers, "ops": total_ops, "depth": depth,
+            "write_batch": wbatch,
+            "serial_txns_per_sec": round(serial_rate),
+            "grouped_txns_per_sec": round(grouped_rate),
+            "grouped_riders": riders,
+            "state_identical": True,
+            "deterministic_group_byte_identical": det_identical,
+            "grouped_acceptance_1_5x": "pass",  # asserted above, on CPU
+            "mix": "create/update/delete ~40/36/24",
+            "tpu_engine_merge": {
+                "write_txns_per_sec": round((total_ops) / tpu_dt),
+                "merges": sc.merge_count,
+                "merge_rows_total": merged,
+                "delta_rows_pending": pending,
+                "full_rebuild_total": full_rebuilds,
+                "accounting_exact": True,
+                "merge_acceptance_tpu": "pass" if on_tpu else "pending_tpu",
+            },
+        },
+    }))
+
+
 def bench_cluster() -> None:
     """Cluster-scale workload replay (make bench-cluster N=...): the
     deterministic kube-apiserver traffic generator driven through the real
@@ -1127,7 +1398,11 @@ def bench_cluster() -> None:
     from kubebrain_tpu.workload.spec import WorkloadSpec
 
     nodes = int(os.environ.get("KB_BENCH_NODES", os.environ.get("N", 1000)))
-    spec = WorkloadSpec.for_cluster(
+    scenario = os.environ.get("KB_WORKLOAD_SCENARIO", "cluster")
+    factory = {"cluster": WorkloadSpec.for_cluster,
+               "churn_heavy": WorkloadSpec.for_churn_heavy,
+               "churn-heavy": WorkloadSpec.for_churn_heavy}[scenario]
+    spec = factory(
         nodes,
         seed=int(os.environ.get("KB_WORKLOAD_SEED", 0)),
         duration_s=float(os.environ.get("KB_WORKLOAD_DURATION", 30.0)),
@@ -1580,6 +1855,8 @@ def main() -> None:
         return bench_rebuild()
     if metric == "sched":
         return bench_sched()
+    if metric == "write":
+        return bench_write()
     if metric == "cluster":
         return bench_cluster()
     if metric == "multichip":

@@ -90,6 +90,9 @@ class Backend:
         # single-FFI-call write/delete fast paths when the engine provides them
         self._mvcc_write = getattr(store, "mvcc_write", None)
         self._mvcc_delete = getattr(store, "mvcc_delete", None)
+        # grouped-commit engine executor (one engine round trip for a whole
+        # write group, per-op demux) — engines without it fall back per-op
+        self._engine_write_batch = getattr(store, "write_batch", None)
         # compact watermark cache: -1 unknown; refreshed at most once per
         # COMPACT_CACHE_TTL so hot reads don't pay an engine round-trip
         # (local compactions update it synchronously; the TTL bounds follower
@@ -382,6 +385,255 @@ class Backend:
             if revealed:
                 self._await_revealed(revealed)
 
+    # ============================================================ group commit
+    def write_batch(self, ops: list) -> list:
+        """Group commit: execute a batch of write ops as ONE commit group —
+        the scheduler's write-batch executor (the write twin of
+        :meth:`list_batch`). ``ops`` is a list of
+
+        - ``("create", key, value, ttl, lease)``
+        - ``("update", key, value, expected_revision, ttl, lease)``
+        - ``("delete", key, expected_revision)``
+
+        and the return list is aligned with it: an ``int`` revision for
+        create/update, ``(revision, KeyValue)`` for delete, or an Exception
+        instance to raise to that op's waiter alone (per-op demux — a CAS
+        conflict fails its op, never the group).
+
+        Mechanics (docs/writes.md): lease TTLs resolve first (a bad lease
+        fails its op without consuming a revision, like the sequential
+        paths); the surviving ops deal ONE contiguous revision block
+        (``TSO.deal_block``) in op order; the engine applies the group in a
+        single ``write_batch`` round trip with per-op conditional demux —
+        each op validates against the state as mutated by earlier ops in
+        the SAME group, so same-key ops inside a group behave exactly as
+        back-to-back sequential commits; every dealt revision is notified
+        into the event ring (valid, failed, or uncertain — the sequencer
+        contract), all in one ring pass. Failed ops consume their dealt
+        revision (notified invalid) exactly like the engine fast paths
+        (`_delete_fast`) — etcd semantics allow revision gaps. Engines
+        without ``write_batch`` fall back to the per-op sequential methods
+        with identical results."""
+        out: list = [None] * len(ops)
+        if self._engine_write_batch is None or len(ops) == 1:
+            for i, op in enumerate(ops):
+                try:
+                    out[i] = self._apply_single(op)
+                except BaseException as e:
+                    out[i] = e
+            return out
+
+        # phase 1 — lease/TTL resolution; failures consume no revision
+        pending: list[dict] = []
+        for i, op in enumerate(ops):
+            kind = op[0]
+            try:
+                if kind == "create":
+                    _, key, value, ttl, lease = op
+                    if lease:
+                        ttl = self._lease_ttl(lease)
+                    ttl = creator.ttl_for_key(key) if ttl is None else ttl
+                    pending.append(dict(i=i, kind=kind, key=key, value=value,
+                                        ttl=ttl, lease=lease, expected=0))
+                elif kind == "update":
+                    _, key, value, expected, ttl, lease = op
+                    if lease:
+                        ttl = self._lease_ttl(lease)
+                    ttl = creator.ttl_for_key(key) if ttl is None else ttl
+                    pending.append(dict(i=i, kind=kind, key=key, value=value,
+                                        ttl=ttl, lease=lease, expected=expected))
+                elif kind == "delete":
+                    _, key, expected = op
+                    pending.append(dict(i=i, kind=kind, key=key, value=b"",
+                                        ttl=0, lease=0, expected=expected))
+                else:
+                    raise ValueError(f"unknown write op kind {kind!r}")
+            except BaseException as e:
+                out[i] = e
+        if not pending:
+            return out
+
+        # phase 2 — one contiguous revision block, dealt in op order
+        base = self.tso.deal_block(len(pending))
+        engine_ops: list[tuple] = []
+        runnable: list[dict] = []  # pending ops that reach the engine
+        for j, p in enumerate(pending):
+            rev = base + j
+            p["rev"] = rev
+            kind, key = p["kind"], p["key"]
+            if kind == "create":
+                p["event"] = WatchEvent(revision=rev, verb=Verb.CREATE,
+                                        key=key, value=p["value"], valid=False)
+                op_t = ("create", coder.encode_revision_key(key), rev,
+                        coder.encode_rev_value(rev),
+                        coder.encode_object_key(key, rev), p["value"],
+                        LAST_REV_KEY, coder.encode_rev_value(rev), p["ttl"])
+            elif kind == "update":
+                p["event"] = WatchEvent(revision=rev, verb=Verb.PUT, key=key,
+                                        value=p["value"],
+                                        prev_revision=p["expected"], valid=False)
+                if rev <= p["expected"]:
+                    # drift-back anomaly (txn.go:171-175): the dealt revision
+                    # must exceed the record it supersedes; the revision is
+                    # consumed and notified invalid, like the sequential path
+                    p["fail"] = FutureRevisionError(rev, p["expected"])
+                    continue
+                op_t = ("update", coder.encode_revision_key(key),
+                        coder.encode_rev_value(rev),
+                        coder.encode_rev_value(p["expected"]),
+                        coder.encode_object_key(key, rev), p["value"],
+                        LAST_REV_KEY, coder.encode_rev_value(rev), p["ttl"])
+            else:  # delete
+                p["event"] = WatchEvent(revision=rev, verb=Verb.DELETE,
+                                        key=key, valid=False)
+                op_t = ("delete", coder.encode_revision_key(key),
+                        p["expected"], rev,
+                        coder.encode_rev_value(rev, deleted=True), TOMBSTONE,
+                        LAST_REV_KEY, coder.encode_rev_value(rev))
+            engine_ops.append(op_t)
+            runnable.append(p)
+
+        # phase 3 — ONE engine round trip with per-op outcome demux
+        revealed_max = 0
+        revealed_watermark = False
+        try:
+            if engine_ops:
+                try:
+                    results = self._engine_write_batch(engine_ops)
+                    if len(results) != len(engine_ops):
+                        raise RuntimeError(
+                            f"engine write_batch returned {len(results)} "
+                            f"outcomes for {len(engine_ops)} ops")
+                except UncertainResultError as e:
+                    # group-atomic uncertainty: every op maybe-applied
+                    results = [("uncertain", e)] * len(engine_ops)
+                except BaseException as e:
+                    results = [("error", e)] * len(engine_ops)
+            else:
+                results = []
+
+            # phase 4 — map outcomes, run lease hooks, collect fences
+            by_id = {id(p): r for p, r in zip(runnable, results)}
+            for p in pending:
+                i, rev, key = p["i"], p["rev"], p["key"]
+                fail = p.get("fail")
+                if fail is not None:
+                    out[i] = fail
+                else:
+                    try:
+                        res, rvl = self._demux_write_outcome(p, by_id[id(p)])
+                    except BaseException as e:
+                        # demux/lease-hook failure (e.g. a transient
+                        # _read_object error building a CAS conflict) fails
+                        # ONLY this op; the event keeps whatever validity
+                        # was set before the raise, so a committed engine
+                        # op stays watch-visible
+                        res, rvl = e, 0
+                    out[i] = res
+                    if rvl == -1:
+                        revealed_watermark = True
+                    elif rvl:
+                        revealed_max = max(revealed_max, rvl)
+                err = out[i] if isinstance(out[i], BaseException) else None
+                txn_log(p["kind"], key, rev, p["event"].err or err)
+        finally:
+            # phase 5 — one ring pass for the whole block, then the write
+            # fence. In a finally like every sequential path's notify: a
+            # dealt revision MUST always reach the ring, else the sequencer
+            # can never advance past it and every later write stalls.
+            self._notify_many([p["event"] for p in pending])
+            self.tso.wait_committed(base + len(pending) - 1, timeout=5.0)
+        if revealed_watermark:
+            self._await_revealed(-1)
+        elif revealed_max:
+            self._await_revealed(revealed_max)
+        return out
+
+    def _demux_write_outcome(self, p: dict, outcome) -> tuple:
+        """One engine outcome → (result-or-Exception, revealed_revision).
+        The mappings replicate the sequential paths' conflict handling
+        byte for byte (create/creator.py, update, _delete_fast)."""
+        kind, key, rev = p["kind"], p["key"], p["rev"]
+        event = p["event"]
+        status = outcome[0]
+        if status == "uncertain":
+            event.err = outcome[1]
+            return outcome[1], 0
+        if status == "error":
+            return outcome[1], 0
+        if kind == "delete":
+            if status == "ok":
+                _, prev, latest = outcome
+                event.prev_revision = latest
+                event.prev_value = prev
+                event.valid = True
+                self._lease_detach(key)
+                return (rev, KeyValue(key, prev or b"", latest)), 0
+            if status == "not_found":
+                # outcome[2] = tombstone revision; 0 = truly absent (no fence)
+                return KeyNotFoundError(key), outcome[2]
+            if status == "mismatch":
+                _, prev, latest = outcome
+                return (CASRevisionMismatchError(
+                    key, latest, None if prev == TOMBSTONE else prev),
+                    latest or -1)
+            if status == "drift":
+                return FutureRevisionError(rev, outcome[1]), outcome[1] or -1
+        elif kind == "create":
+            if status == "ok":
+                event.valid = True
+                self._lease_attach(key, p["lease"])
+                return rev, 0
+            if status == "drift":
+                return FutureRevisionError(rev, outcome[1]), outcome[1] or -1
+            if status == "conflict":
+                observed = outcome[1]
+                if observed is None:
+                    return KeyExistsError(key, 0), -1
+                try:
+                    old_rev, deleted = coder.decode_rev_value(observed)
+                except coder.CodecError:
+                    return KeyExistsError(key, 0), -1
+                if deleted:
+                    # a correct engine resolves tombstones itself (convert or
+                    # drift); an engine that surfaces one is mapped like the
+                    # creator's lost-race branch
+                    return FutureRevisionError(rev, old_rev), old_rev or -1
+                return KeyExistsError(key, old_rev), old_rev or -1
+        else:  # update
+            if status == "ok":
+                event.valid = True
+                self._lease_reattach(key, p["lease"])
+                return rev, 0
+            if status == "conflict":
+                observed = outcome[1]
+                latest_rev, latest_val = 0, None
+                if observed is not None:
+                    try:
+                        latest_rev, deleted = coder.decode_rev_value(observed)
+                        if not deleted:
+                            latest_val = self._read_object(key, latest_rev)
+                    except coder.CodecError:
+                        pass
+                return (CASRevisionMismatchError(key, latest_rev, latest_val),
+                        latest_rev or -1)
+            if status == "drift":
+                return FutureRevisionError(rev, outcome[1]), outcome[1] or -1
+        return RuntimeError(
+            f"engine write_batch outcome {outcome!r} for op kind {kind}"), 0
+
+    def _apply_single(self, op: tuple):
+        """Per-op fallback for engines without ``write_batch`` — the
+        sequential methods, so semantics cannot drift."""
+        kind = op[0]
+        if kind == "create":
+            return self.create(op[1], op[2], ttl=op[3], lease=op[4])
+        if kind == "update":
+            return self.update(op[1], op[2], op[3], ttl=op[4], lease=op[5])
+        if kind == "delete":
+            return self.delete(op[1], op[2])
+        raise ValueError(f"unknown write op kind {kind!r}")
+
     # ==================================================================== reads
     def current_revision(self) -> int:
         return self.tso.committed()
@@ -649,6 +901,23 @@ class Backend:
         # its own event synchronously, skipping a cross-thread wakeup —
         # functionally the reference's always-hot spin sequencer
         # (backend.go:212-224) without burning a core
+        self._drain()
+
+    def _notify_many(self, events: list[WatchEvent]) -> None:
+        """Post a whole commit group's events into the ring under ONE lock
+        acquisition, then drain once — the group-commit analogue of
+        :meth:`_notify` (a group of G writes pays one ring pass and one
+        sequencer wakeup instead of G)."""
+        if not events:
+            return
+        with self._ring_cond:
+            for event in events:
+                idx = event.revision % self._ring_cap
+                if self._ring[idx] is not None:
+                    raise RuntimeError(
+                        "event ring wrapped: sequencer too far behind")
+                self._ring[idx] = event
+            self._ring_cond.notify_all()
         self._drain()
 
     def _drain(self) -> None:

@@ -30,6 +30,20 @@ class TSO:
             self._deal += 1
             return self._deal
 
+    def deal_block(self, n: int) -> int:
+        """Atomically reserve ``n`` consecutive revisions; returns the first.
+        The group-commit write path (Backend.write_batch) deals one block
+        per group so the whole group occupies a contiguous ring span and the
+        sequencer drains it in one pass. Every revision of the block MUST be
+        notified (valid, failed, or uncertain) or the sequencer stalls —
+        the same contract as ``deal()``."""
+        if n <= 0:
+            raise ValueError(f"deal_block needs n >= 1, got {n}")
+        with self._lock:
+            first = self._deal + 1
+            self._deal += n
+            return first
+
     def commit(self, revision: int) -> None:
         with self._lock:
             if revision > self._commit:

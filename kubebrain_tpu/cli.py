@@ -115,6 +115,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "requests drained into one dispatch slot — over the "
                         "TPU engine they become ONE query-batched kernel "
                         "launch (bench batched_rows_per_sec); 1 disables")
+    p.add_argument("--sched-write-batch", type=int, default=8,
+                   help="request scheduler: max queued write ops (create/"
+                        "update/delete) drained into one group commit — a "
+                        "contiguous revision block + ONE engine round trip "
+                        "with per-op conflict demux (bench "
+                        "write_txns_per_sec; docs/writes.md); 1 disables")
     p.add_argument("--grpc-workers", type=int, default=256,
                    help="gRPC worker threads; each open watch stream holds one")
     p.add_argument("--aio-port", type=int, default=0,
@@ -193,6 +199,9 @@ def validate_args(args) -> None:
                          "--sched-queue-limit must be >= 1")
     if getattr(args, "sched_batch", 1) < 1:
         raise SystemExit("--sched-batch must be >= 1 (1 disables batching)")
+    if getattr(args, "sched_write_batch", 1) < 1:
+        raise SystemExit(
+            "--sched-write-batch must be >= 1 (1 disables group commit)")
     if getattr(args, "sched_shed_ms", 1.0) <= 0:
         raise SystemExit("--sched-shed-ms must be > 0")
     if getattr(args, "trace_slow_ms", 0.0) < 0:
@@ -321,6 +330,7 @@ def build_endpoint(args):
         queue_limit=args.sched_queue_limit,
         shed_ms=args.sched_shed_ms,
         batch=args.sched_batch,
+        write_batch=args.sched_write_batch,
     ), metrics=metrics)
 
     identity = args.identity or f"{get_host()}:{args.peer_port}"

@@ -12,12 +12,13 @@ queues + deadline shedding (etcd ``ResourceExhausted`` on the wire).
 See docs/scheduler.md for the queue model, lanes, and shedding policy.
 """
 
-from .lanes import Lane, classify
+from .lanes import Lane, classify, classify_write
 from .scheduler import (
     RequestScheduler,
     SchedConfig,
     SchedClosedError,
     SchedOverloadError,
+    SchedResultTimeoutError,
     client_of,
     ensure_scheduler,
 )
@@ -25,10 +26,12 @@ from .scheduler import (
 __all__ = [
     "Lane",
     "classify",
+    "classify_write",
     "client_of",
     "RequestScheduler",
     "SchedConfig",
     "SchedClosedError",
     "SchedOverloadError",
+    "SchedResultTimeoutError",
     "ensure_scheduler",
 ]

@@ -37,6 +37,19 @@ SYSTEM_PREFIXES: tuple[bytes, ...] = (
 )
 
 
+def classify_write(key: bytes) -> Lane:
+    """Lane for a write (create/update/delete) of ``key``. Writes that gate
+    control-plane liveness — leader-election lease renewals, masterlease
+    heartbeats, the compactor's coordination txn — ride SYSTEM so a pod-
+    churn storm cannot queue ahead of them; everything else is NORMAL.
+    Writes are never BACKGROUND: a write the apiserver issued is state the
+    cluster already committed to."""
+    for p in SYSTEM_PREFIXES:
+        if key.startswith(p):
+            return Lane.SYSTEM
+    return Lane.NORMAL
+
+
 def classify(start: bytes, end: bytes = b"", limit: int = 0,
              count_only: bool = False) -> Lane:
     """Lane for a range read over [start, end). etcd single-key reads never

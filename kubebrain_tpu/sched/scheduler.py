@@ -32,11 +32,23 @@ Queue model (docs/scheduler.md):
   SYSTEM read rides the next slot rather than queuing behind it),
   with coalescing (a drained member's followers share its demuxed
   result), and with pipelined depth (each slot now carries a batch);
+- WRITES ride the same lanes (create/update/delete entry points;
+  docs/writes.md): a write never coalesces (it is an effect, not a pure
+  read), but when a dispatch slot frees behind a write leader the
+  dispatcher drains up to ``write_batch - 1`` additional queued write ops
+  (same head-only per-client pops, so same-client order is sequential
+  order) into ONE ``backend.write_batch`` commit group — a contiguous
+  revision block, one engine round trip with per-op CAS/exists demux,
+  one event-ring pass. Conflicts inside a group fail only their own op,
+  byte-identical to back-to-back sequential commits by construction;
 - overload: each lane queue is bounded (``queue_limit``; enqueue sheds
   immediately when full) and every request carries an age deadline
   (``shed_ms``; stale requests shed at pop). Shed requests surface as
   ``SchedOverloadError`` which the etcd surface maps to the
-  ``ResourceExhausted`` wire status kube-apiserver already retries on.
+  ``ResourceExhausted`` wire status kube-apiserver already retries on —
+  for writes this is new but safe admission control: a shed write was
+  never dealt a revision, and the apiserver's etcd3 client retries the
+  txn exactly like an overloaded etcd.
 
 The scheduler is engine-agnostic: it schedules *backend* entry points, so
 the same admission path runs over the TPU mirror scanner and the generic
@@ -53,7 +65,7 @@ from dataclasses import dataclass, field
 from typing import Any, Callable
 
 from ..trace import TRACER
-from .lanes import Lane, classify
+from .lanes import Lane, classify, classify_write
 
 #: wire message kube-apiserver's etcd3 client recognizes and retries on
 ERR_TOO_MANY_REQUESTS = "etcdserver: too many requests"
@@ -74,6 +86,15 @@ class SchedOverloadError(Exception):
         super().__init__(f"{ERR_TOO_MANY_REQUESTS} (lane={lane.name.lower()}, {reason})")
         self.lane = lane
         self.reason = reason
+
+
+class SchedResultTimeoutError(SchedOverloadError):
+    """The submitter gave up waiting for a result AFTER the request may
+    have been dispatched: the outcome is ambiguous (the op may yet commit).
+    Distinct from admission-control sheds (queue full / deadline passed,
+    which happen strictly before a revision is dealt) so write surfaces can
+    map it to an ambiguous status (DEADLINE_EXCEEDED) instead of etcd's
+    safe-to-retry RESOURCE_EXHAUSTED."""
 
 
 class SchedClosedError(Exception):
@@ -102,6 +123,10 @@ class SchedConfig:
     workers: int = 0         # worker threads; 0 = same as depth
     batch: int = 8           # max distinct ready scan requests per dispatch
     #                          slot (query-batched device scan); 1 disables
+    write_batch: int = 8     # max queued write ops drained into one commit
+    #                          group (backend.write_batch: one contiguous
+    #                          revision block + one engine round trip);
+    #                          1 disables grouping
 
 
 class _Request:
@@ -150,7 +175,7 @@ class _Request:
 
     def wait(self, timeout: float) -> object:
         if not self.done.wait(timeout):
-            raise SchedOverloadError(self.lane, "result wait timed out")
+            raise SchedResultTimeoutError(self.lane, "result wait timed out")
         if self.error is not None:
             raise self.error
         return self.result
@@ -249,10 +274,16 @@ class RequestScheduler:
         self.coalesced = 0
         self.dispatched = 0
         self.batched = 0  # requests that rode another leader's batch slot
-        # the backend's batch executor, resolved ONCE so member compatibility
-        # is an identity check (bound methods are fresh objects per access)
+        self.write_batched = 0  # write ops that rode another leader's group
+        # the backend's batch executors, resolved ONCE so member
+        # compatibility is an identity check (bound methods are fresh
+        # objects per access). Scan batches and write groups never mix:
+        # each request carries exactly one executor identity.
         self._backend_bexec = (
             getattr(backend, "list_batch", None) if backend is not None else None
+        )
+        self._backend_wexec = (
+            getattr(backend, "write_batch", None) if backend is not None else None
         )
         if metrics is not None:
             for lane in Lane:
@@ -488,6 +519,47 @@ class RequestScheduler:
             lane, client, key=None,
         )
 
+    # ----------------------------------------------- backend write entries
+    # (the only write path the service layer may use; kblint KB106. Writes
+    # never coalesce — every op is an effect, not a pure read — but a freed
+    # dispatch slot drains up to ``write_batch - 1`` additional queued write
+    # ops behind a write leader into ONE backend.write_batch commit group:
+    # a contiguous revision block, one engine round trip, one event-ring
+    # pass, per-op conflict demux. Per-client FIFO through pop_matching
+    # keeps same-client ordering identical to sequential submission.)
+    def create(self, key: bytes, value: bytes, ttl: int | None = None,
+               lease: int = 0, client: str = "") -> Any:
+        wexec = self._backend_wexec
+        return self.submit(
+            lambda: self.backend.create(key, value, ttl=ttl, lease=lease),
+            classify_write(key), client, key=None,
+            bargs=("create", key, value, ttl, lease) if wexec else None,
+            bexec=wexec,
+        )
+
+    def update(self, key: bytes, value: bytes, expected_revision: int,
+               ttl: int | None = None, lease: int = 0,
+               client: str = "") -> Any:
+        wexec = self._backend_wexec
+        return self.submit(
+            lambda: self.backend.update(key, value, expected_revision,
+                                        ttl=ttl, lease=lease),
+            classify_write(key), client, key=None,
+            bargs=("update", key, value, expected_revision, ttl, lease)
+            if wexec else None,
+            bexec=wexec,
+        )
+
+    def delete(self, key: bytes, expected_revision: int = 0,
+               client: str = "") -> Any:
+        wexec = self._backend_wexec
+        return self.submit(
+            lambda: self.backend.delete(key, expected_revision),
+            classify_write(key), client, key=None,
+            bargs=("delete", key, expected_revision) if wexec else None,
+            bexec=wexec,
+        )
+
     # ------------------------------------------------------------- dispatch
     def _dispatch_loop(self) -> None:
         while True:
@@ -519,17 +591,26 @@ class RequestScheduler:
                 self._run_cv.notify()
 
     def _form_batch(self, req: _Request) -> None:
-        """Drain up to ``batch - 1`` additional compatible ready scan
-        requests into ``req``'s dispatch slot. Compatible = carries the
-        same batch executor (the backend's ``list_batch``; streamed lists
-        and wire-encoded fast paths never set one). Members drain in
-        strict lane-priority order through the per-client round-robin, so
-        a queued SYSTEM read rides the very next slot instead of waiting
-        out lower-priority work ahead of it."""
-        if req.bexec is None or self.config.batch <= 1:
+        """Drain additional compatible ready requests into ``req``'s
+        dispatch slot: up to ``batch - 1`` scan requests behind a scan
+        leader, or up to ``write_batch - 1`` write ops behind a write
+        leader — one mechanism, two executors. Compatible = carries the
+        SAME batch executor identity (the backend's ``list_batch`` for
+        scans, ``write_batch`` for writes; streamed lists and wire-encoded
+        fast paths never set one), so scan batches and write groups can
+        never mix. Members drain in strict lane-priority order through the
+        per-client round-robin (head-only pops — per-client FIFO is
+        preserved, which is what makes same-client write ordering inside a
+        group identical to sequential), so a queued SYSTEM op rides the
+        very next slot instead of waiting out lower-priority work ahead of
+        it."""
+        is_write = (self._backend_wexec is not None
+                    and req.bexec is self._backend_wexec)
+        limit = self.config.write_batch if is_write else self.config.batch
+        if req.bexec is None or limit <= 1:
             return
         members: list[_Request] = []
-        want = self.config.batch - 1
+        want = limit - 1
         compatible = lambda r: r.bexec is req.bexec
         while len(members) < want:
             with self._cv:
@@ -550,10 +631,14 @@ class RequestScheduler:
         req.batch_members = members
         for m in members:
             m.joined_batch = True
-        self.batched += len(members)
+        if is_write:
+            self.write_batched += len(members)
+        else:
+            self.batched += len(members)
         if self.metrics is not None:
             self.metrics.emit_histogram(
-                "kb.sched.batch.size", float(1 + len(members)))
+                "kb.sched.write.batch.size" if is_write
+                else "kb.sched.batch.size", float(1 + len(members)))
 
     def _next_request(self) -> _Request | None:
         with self._cv:

@@ -23,7 +23,7 @@ from ...backend import (
     FutureRevisionError,
     KeyExistsError,
 )
-from ...sched import SchedOverloadError, client_of
+from ...sched import SchedOverloadError, SchedResultTimeoutError, client_of
 from ...storage.errors import KeyNotFoundError
 from ...proto import brain_pb2
 from ..etcd.server import _bidi, _unary
@@ -158,8 +158,16 @@ class BrainServer:
     def Create(self, request, context) -> brain_pb2.CreateResponse:
         self._check_leader_write(context)
         try:
-            rev = self.backend.create(request.key, request.value)
+            # writes ride the scheduler lanes + group commit like the etcd
+            # surface (kblint KB106; docs/writes.md)
+            rev = self._sched().create(request.key, request.value,
+                                       client=self._client_of(context))
             return brain_pb2.CreateResponse(succeeded=True, revision=rev)
+        except SchedResultTimeoutError:
+            # post-dispatch wait timeout: outcome ambiguous, not a shed
+            context.abort(grpc.StatusCode.DEADLINE_EXCEEDED, "request timed out")
+        except SchedOverloadError as e:
+            context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, str(e))
         except KeyExistsError as e:
             return brain_pb2.CreateResponse(succeeded=False, revision=e.revision)
         except FutureRevisionError:
@@ -171,8 +179,14 @@ class BrainServer:
     def Update(self, request, context) -> brain_pb2.UpdateResponse:
         self._check_leader_write(context)
         try:
-            rev = self.backend.update(request.key, request.value, request.expected_revision)
+            rev = self._sched().update(request.key, request.value,
+                                       request.expected_revision,
+                                       client=self._client_of(context))
             return brain_pb2.UpdateResponse(succeeded=True, revision=rev)
+        except SchedResultTimeoutError:
+            context.abort(grpc.StatusCode.DEADLINE_EXCEEDED, "request timed out")
+        except SchedOverloadError as e:
+            context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, str(e))
         except CASRevisionMismatchError as e:
             resp = brain_pb2.UpdateResponse(succeeded=False, revision=e.revision)
             if e.value is not None:
@@ -184,7 +198,9 @@ class BrainServer:
     def Delete(self, request, context) -> brain_pb2.BrainDeleteResponse:
         self._check_leader_write(context)
         try:
-            rev, prev = self.backend.delete(request.key, request.expected_revision)
+            rev, prev = self._sched().delete(request.key,
+                                             request.expected_revision,
+                                             client=self._client_of(context))
             return brain_pb2.BrainDeleteResponse(
                 succeeded=True,
                 revision=rev,
@@ -192,6 +208,10 @@ class BrainServer:
                     key=prev.key, value=prev.value, revision=prev.revision
                 ),
             )
+        except SchedResultTimeoutError:
+            context.abort(grpc.StatusCode.DEADLINE_EXCEEDED, "request timed out")
+        except SchedOverloadError as e:
+            context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, str(e))
         except (KeyNotFoundError, CASRevisionMismatchError):
             return brain_pb2.BrainDeleteResponse(
                 succeeded=False, revision=self.backend.current_revision()

@@ -31,7 +31,12 @@ from ...backend import (
     KeyExistsError,
 )
 from ...lease import LeaseNotFoundError
-from ...sched import SchedOverloadError, client_of, ensure_scheduler
+from ...sched import (
+    SchedOverloadError,
+    SchedResultTimeoutError,
+    client_of,
+    ensure_scheduler,
+)
 from ...storage.errors import KeyNotFoundError
 from ...proto import rpc_pb2
 from ...trace import TRACER, traceparent_of
@@ -216,16 +221,37 @@ class KVService:
                 context.abort(grpc.StatusCode.UNAVAILABLE, "etcdserver: not leader")
             m = self._match(request, context)
         kind, key, guard_rev, value, lease = m
+        client = self._client_of(context)
         try:
+            # writes go through the scheduler like reads (kblint KB106):
+            # admission lanes + group commit — a freed slot drains queued
+            # compatible writes into ONE backend.write_batch commit group
+            # (contiguous revision block, one engine round trip, per-op
+            # conflict demux; docs/writes.md)
             with TRACER.stage("backend_write"):
                 if kind == "create":
-                    rev = self.backend.create(key, value, lease=lease)
+                    rev = self.limiter.create(key, value, lease=lease,
+                                              client=client)
                 elif kind == "update":
-                    rev = self.backend.update(key, value, guard_rev, lease=lease)
+                    rev = self.limiter.update(key, value, guard_rev,
+                                              lease=lease, client=client)
                 else:  # delete
-                    rev, _prev = self.backend.delete(key, guard_rev)
+                    rev, _prev = self.limiter.delete(key, guard_rev,
+                                                     client=client)
             with TRACER.stage("response_encode"):
                 return self._txn_ok(rev, put=kind != "delete")
+        except SchedResultTimeoutError:
+            # the result wait timed out AFTER dispatch: the write may yet
+            # commit, so signal the ambiguous outcome the way etcd does
+            # (ErrTimeout → DeadlineExceeded), never the safe-to-retry
+            # RESOURCE_EXHAUSTED an admission shed gets
+            context.abort(grpc.StatusCode.DEADLINE_EXCEEDED,
+                          "etcdserver: request timed out")
+        except SchedOverloadError as e:
+            # write shed by admission control BEFORE a revision was dealt:
+            # safe to retry, and the etcd error the apiserver's client
+            # already backs off on
+            context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, str(e))
         except LeaseNotFoundError:
             # a put under an unknown/expired lease is a definite failure
             # (etcd ErrLeaseNotFound) — the apiserver re-grants and retries

@@ -105,6 +105,132 @@ class MemKv(KvStorage):
     def begin_batch_write(self) -> BatchWrite:
         return _MemBatch(self)
 
+    def write_batch(self, ops: list) -> list:
+        """Grouped MVCC commit under ONE store-lock acquisition with per-op
+        conditional demux (the group-commit engine contract,
+        docs/writes.md). ``ops`` is a list of
+
+        - ``("create", rev_key, new_rev, rev_val, obj_key, obj_val,
+          last_key, last_val, ttl)``
+        - ``("update", rev_key, rev_val, expected, obj_key, obj_val,
+          last_key, last_val, ttl)``
+        - ``("delete", rev_key, expected_rev, new_rev, new_record,
+          tombstone, last_key, last_val)``
+
+        Each op validates against the state as mutated by earlier ops in
+        the SAME group and either applies atomically (its own commit
+        timestamp, exactly like a sequential batch commit) or fails alone.
+        Outcomes, aligned with ``ops``:
+
+        - create/update: ``("ok",)`` or ``("conflict", observed_record)``
+          or ``("drift", latest_rev)`` (create over a same-or-newer
+          tombstone);
+        - delete: the ``mvcc_delete`` quadruple —
+          ``("ok", prev_value, latest_rev)`` / ``("not_found", None,
+          latest_rev)`` / ``("mismatch", prev_value, latest_rev)`` /
+          ``("drift", latest_rev)``.
+
+        The create op resolves the creator's tombstone-conversion branch
+        in-engine (naive.go:83-86): under the store lock there is no
+        read-then-CAS race, so the two-attempt loop collapses to a branch.
+        Record parsing uses the shared MVCC codec — the same format the
+        native engine's C `kb_mvcc_delete` parses."""
+        from .. import coder
+
+        out: list = []
+        with self._lock:
+            now = time.time()
+            for op in ops:
+                kind = op[0]
+                if kind == "create":
+                    out.append(self._wb_create(op, now, coder))
+                elif kind == "update":
+                    out.append(self._wb_update(op, now))
+                elif kind == "delete":
+                    out.append(self._wb_delete(op, now, coder))
+                else:
+                    out.append(("error", ValueError(f"bad op kind {kind!r}")))
+        return out
+
+    def _wb_apply(self, puts: list[tuple[bytes, bytes, int]], now: float) -> None:
+        """One successful group member = one commit timestamp (identical to
+        a sequential ``begin_batch_write().commit()``); TTL is per row —
+        the record and object rows carry the member's TTL, the watermark
+        row never does, exactly like ``Backend._commit_write``."""
+        self._ts += 1
+        for key, value, ttl in puts:
+            expire_at = now + ttl if ttl else 0.0
+            self._append(key, _Version(self._ts, value, expire_at))
+
+    def _wb_create(self, op, now: float, coder):
+        _, rev_key, new_rev, rev_val, obj_key, obj_val, last_key, last_val, ttl = op
+        cur = self._live_value(rev_key, None, now)
+        if cur is not None:
+            try:
+                old_rev, deleted = coder.decode_rev_value(cur)
+            except coder.CodecError:
+                return ("conflict", cur)
+            if not deleted:
+                return ("conflict", cur)
+            if old_rev >= new_rev:
+                return ("drift", old_rev)
+            # deleted at a lower revision: create becomes an update over the
+            # tombstone (creator conversion, resolved in-engine)
+        self._wb_apply([(rev_key, rev_val, ttl), (obj_key, obj_val, ttl),
+                        (last_key, last_val, 0)], now)
+        return ("ok",)
+
+    def _wb_update(self, op, now: float):
+        _, rev_key, rev_val, expected, obj_key, obj_val, last_key, last_val, ttl = op
+        cur = self._live_value(rev_key, None, now)
+        if cur != expected:
+            return ("conflict", cur)
+        self._wb_apply([(rev_key, rev_val, ttl), (obj_key, obj_val, ttl),
+                        (last_key, last_val, 0)], now)
+        return ("ok",)
+
+    def _wb_delete(self, op, now: float, coder):
+        _, rev_key, expected_rev, new_rev, new_record, tombstone, last_key, last_val = op
+        cur = self._live_value(rev_key, None, now)
+        if cur is None:
+            return ("not_found", None, 0)
+        try:
+            latest, deleted = coder.decode_rev_value(cur)
+        except coder.CodecError:
+            return ("not_found", None, 0)
+        if deleted:
+            return ("not_found", None, latest)
+        ukey, _ = coder.decode(rev_key)
+        prev = self._live_value(coder.encode_object_key(ukey, latest), None, now)
+        if expected_rev and latest != expected_rev:
+            return ("mismatch", prev, latest)
+        if new_rev <= latest:
+            return ("drift", latest)
+        self._wb_apply([(rev_key, new_record, 0),
+                        (coder.encode_object_key(ukey, new_rev), tombstone, 0),
+                        (last_key, last_val, 0)], now)
+        return ("ok", prev, latest)
+
+    def mvcc_delete(self, rev_key: bytes, expected_rev: int, new_rev: int,
+                    new_record: bytes, tombstone: bytes, last_key: bytes,
+                    last_val: bytes) -> tuple:
+        """One-call read-validate-tombstone delete (the native engine's
+        ``kb_mvcc_delete`` contract) — the sequential delete then takes
+        ``Backend._delete_fast``, where a failed delete consumes its dealt
+        revision exactly like a failed group member, so grouped and
+        sequential revision streams stay byte-identical on this engine."""
+        from .. import coder
+        from .errors import RevisionDriftBackError
+
+        with self._lock:
+            out = self._wb_delete(
+                ("delete", rev_key, expected_rev, new_rev, new_record,
+                 tombstone, last_key, last_val), time.time(), coder)
+        if out[0] == "drift":
+            raise RevisionDriftBackError(
+                f"revision drift on delete (latest {out[1]})", latest=out[1])
+        return out
+
     def _commit(self, ops: list[tuple]) -> None:
         with self._lock:
             now = time.time()

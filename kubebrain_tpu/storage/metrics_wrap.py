@@ -19,6 +19,8 @@ class MetricsKvStorage(KvStorage):
             self.mvcc_write = self._mvcc_write_timed
         if hasattr(inner, "mvcc_delete"):
             self.mvcc_delete = self._mvcc_delete_timed
+        if hasattr(inner, "write_batch"):
+            self.write_batch = self._write_batch_timed
         if hasattr(inner, "prune_versions"):
             self.prune_versions = inner.prune_versions
 
@@ -29,6 +31,11 @@ class MetricsKvStorage(KvStorage):
     def _mvcc_delete_timed(self, *args, **kwargs):
         with self._m.timed("storage.mvcc_delete"):
             return self._inner.mvcc_delete(*args, **kwargs)
+
+    def _write_batch_timed(self, ops):
+        self._m.emit_counter("storage.write_batch.ops", len(ops))
+        with self._m.timed("storage.write_batch"):
+            return self._inner.write_batch(ops)
 
     def get_timestamp_oracle(self) -> int:
         return self._inner.get_timestamp_oracle()

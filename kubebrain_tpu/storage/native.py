@@ -275,6 +275,16 @@ class NativeKv(KvStorage):
         keep_after_ts; returns versions freed."""
         return int(self._lib.kb_prune(self._store, keep_after_ts))
 
+    def write_batch(self, ops: list) -> list:
+        """Group-commit executor (docs/writes.md): the shared loop over the
+        one-FFI-call MVCC fast paths below — each op is already a single C
+        round trip; the group's wins live above the engine (one scheduler
+        dispatch, one revision block, one ring pass). A native C grouped op
+        (one FFI call for the whole group) is the documented next step."""
+        from .groupwrite import mvcc_write_batch
+
+        return mvcc_write_batch(self, ops)
+
     def mvcc_write(
         self,
         rev_key: bytes,

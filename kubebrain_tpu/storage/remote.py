@@ -856,6 +856,16 @@ class RemoteKvStorage(KvStorage):
         return keys, lens, revs, tomb, arena, offsets
 
     # ------------------------------------------- MVCC one-round-trip paths
+    def write_batch(self, ops: list) -> list:
+        """Group-commit executor (docs/writes.md): the shared loop over the
+        one-round-trip MVCC frames below. The wire round trips stay per-op
+        until kbstored grows an OP_WRITE_BATCH frame (documented future
+        work); the group still pays one scheduler dispatch, one contiguous
+        revision block, and one ring pass above the engine."""
+        from .groupwrite import mvcc_write_batch
+
+        return mvcc_write_batch(self, ops)
+
     def mvcc_write(self, rev_key, rev_val, expected, obj_key, obj_val,
                    last_key, last_val, ttl_seconds=0) -> None:
         body = bytearray(struct.pack(

@@ -54,6 +54,11 @@ class Mirror:
     max_rev: int
     key_width: int = 0              # RAW packed key width (bytes)
     encoding: KeyEncoding | None = None
+    # host TTL flag column (row-aligned with ttl_dev): lets the incremental
+    # stored-domain merge and the pallas TTL layout run without a device
+    # pull, and lets merged TTL flags ride the delta instead of being
+    # recomputed from (undecodable) encoded keys
+    ttl_host: np.ndarray | None = None  # bool[P, N]
 
     @property
     def partitions(self) -> int:
@@ -169,27 +174,41 @@ def rows_to_arrays(rows: list[tuple[bytes, int, bytes]], width: int):
     return keys_u8, lens, revs, tomb, arena, offsets
 
 
-def merge_sorted_arrays(a, b):
-    """Merge two row-array quintuples into one, sorted by (key, revision).
+def _merge_sorted_blocks(blocks: list[tuple]) -> tuple:
+    """k-way merge of row-array tuples sorted by (key, revision).
 
-    Sort key = raw key bytes + big-endian revision, compared as a void
-    scalar (memcmp) — a single numpy argsort, no Python comparisons.
-    """
-    keys_u8 = np.concatenate([a[0], b[0]])
-    lens = np.concatenate([a[1], b[1]])
-    revs = np.concatenate([a[2], b[2]])
-    tomb = np.concatenate([a[3], b[3]])
+    Each block is ``(keys_u8[n, W], *columns, arena, offsets)`` — any
+    number of row-aligned 1-D columns between the key matrix and the
+    value arena. Sort key = key bytes + big-endian revision (the column
+    right after the keys), compared as a void scalar (memcmp) — a single
+    numpy argsort, no Python comparisons. Shared by the raw-domain
+    :func:`merge_sorted_arrays` and the stored-domain
+    :func:`merge_sorted_stored` so the two merge paths cannot diverge."""
+    ncols = len(blocks[0]) - 3  # columns between keys and arena
+    keys_u8 = np.concatenate([b[0] for b in blocks])
+    cols = [np.concatenate([b[1 + c] for b in blocks]) for c in range(ncols)]
+    revs = cols[1]  # (keys, lens, revs, ...) in every caller
     n, w = keys_u8.shape
     rev_be = revs[:, None].astype(">u8").view(np.uint8).reshape(n, 8)
     sort_rows = np.ascontiguousarray(np.concatenate([keys_u8, rev_be], axis=1))
     void = sort_rows.view([("v", f"V{w + 8}")]).reshape(n)
     perm = np.argsort(void, kind="stable")
-    # merge arenas, then reorder by perm
-    arena = np.concatenate([a[4], b[4]])
-    off_b = b[5].astype(np.int64) + int(a[5][-1])
-    offsets = np.concatenate([a[5].astype(np.int64)[:-1], off_b]).astype(np.uint64)
+    # merge arenas (rebase each block's offsets), then reorder by perm
+    arena = np.concatenate([b[-2] for b in blocks])
+    bases = np.cumsum([0] + [len(b[-2]) for b in blocks[:-1]]).astype(np.int64)
+    offsets = np.concatenate(
+        [b[-1].astype(np.int64)[:-1] + base
+         for b, base in zip(blocks, bases)]
+        + [np.array([len(arena)], dtype=np.int64)]
+    ).astype(np.uint64)
     new_arena, new_offsets = keyops.gather_arena(arena, offsets, perm)
-    return keys_u8[perm], lens[perm], revs[perm], tomb[perm], new_arena, new_offsets
+    return (keys_u8[perm], *(c[perm] for c in cols), new_arena, new_offsets)
+
+
+def merge_sorted_arrays(a, b):
+    """Merge two RAW row-array sextuples ``(keys, lens, revs, tomb,
+    arena, offsets)`` into one, sorted by (key, revision)."""
+    return _merge_sorted_blocks([a, b])
 
 
 def padded_capacity(count: int) -> int:
@@ -312,7 +331,7 @@ def build_mirror_from_arrays(
         n_valid=n_valid, val_arena=arenas, val_offsets=offs,
         snapshot_ts=snapshot_ts,
         max_rev=int(revs.max()) if n else 0,
-        key_width=key_width, encoding=encoding,
+        key_width=key_width, encoding=encoding, ttl_host=ttl_h,
     )
 
 
@@ -364,6 +383,174 @@ def _assemble_sharded(mesh, host_arr: np.ndarray, old_dev, dirty: set[int]):
         else:
             shards.append(by_dev[d])
     return jax.make_array_from_single_device_arrays(host_arr.shape, sharding, shards)
+
+
+def merge_sorted_stored(blocks: list[tuple]) -> tuple:
+    """Merge k sorted STORED-domain row blocks into one.
+
+    A stored block is a septuple ``(keys_u8[n, W], lens, revs, tomb, ttl,
+    arena, offsets)`` whose key bytes live in the mirror's compare domain —
+    raw packed bytes for a raw mirror, dictionary-encoded rows for an
+    encoded one. Encoded lexicographic order equals raw byte order
+    (storage/tpu/encode.py order preservation) and the encoding is
+    injective, so ONE void argsort over ``key || rev_be`` merges encoded
+    blocks as exactly as raw ones — the k-way merge of the write-path
+    delta blocks (docs/writes.md). Shares :func:`_merge_sorted_blocks`
+    with the raw-domain :func:`merge_sorted_arrays` so the two merge
+    paths cannot diverge."""
+    if len(blocks) == 1:
+        return blocks[0]
+    return _merge_sorted_blocks(blocks)
+
+
+def merge_partitions_stored(
+    mirror: Mirror,
+    delta: tuple,  # sorted stored-domain septuple (see merge_sorted_stored)
+    mesh,
+    snapshot_ts: int,
+) -> Mirror | None:
+    """Incremental merge of a STORED-domain delta into the mirror — the
+    write-path successor to :func:`merge_partitions_incremental`.
+
+    The delta rows arrive already encoded against the published dictionary
+    (sealed at write time, PR 9's incremental re-encode moved off the merge
+    path), so a dirty partition merges by pure byte interleave: no
+    partition decode, no raw-domain merge, no re-encode — per-merge host
+    work is O(delta + dirty-partition memcpy). TTL flags ride the delta
+    column and the mirror's host TTL column, so the merge never touches the
+    device except for the dirty-shard-only republish
+    (:func:`_assemble_sharded`, PR 7 machinery).
+
+    A partition outgrowing its padded capacity does NOT force the full
+    decode → re-dictionary → re-partition host rebuild: the stored-domain
+    arrays grow to the next padded capacity by pure memcpy (every shard
+    republishes — the device pays, the host never re-sorts or re-encodes),
+    which is what keeps a sustained write storm on the incremental path
+    between compactions (compaction re-partitions and re-fits capacity).
+    Returns None only when the mirror predates the host TTL column or the
+    delta's stored width no longer matches (a re-dictionaried mirror) —
+    the true full-rebuild cases."""
+    d_keys, d_lens, d_revs, d_tomb, d_ttl, d_arena, d_offsets = delta
+    dn = len(d_keys)
+    if dn == 0:
+        return mirror
+    if mirror.ttl_host is None:
+        return None  # pre-ttl_host mirror: full rebuild re-derives everything
+    P = mirror.partitions
+    cap = mirror.keys_host.shape[1]
+    W = mirror.keys_host.shape[2] * 4
+    if d_keys.shape[1] != W:
+        return None  # stored-width drift (re-dictionaried mirror): rebuild
+
+    # route delta rows to non-empty partitions by the partitions' FIRST
+    # STORED rows — stored order == raw order, so the stored compare routes
+    # identically to the raw-domain routing of merge_partitions_incremental
+    nonempty = [p for p in range(P) if mirror.n_valid[p] > 0]
+    if not nonempty:
+        return None  # nothing to merge into; full rebuild re-partitions
+    firsts = np.stack([mirror.keys_host[p, 0] for p in nonempty])
+    firsts_u8 = keyops.chunks_to_u8(firsts)
+    firsts_void = keyops.u8_void(np.ascontiguousarray(firsts_u8))
+    d_void = keyops.u8_void(np.ascontiguousarray(d_keys))
+    # last non-empty partition whose first key <= row key (rows below the
+    # first partition's floor route to it)
+    pos = np.maximum(np.searchsorted(firsts_void, d_void, side="right") - 1, 0)
+    row_part = np.asarray(nonempty, dtype=np.int64)[pos]
+    # row_part is non-decreasing (sorted delta routed through sorted
+    # firsts), so each dirty partition owns ONE contiguous delta slice —
+    # locate every slice with two binary searches instead of a full-delta
+    # boolean scan per partition (this runs in the merge critical section)
+    dirty = np.unique(row_part).tolist()
+    part_lo = np.searchsorted(row_part, np.asarray(dirty), side="left")
+    part_hi = np.searchsorted(row_part, np.asarray(dirty), side="right")
+
+    # capacity check up front: if any dirty partition outgrows the padded
+    # cap, grow EVERY partition's stored arrays to the next padded
+    # capacity (memcpy, no decode/re-encode/re-sort) and republish all
+    # shards — the write-storm path that must never fall back to the full
+    # host rebuild between compactions
+    need = int(max(
+        int(mirror.n_valid[p]) + int(hi - lo)
+        for p, lo, hi in zip(dirty, part_lo, part_hi)))
+    grew = need > cap
+    if grew:
+        new_cap = padded_capacity(need)
+        keys_h = np.zeros((P, new_cap, mirror.keys_host.shape[2]),
+                          dtype=mirror.keys_host.dtype)
+        lens_h = np.zeros((P, new_cap), dtype=mirror.lens_host.dtype)
+        revs_h = np.zeros((P, new_cap), dtype=mirror.revs_host.dtype)
+        tomb_h = np.zeros((P, new_cap), dtype=mirror.tomb_host.dtype)
+        ttl_h = np.zeros((P, new_cap), dtype=mirror.ttl_host.dtype)
+        for p in range(P):
+            nv = int(mirror.n_valid[p])
+            keys_h[p, :nv] = mirror.keys_host[p, :nv]
+            lens_h[p, :nv] = mirror.lens_host[p, :nv]
+            revs_h[p, :nv] = mirror.revs_host[p, :nv]
+            tomb_h[p, :nv] = mirror.tomb_host[p, :nv]
+            ttl_h[p, :nv] = mirror.ttl_host[p, :nv]
+        cap = new_cap
+    else:
+        # copy-on-write: readers hold the old Mirror object
+        keys_h = mirror.keys_host.copy()
+        lens_h = mirror.lens_host.copy()
+        revs_h = mirror.revs_host.copy()
+        tomb_h = mirror.tomb_host.copy()
+        ttl_h = mirror.ttl_host.copy()
+    n_valid = mirror.n_valid.copy()
+    arenas = list(mirror.val_arena)
+    offs = list(mirror.val_offsets)
+
+    d_off64 = d_offsets.astype(np.int64)
+    for p, lo, hi in zip(dirty, part_lo, part_hi):
+        lo, hi = int(lo), int(hi)
+        nv = int(n_valid[p])
+        mn = nv + (hi - lo)
+        part = (
+            keyops.chunks_to_u8(mirror.keys_host[p, :nv]),
+            mirror.lens_host[p, :nv], mirror.revs_host[p, :nv],
+            mirror.tomb_host[p, :nv], mirror.ttl_host[p, :nv],
+            mirror.val_arena[p][: int(mirror.val_offsets[p][nv])],
+            mirror.val_offsets[p][: nv + 1],
+        )
+        dslice = (
+            d_keys[lo:hi], d_lens[lo:hi], d_revs[lo:hi], d_tomb[lo:hi],
+            d_ttl[lo:hi],
+            d_arena[d_off64[lo] : d_off64[hi]],
+            (d_off64[lo : hi + 1] - d_off64[lo]).astype(np.uint64),
+        )
+        mk, ml, mr, mt, mttl, ma, mo = merge_sorted_stored([part, dslice])
+        keys_h[p, :mn] = keyops.bytes_to_chunks(np.ascontiguousarray(mk))
+        lens_h[p, :mn] = ml
+        revs_h[p, :mn] = mr
+        tomb_h[p, :mn] = mt
+        ttl_h[p, :mn] = mttl
+        ttl_h[p, mn:] = False
+        n_valid[p] = mn
+        arenas[p] = ma
+        offs[p] = mo
+
+    rh_all, rl_all = keyops.split_revs(revs_h.reshape(-1))
+    rh_all = rh_all.reshape(P, cap)
+    rl_all = rl_all.reshape(P, cap)
+
+    ds = set(dirty)
+    return Mirror(
+        keys_dev=_assemble_sharded(mesh, keys_h, mirror.keys_dev, ds),
+        rh_dev=_assemble_sharded(mesh, rh_all, mirror.rh_dev, ds),
+        rl_dev=_assemble_sharded(mesh, rl_all, mirror.rl_dev, ds),
+        tomb_dev=_assemble_sharded(mesh, tomb_h, mirror.tomb_dev, ds),
+        ttl_dev=_assemble_sharded(mesh, ttl_h, mirror.ttl_dev, ds),
+        n_valid_dev=(
+            jax.device_put(n_valid) if mesh is None
+            else jax.device_put(
+                n_valid, NamedSharding(mesh, PartitionSpec("part")))
+        ),
+        keys_host=keys_h, lens_host=lens_h, revs_host=revs_h, tomb_host=tomb_h,
+        n_valid=n_valid, val_arena=arenas, val_offsets=offs,
+        snapshot_ts=snapshot_ts,
+        max_rev=max(mirror.max_rev, int(d_revs.max())),
+        key_width=mirror.key_width, encoding=mirror.encoding, ttl_host=ttl_h,
+    )
 
 
 def merge_partitions_incremental(
@@ -469,8 +656,10 @@ def merge_partitions_incremental(
     rh_all, rl_all = keyops.split_revs(revs_h.reshape(-1))
     rh_all = rh_all.reshape(P, cap)
     rl_all = rl_all.reshape(P, cap)
-    ttl_h = np.array(jax.device_get(mirror.ttl_dev)) if ttl_dirty else None
-    if ttl_h is not None:
+    ttl_h = None
+    if ttl_dirty:
+        ttl_h = (mirror.ttl_host.copy() if mirror.ttl_host is not None
+                 else np.array(jax.device_get(mirror.ttl_dev)))
         for p, row in ttl_dirty.items():
             ttl_h[p] = row
 
@@ -492,4 +681,5 @@ def merge_partitions_incremental(
         snapshot_ts=snapshot_ts,
         max_rev=max(mirror.max_rev, int(d_revs.max())),
         key_width=mirror.key_width, encoding=mirror.encoding,
+        ttl_host=ttl_h if ttl_h is not None else mirror.ttl_host,
     )

@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import functools
 import threading
+import time
 
 import jax
 import jax.numpy as jnp
@@ -43,23 +44,45 @@ from .blocks import (
     Mirror,
     build_mirror,
     build_mirror_from_arrays,
-    merge_partitions_incremental,
+    compute_ttl_flags,
+    merge_partitions_incremental,  # noqa: F401  (raw-domain path, tests/compat)
+    merge_partitions_stored,
     merge_sorted_arrays,
+    merge_sorted_stored,
     rows_to_arrays,
 )
+from .encode import EncodeOverflow
 
 
 class _DeltaIndex:
     """Commit-order delta rows PLUS a sorted key index, so read overlays
     cost O(log d + matches) instead of a full O(d) Python scan per query
-    (VERDICT r1 weak #5). Writers append; per-key revision lists only grow."""
+    (VERDICT r1 weak #5). Writers append; per-key revision lists only grow.
 
-    __slots__ = ("_rows", "_keys", "_by_key")
+    The index ALSO accumulates the rows into sealed, sorted, STORED-domain
+    blocks (``seal_rows`` rows each; encoded against the published
+    dictionary when the mirror is encoded) so the incremental merge
+    (:func:`blocks.merge_partitions_stored`) consumes ready-made sorted
+    encoded runs instead of re-sorting and re-encoding the whole delta
+    under the engine lock — the write-path half of PR 9's incremental
+    re-encode. A key the dictionary cannot express marks the index
+    ``overflowed`` (the merge then falls back to the full re-dictionary
+    rebuild, which reads the raw rows kept alongside)."""
 
-    def __init__(self):
+    __slots__ = ("_rows", "_keys", "_by_key", "_width", "_encoding",
+                 "_seal_rows", "_blocks", "_sealed_upto", "_overflow")
+
+    def __init__(self, width: int = keyops.KEY_WIDTH, encoding=None,
+                 seal_rows: int = 512):
         self._rows: list[tuple[bytes, int, bytes]] = []
         self._keys: list[bytes] = []  # sorted, unique
         self._by_key: dict[bytes, list[tuple[int, bytes]]] = {}
+        self._width = width
+        self._encoding = encoding
+        self._seal_rows = max(1, seal_rows)
+        self._blocks: list[tuple] = []  # sealed stored-domain septuples
+        self._sealed_upto = 0
+        self._overflow = False
 
     def extend(self, rows) -> None:
         import bisect
@@ -72,6 +95,42 @@ class _DeltaIndex:
                 bisect.insort(self._keys, ukey)
             else:
                 lst.append((rev, value))
+        while len(self._rows) - self._sealed_upto >= self._seal_rows:
+            hi = self._sealed_upto + self._seal_rows
+            self._seal(self._rows[self._sealed_upto:hi])
+            self._sealed_upto = hi
+
+    def _seal(self, rows: list[tuple[bytes, int, bytes]]) -> None:
+        """Sort one run and move it into the mirror's stored domain. Sealing
+        amortizes over writes (one small argsort + encode per ``seal_rows``
+        rows) so merge time pays only the k-way interleave."""
+        raw = rows_to_arrays(rows, self._width)
+        k, l, r, t, arena, off = merge_sorted_arrays(
+            rows_to_arrays([], self._width), raw)
+        ttl = compute_ttl_flags(k, l)
+        if self._encoding is not None and not self._overflow:
+            try:
+                k, l = self._encoding.encode_keys(k, l)
+            except EncodeOverflow:
+                # inexpressible key: the whole delta merges via the full
+                # re-dictionary rebuild (raw rows kept in self._rows)
+                self._overflow = True
+        self._blocks.append((k, np.asarray(l, np.int32), r, t, ttl,
+                             arena, off))
+
+    def snapshot_blocks(self) -> tuple[list[tuple], list, bool]:
+        """Seal the open tail and return ``(sealed blocks, raw-row prefix,
+        overflowed)`` — the merge's input snapshot. Rows appended after
+        this call stay in the index (the caller re-indexes the tail after
+        the swap)."""
+        if self._sealed_upto < len(self._rows):
+            self._seal(self._rows[self._sealed_upto:])
+            self._sealed_upto = len(self._rows)
+        return list(self._blocks), self._rows[: self._sealed_upto], self._overflow
+
+    def tail_rows(self, n: int) -> list[tuple[bytes, int, bytes]]:
+        """Rows appended after a ``snapshot_blocks`` that covered ``n``."""
+        return self._rows[n:]
 
     def __len__(self) -> int:
         return len(self._rows)
@@ -417,9 +476,26 @@ class TpuScanner(Scanner):
         self._pallas_ttl_cache: tuple[Mirror, object] | None = None
         self._probe_cache: tuple[Mirror, list] | None = None
         self._mlock = threading.RLock()
+        # mergers serialize on their own lock and do the heavy interleave
+        # OFF _mlock — readers keep serving mirror+overlay while a merge
+        # runs (lock order: _merge_lock before _mlock, never the reverse)
+        self._merge_lock = threading.Lock()
+        # single-flight admission for write-kicked background merges
+        self._merge_kick = threading.Lock()
         self._mirror: Mirror | None = None
-        self._delta = _DeltaIndex()
+        self._delta = _DeltaIndex(self._kw)
         self._force_rebuild = True
+        self._metrics = None
+        # merge accounting (also exported as kb_mirror_merge_* metrics):
+        # steady state must show merge_rows_total accounting every delta row
+        # with full_rebuild_total flat (bench write phase asserts this)
+        self.merge_count = 0
+        self.merge_rows_total = 0
+        self.full_rebuild_total = 0
+        # background (write-kicked) merge failures: counted + last error
+        # kept so a deterministic merge defect is never silent
+        self.merge_bg_errors = 0
+        self._merge_bg_last_error: Exception | None = None
 
     # -------------------------------------------------------------- metrics
     def register_metrics(self, metrics) -> None:
@@ -430,7 +506,10 @@ class TpuScanner(Scanner):
         ``kb_mirror_raw_bytes{device=}`` gauge reports what the SAME shard
         would cost with raw (un-encoded) keys, so the prefix-encoding HBM
         saving is scrape-visible as a ratio of the two series."""
-        if metrics is None or self._mesh is None:
+        if metrics is None:
+            return
+        self._metrics = metrics  # also feeds kb_mirror_merge_* emissions
+        if self._mesh is None:
             return
         for d in self._mesh.devices.flat:
             metrics.register_gauge_fn(
@@ -501,6 +580,41 @@ class TpuScanner(Scanner):
     def record_version_rows(self, rows: list[tuple[bytes, int, bytes]]) -> None:
         with self._mlock:
             self._delta.extend(rows)  # O(log d) per row via the key index
+            kick = (self._mirror is not None and not self._force_rebuild
+                    and len(self._delta) >= self._merge_threshold)
+        if kick:
+            self._kick_merge()
+
+    def _kick_merge(self) -> None:
+        """Single-flight BACKGROUND incremental merge: a write burst that
+        crosses the merge threshold starts the merge itself instead of
+        leaving the whole accumulated delta for the next reader to pay
+        (docs/writes.md). If a merge is already in flight the kick is
+        dropped — the next threshold crossing re-kicks, and the final
+        ``publish()`` sweeps any tail."""
+        if not self._merge_kick.acquire(blocking=False):
+            return
+
+        def run() -> None:
+            try:
+                self._merge_delta()
+            except Exception as e:
+                # best-effort maintenance: a racing close/compact can pull
+                # the store out from under us — readers are unaffected
+                # (they still serve mirror + overlay) and the next
+                # publish()/read retries on the foreground path. NOT
+                # silent, though: a deterministic merge defect would fail
+                # every kick, so count it scrape-visibly and keep the
+                # last error for the foreground path to surface.
+                self.merge_bg_errors += 1
+                self._merge_bg_last_error = e
+                if self._metrics is not None:
+                    self._metrics.emit_counter("kb.mirror.merge.errors", 1)
+            finally:
+                self._merge_kick.release()
+
+        threading.Thread(target=run, name="kb-mirror-merge",
+                         daemon=True).start()
 
     def mark_uncertain(self) -> None:
         """A commit with unknowable outcome may or may not have produced
@@ -513,8 +627,13 @@ class TpuScanner(Scanner):
         with self._mlock:
             if self._force_rebuild or self._mirror is None:
                 self._rebuild_from_store()
-            elif self._delta and (full or len(self._delta) >= self._merge_threshold):
-                self._merge_delta()
+                return
+            if not (self._delta
+                    and (full or len(self._delta) >= self._merge_threshold)):
+                return
+        # threshold crossed: merge OFF the engine lock — concurrent readers
+        # keep serving mirror+overlay (overlay-wins is exact either way)
+        self._merge_delta()
 
     def _rebuild_from_store(self) -> None:
         snapshot = self._store.get_timestamp_oracle()
@@ -554,37 +673,94 @@ class TpuScanner(Scanner):
             self._mirror = build_mirror(rows, self._mesh, self._kw, snapshot,
                                         n_parts=self._partitions or None,
                                         encode=self._encode)
-        self._delta = _DeltaIndex()
+        self._delta = self._fresh_delta()
         self._force_rebuild = False
         self._pallas_cache = None  # old mirror's device copies must not pin
         self._pallas_ttl_cache = None
         self._probe_cache = None
 
+    def _fresh_delta(self) -> _DeltaIndex:
+        """A delta index bound to the CURRENT mirror's stored domain, so
+        write-time sealing encodes against the published dictionary."""
+        enc = self._mirror.encoding if self._mirror is not None else None
+        seal = max(64, min(512, self._merge_threshold // 4 or 64))
+        return _DeltaIndex(self._kw, encoding=enc, seal_rows=seal)
+
     def _merge_delta(self) -> None:
-        """Dirty-partition-only merge: sort the delta alone, two-way merge it
-        into just the partitions it lands in, re-upload only those shards.
-        Falls back to the full re-partitioning rebuild when a partition
-        overflows its padded capacity."""
-        ts = self._store.get_timestamp_oracle()
-        delta_arrays = rows_to_arrays(self._delta.rows(), self._kw)
-        empty = rows_to_arrays([], self._kw)
-        sorted_delta = merge_sorted_arrays(empty, delta_arrays)
-        m = merge_partitions_incremental(
-            self._mirror, sorted_delta, self._mesh, self._kw, ts
-        )
-        if m is None:
-            # full re-dictionary rebuild: flat_arrays decodes to RAW rows,
-            # merge there, and build_mirror_from_arrays derives a FRESH
-            # dictionary sized to the merged keyspace
-            merged = merge_sorted_arrays(self._mirror.flat_arrays(), sorted_delta)
-            m = build_mirror_from_arrays(*merged, self._mesh, self._kw, ts,
-                                         n_parts=self._partitions or None,
-                                         encode=self._encode)
-        self._mirror = m
-        self._delta = _DeltaIndex()
-        self._pallas_cache = None  # re-layout lazily on the next pallas query
-        self._pallas_ttl_cache = None
-        self._probe_cache = None
+        """Incremental delta merge, OFF the engine lock (docs/writes.md).
+
+        The delta accumulated into sorted stored-domain blocks at write
+        time; here they k-way interleave (:func:`merge_sorted_stored`) and
+        land in only the dirty partitions with a dirty-shard-only device
+        republish (:func:`merge_partitions_stored`) — no partition decode,
+        no re-encode, no stop-the-world host rebuild. Readers keep serving
+        the published mirror + overlay throughout; the swap happens under
+        ``_mlock`` and keeps every row appended after the snapshot in the
+        successor overlay. Falls back to the full re-partitioning (and,
+        when a delta key no longer fits the dictionary, re-dictionary)
+        rebuild — counted separately so a bench can assert the steady
+        state never takes it."""
+        with self._merge_lock:
+            t0 = time.monotonic()
+            with self._mlock:
+                if self._force_rebuild or self._mirror is None:
+                    self._rebuild_from_store()
+                    return
+                mirror = self._mirror
+                blocks, rows_prefix, overflow = self._delta.snapshot_blocks()
+            n_rows = len(rows_prefix)
+            if n_rows == 0:
+                return
+            ts = self._store.get_timestamp_oracle()
+            m = None
+            full = False
+            if not overflow:
+                delta7 = merge_sorted_stored(blocks)
+                m = merge_partitions_stored(mirror, delta7, self._mesh, ts)
+            if m is None:
+                # full rebuild: re-partition (capacity overflow) or
+                # re-dictionary (EncodeOverflow at seal time) — flat_arrays
+                # decodes to RAW rows, merge there, fresh dictionary sized
+                # to the merged keyspace
+                full = True
+                sorted_delta = merge_sorted_arrays(
+                    rows_to_arrays([], self._kw),
+                    rows_to_arrays(rows_prefix, self._kw))
+                merged = merge_sorted_arrays(mirror.flat_arrays(), sorted_delta)
+                m = build_mirror_from_arrays(*merged, self._mesh, self._kw, ts,
+                                             n_parts=self._partitions or None,
+                                             encode=self._encode)
+            with self._mlock:
+                if self._mirror is not mirror:
+                    # superseded mid-merge (uncertainty rebuild / compact):
+                    # the fresher mirror came straight from the store —
+                    # discard this merge, its rows are already covered
+                    return
+                self._mirror = m
+                tail = self._delta.tail_rows(n_rows)
+                self._delta = self._fresh_delta()
+                if tail:
+                    self._delta.extend(tail)
+                self._pallas_cache = None  # re-layout on the next pallas query
+                self._pallas_ttl_cache = None
+                self._probe_cache = None
+                # accounting lands in the SAME critical section as the swap:
+                # publish()'s empty-delta fast path returns under _mlock
+                # without touching _merge_lock, so anyone who observed the
+                # merged (empty) delta must also observe these counters
+                dt = time.monotonic() - t0
+                self.merge_count += 1
+                if full:
+                    self.full_rebuild_total += 1
+                else:
+                    self.merge_rows_total += n_rows
+            if self._metrics is not None:
+                self._metrics.emit_histogram(
+                    "kb.mirror.merge.seconds", dt,
+                    kind="full_rebuild" if full else "incremental")
+                if not full:
+                    self._metrics.emit_counter(
+                        "kb.mirror.merge.rows.total", n_rows)
 
     def publish(self) -> None:
         """Force the mirror fully up to date (bench/startup hook)."""
@@ -1288,7 +1464,11 @@ class TpuScanner(Scanner):
                     self._store.get_timestamp_oracle(),
                     n_parts=self._partitions or None, encode=self._encode,
                 )
-                self._delta = _DeltaIndex()
+                # bind the fresh delta to the NEW mirror's stored domain —
+                # a bare _DeltaIndex() would seal raw default-width blocks
+                # that fail merge_partitions_stored's width check, forcing a
+                # full rebuild on the first post-compact merge
+                self._delta = self._fresh_delta()
                 self._pallas_cache = None
                 self._pallas_ttl_cache = None
                 self._probe_cache = None
@@ -1316,6 +1496,8 @@ class TpuKvStorage(KvStorage):
             self.mvcc_write = self._mvcc_write_tracked
         if hasattr(inner, "mvcc_delete"):
             self.mvcc_delete = self._mvcc_delete_tracked
+        if hasattr(inner, "write_batch"):
+            self.write_batch = self._write_batch_tracked
 
     # ---- scanner wiring (Backend calls make_scanner, storage/__init__.py)
     def make_scanner(self, **kw) -> TpuScanner:
@@ -1376,6 +1558,49 @@ class TpuKvStorage(KvStorage):
             ukey, rev = coder.decode(obj_key)
             if rev != 0:
                 self._on_committed([(ukey, rev, obj_val)])
+
+    def _write_batch_tracked(self, ops: list) -> list:
+        """Grouped commit through the inner engine, with the whole group's
+        committed version rows recorded into the delta in ONE call, in
+        revision order — a group's rows can never interleave with another
+        writer's between recordings (the group-commit analogue of the
+        per-op tracked fast paths above). Per-op uncertainty (a maybe-
+        applied member) poisons the mirror exactly like a lone uncertain
+        commit."""
+        try:
+            results = self._inner.write_batch(ops)
+        except UncertainResultError:
+            self._on_uncertain()
+            raise
+        rows: list[tuple[bytes, int, bytes]] = []
+        uncertain = False
+        for op, res in zip(ops, results):
+            status = res[0]
+            if status == "uncertain":
+                uncertain = True
+                continue
+            if status != "ok":
+                continue
+            if op[0] == "delete":
+                # ("delete", rev_key, expected_rev, new_rev, new_record,
+                #  tombstone, ...)
+                rev_key, new_rev, tombstone = op[1], op[3], op[5]
+                if coder.is_internal_key(rev_key):
+                    rows.append((coder.decode(rev_key)[0], new_rev, tombstone))
+            else:
+                # ("create", rev_key, new_rev, rev_val, obj_key, obj_val, ...)
+                # ("update", rev_key, rev_val, expected, obj_key, obj_val, ...)
+                # — both shapes carry (obj_key, obj_val) at slots 4/5
+                obj_key, obj_val = op[4], op[5]
+                if coder.is_internal_key(obj_key):
+                    ukey, rev = coder.decode(obj_key)
+                    if rev != 0:
+                        rows.append((ukey, rev, obj_val))
+        if uncertain:
+            self._on_uncertain()
+        elif rows:
+            self._on_committed(rows)
+        return results
 
     def _mvcc_delete_tracked(self, rev_key, expected_rev, new_rev, new_record,
                              tombstone, last_key, last_val):

@@ -608,9 +608,17 @@ class WorkloadRunner:
 
         b_count, b_sum = slo.hist_count_sum(baseline, "kb_sched_batch_size")
         f_count, f_sum = slo.hist_count_sum(final, "kb_sched_batch_size")
+        wb_count, wb_sum = slo.hist_count_sum(
+            baseline, "kb_sched_write_batch_size")
+        wf_count, wf_sum = slo.hist_count_sum(
+            final, "kb_sched_write_batch_size")
         sched = {
             "batched_launches": int(f_count - b_count),
             "batched_requests": int(f_sum - b_sum),
+            # write groups (docs/writes.md): histogram samples only on
+            # REAL formation (>= 2 ops riding one commit group)
+            "write_batched_groups": int(wf_count - wb_count),
+            "write_batched_ops": int(wf_sum - wb_sum),
             "shed_total": int(slo.delta(final, baseline, "kb_sched_shed_total")),
             "coalesced_total": int(slo.delta(
                 final, baseline, "kb_sched_coalesced_total")),
@@ -636,6 +644,17 @@ class WorkloadRunner:
             slo.delta(final, baseline, "kb_lease_keepalive_total"))
         chk("watchers", live_watchers,
             slo.series_count(final, "kb_watch_backlog"))
+        if spec.bounds.min_write_batched_ops > 0:
+            # scenario declares write-group formation mandatory: the
+            # kb_sched_write_batch_size histogram COUNT must have moved
+            # (samples land only on real >= 2-op groups)
+            checks["write_groups_formed"] = {
+                "client": int(spec.bounds.min_write_batched_ops),
+                "server": sched["write_batched_ops"],
+                "ok": sched["write_batched_groups"] > 0
+                and sched["write_batched_ops"]
+                >= spec.bounds.min_write_batched_ops,
+            }
         reconcile_ok = all(c["ok"] for c in checks.values())
 
         replay_ops = len(schedule.replay)
@@ -713,13 +732,23 @@ def main(argv=None) -> int:
                     help="report path (default: WORKLOAD_rNN.json in repo root)")
     ap.add_argument("--smoke", action="store_true",
                     help="small-N CI smoke shape (short, every traffic kind)")
+    ap.add_argument("--scenario", default="cluster",
+                    choices=["cluster", "smoke", "churn-heavy"],
+                    help="traffic preset: cluster (default), smoke, or "
+                         "churn-heavy (pod-churn + keepalive-storm write "
+                         "skew exercising group commit; docs/writes.md)")
     args = ap.parse_args(argv)
 
     mesh_kw = {"mesh_part": args.mesh_part,
                "scan_partitions": args.scan_partitions}
-    if args.smoke:
+    scenario = "smoke" if args.smoke else args.scenario
+    if scenario == "smoke":
         spec = WorkloadSpec.for_smoke(args.nodes, seed=args.seed,
                                       storage=args.storage, **mesh_kw)
+    elif scenario == "churn-heavy":
+        spec = WorkloadSpec.for_churn_heavy(
+            args.nodes, seed=args.seed, duration_s=args.duration,
+            time_scale=args.scale, storage=args.storage, **mesh_kw)
     else:
         spec = WorkloadSpec.for_cluster(
             args.nodes, seed=args.seed, duration_s=args.duration,

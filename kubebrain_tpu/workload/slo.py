@@ -131,7 +131,8 @@ _REQUIRED: dict[str, tuple[str, ...]] = {
     "leases": ("granted", "keepalives_sent", "keepalives_acked",
                "expired_acks", "metrics"),
     "sched": ("batched_launches", "batched_requests", "shed_total",
-              "coalesced_total"),
+              "coalesced_total", "write_batched_groups",
+              "write_batched_ops"),
     "reconcile": ("ok", "checks"),
     "slo": ("pass", "violations", "bounds"),
     "errors": (),
@@ -216,6 +217,11 @@ def evaluate(report: dict, bounds) -> tuple[bool, list[str]]:
     if report["sched"]["batched_requests"] < bounds.min_batched_requests:
         v.append(f"batched requests {report['sched']['batched_requests']} < "
                  f"{bounds.min_batched_requests}")
+    min_wb = getattr(bounds, "min_write_batched_ops", 0)
+    if report["sched"].get("write_batched_ops", 0) < min_wb:
+        v.append(f"write ops in commit groups "
+                 f"{report['sched'].get('write_batched_ops', 0)} < {min_wb} "
+                 "(group commit never formed — docs/writes.md)")
     if not report["reconcile"]["ok"]:
         bad = [c for c, r in report["reconcile"]["checks"].items() if not r["ok"]]
         v.append(f"client/server reconciliation failed: {', '.join(bad)}")

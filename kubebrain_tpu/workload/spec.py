@@ -36,6 +36,11 @@ class SLOBounds:
     #: dispatch (kb_sched_batch_size sum). 0 = don't require batching —
     #: small-N smokes can't guarantee concurrent distinct ranges queue up.
     min_batched_requests: int = 0
+    #: total write ops that must have ridden a group commit
+    #: (kb_sched_write_batch_size sum; docs/writes.md). 0 = don't require
+    #: group formation; the churn_heavy scenario sets it > 0 and the
+    #: reconcile section re-asserts the histogram moved.
+    min_write_batched_ops: int = 0
 
 
 @dataclass(frozen=True)
@@ -132,6 +137,37 @@ class WorkloadSpec:
             SLOBounds(min_batched_requests=2 if nodes >= 100 else 0))
         return cls(nodes=nodes, namespaces=namespaces, bounds=bounds,
                    **overrides)
+
+    @classmethod
+    def for_churn_heavy(cls, nodes: int, **overrides) -> "WorkloadSpec":
+        """Write-storm scenario (docs/writes.md): pod churn ~4x the
+        cluster shape plus a node-lease keepalive storm (tight cadence,
+        every node), with the list/relist load thinned so the traffic
+        skews hard toward create/update/delete — the shape that exercises
+        the scheduler's write-group formation and the TPU mirror's
+        incremental delta merge. The SLO bounds REQUIRE group commits to
+        have formed (``min_write_batched_ops``), and the reconcile
+        section re-asserts the ``kb_sched_write_batch_size`` histogram
+        moved."""
+        namespaces = max(4, min(100, nodes // 10))
+        bounds = overrides.pop(
+            "bounds",
+            SLOBounds(min_write_batched_ops=2,
+                      min_batched_requests=0))
+        defaults = dict(
+            nodes=nodes, namespaces=namespaces, bounds=bounds,
+            pods_per_node=6,
+            churn_interval_s=0.5,       # ~4x the cluster churn rate
+            keepalive_interval_s=4.0,   # keepalive storm (real: .8s @ x5)
+            lease_ttl_s=40,
+            list_interval_s=20.0,       # thin the read load
+            relist_interval_s=25.0,
+            lease_list_interval_s=10.0,
+            lease_listers=1,
+            grant_spread_s=2.0,
+        )
+        defaults.update(overrides)
+        return cls(**defaults)
 
     @classmethod
     def for_smoke(cls, nodes: int = 10, **overrides) -> "WorkloadSpec":

@@ -217,6 +217,45 @@ def test_kb106_suppressible():
     assert ids(src, SRV_ETCD) == []
 
 
+def test_kb106_flags_direct_backend_write_calls():
+    # writes are funneled like reads (docs/writes.md): the service layer
+    # reaches create/update/delete only through the scheduler's write lanes
+    for entry, args in (("create", "k, v"), ("update", "k, v, 3"),
+                        ("delete", "k")):
+        src = f"def f(self, k, v):\n    return self.backend.{entry}({args})\n"
+        assert ids(src, SRV_ETCD) == ["KB106"], entry
+        assert ids(src, EP) == ["KB106"], entry
+    # the scheduler's own write entries are the sanctioned path
+    clean = (
+        "def f(self, k, v):\n"
+        "    self.limiter.create(k, v)\n"
+        "    self.limiter.update(k, v, 3)\n"
+        "    return self.limiter.delete(k)\n"
+    )
+    assert ids(clean, SRV_ETCD) == []
+    # unrelated receivers named neither backend nor scanner stay clean
+    assert ids("def f(self, k):\n    self.watchers.delete(k)\n",
+               SRV_ETCD) == []
+
+
+def test_kb106_flags_laundered_write_batch_call():
+    # write_batch is the group-commit executor itself: flagged on ANY
+    # receiver, so aliasing the backend can't launder a direct group
+    # commit past the admission queue
+    laundered = (
+        "def f(self, ops):\n"
+        "    b = self.backend\n"
+        "    return b.write_batch(ops)\n"
+    )
+    assert ids(laundered, SRV_ETCD) == ["KB106"]
+    assert ids(laundered, EP) == ["KB106"]
+    direct = "def f(self, ops):\n    return self.backend.write_batch(ops)\n"
+    assert ids(direct, SRV_ETCD) == ["KB106"]
+    # out of the service layer the backend core and scheduler ARE the path
+    assert ids(direct, "kubebrain_tpu/sched/scheduler.py") == []
+    assert ids(direct, ANY) == []
+
+
 # ------------------------------------------------------------- suppressions
 def test_suppression_on_flagged_line():
     src = "import time\nasync def f():\n    time.sleep(1)  # kblint: disable=KB101 -- test\n"

@@ -53,6 +53,16 @@ class FlakyCommit:
 
         return B()
 
+    def mvcc_delete(self, *args, **kwargs):
+        # the one-call delete fast path commits inside the engine — inject
+        # the same post-commit uncertainty there (memkv deletes take
+        # _delete_fast now that it implements mvcc_delete)
+        out = self._store.mvcc_delete(*args, **kwargs)
+        if out[0] == "ok" and self.remaining > 0:
+            self.remaining -= 1
+            raise UncertainResultError("injected commit timeout")
+        return out
+
 
 def test_uncertain_create_repair():
     store = new_storage("memkv")

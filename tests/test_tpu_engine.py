@@ -288,9 +288,11 @@ def test_incremental_merge_reuses_clean_shards():
     store.close()
 
 
-def test_incremental_merge_overflow_falls_back():
-    """A partition overflowing its padded capacity triggers the full
-    re-balancing rebuild (and reads stay correct)."""
+def test_incremental_merge_overflow_grows_in_stored_domain():
+    """A partition overflowing its padded capacity GROWS the stored-domain
+    arrays (memcpy + republish) instead of taking the full decode →
+    re-dictionary → re-partition host rebuild (docs/writes.md merge
+    policy) — and reads stay correct."""
     from kubebrain_tpu.backend import Backend, BackendConfig
     from kubebrain_tpu.storage import new_storage
 
@@ -301,10 +303,15 @@ def test_incremental_merge_overflow_falls_back():
     for i in range(50):
         b.create(b"/registry/of/k%04d" % i, b"v")
     sc.publish()
+    cap0 = sc._mirror.keys_host.shape[1]
     # burst big enough to blow past the padded capacity of one partition
     for i in range(800):
         b.create(b"/registry/of/m%04d" % i, b"v")
     sc.publish()
+    assert sc.full_rebuild_total == 0, \
+        "capacity overflow must grow in the stored domain, not full-rebuild"
+    assert sc.merge_count > 0 and sc.merge_rows_total > 0
+    assert sc._mirror.keys_host.shape[1] > cap0, "capacity must have grown"
     res = b.list_(b"/registry/of/", b"/registry/of0")
     assert len(res.kvs) == 850
     b.close()

@@ -144,6 +144,7 @@ def _minimal_report(**overrides) -> dict:
         "leases": {"granted": 4, "keepalives_sent": 8, "keepalives_acked": 8,
                    "expired_acks": 0, "metrics": {"expired_delta": 0}},
         "sched": {"batched_launches": 0, "batched_requests": 0,
+                  "write_batched_groups": 0, "write_batched_ops": 0,
                   "shed_total": 0, "coalesced_total": 0},
         "reconcile": {"ok": True, "checks": {}},
         "slo": {"pass": True, "violations": [], "bounds": {}},
@@ -360,3 +361,45 @@ def test_small_n_replay_smoke(tmp_path):
     slo.validate_report(on_disk)
     assert on_disk["trace"]["sha256"] == report["trace"]["sha256"]
     assert os.path.getsize(out) > 500
+
+
+def test_churn_heavy_scenario_forms_write_groups():
+    """The churn_heavy preset (docs/writes.md): pod churn + keepalive
+    storm through the real gRPC front must actually form write commit
+    groups on the server — kb_sched_write_batch_size COUNT moves, the
+    reconcile section carries the mandatory write_groups_formed check,
+    and the run passes its declared SLOs."""
+    from kubebrain_tpu.workload.runner import run_workload
+
+    spec = WorkloadSpec.for_churn_heavy(
+        60, seed=1, duration_s=6.0, time_scale=3.0,
+        compact_interval_s=2.5)  # >= 1 compaction inside the short window
+    assert spec.bounds.min_write_batched_ops > 0
+    report = run_workload(spec, write_report=False)
+
+    slo.validate_report(report)
+    assert report["slo"]["pass"], report["slo"]["violations"]
+    sched = report["sched"]
+    assert sched["write_batched_groups"] > 0
+    assert sched["write_batched_ops"] >= spec.bounds.min_write_batched_ops
+    # ops-per-group is a real mean over >= 2-op groups
+    assert sched["write_batched_ops"] >= 2 * sched["write_batched_groups"]
+    check = report["reconcile"]["checks"]["write_groups_formed"]
+    assert check["ok"], check
+    # the write skew actually skewed: more write ops than list/relist reads
+    writes = report["lanes"]["write"]["count"]
+    reads = (report["lanes"]["normal"]["count"]
+             + report["lanes"]["background"]["count"])
+    assert writes > reads, (writes, reads)
+
+
+def test_churn_heavy_bound_fails_without_group_formation():
+    """min_write_batched_ops is a REAL bound: a report with no group
+    formation must fail the churn_heavy SLO evaluation."""
+    from kubebrain_tpu.workload.spec import SLOBounds
+
+    report = _minimal_report()
+    passed, violations = slo.evaluate(
+        report, SLOBounds(min_compactions=0, min_write_batched_ops=2))
+    assert not passed
+    assert any("group commit never formed" in v for v in violations)

@@ -46,9 +46,10 @@ env JAX_PLATFORMS=cpu python -m pytest tests/test_kblint.py \
 echo "=== [2/8] make typecheck"
 make typecheck || exit 1
 
-echo "=== [3/8] scheduler semantics + query-batched scan + bench-smoke (CPU fallback)"
+echo "=== [3/8] scheduler semantics + query-batched scan + write group commit + bench-smoke (CPU fallback)"
 env JAX_PLATFORMS=cpu python -m pytest tests/test_sched.py \
-    tests/test_sched_batch.py tests/test_scan_pallas.py -q -m 'not slow' \
+    tests/test_sched_batch.py tests/test_scan_pallas.py \
+    tests/test_write_batch.py -q -m 'not slow' \
     -p no:cacheprovider || exit 1
 make bench-smoke || exit 1
 

@@ -751,18 +751,28 @@ _SCAN_ENTRY_POINTS = {
     "list_batch", "scan_batch",
 }
 _SCAN_RECEIVERS = {"backend", "scanner"}
+#: backend write entry points — same funnel discipline for the write path
+#: (docs/writes.md): service code reaches create/update/delete through the
+#: scheduler's write lanes so group commit + admission control apply.
+_WRITE_ENTRY_POINTS = {"create", "update", "delete"}
+#: ``write_batch`` is the engine/backend group-commit executor itself; the
+#: ONLY caller is the scheduler's batch dispatch (sched/scheduler.py) and
+#: the backend core — in the service layer it is flagged on ANY receiver,
+#: so aliasing the backend (``b = self.backend; b.write_batch(...)``)
+#: cannot launder a direct group commit past the admission queue.
+_GROUP_COMMIT_ENTRY = "write_batch"
 
 
 @register
 class RangeReadsThroughScheduler(Rule):
-    """Service-layer range reads go through the request scheduler
-    (``sched.ensure_scheduler``/the KVService ``limiter``); calling the
-    backend/scanner scan entry points directly skips priority lanes and
-    overload protection."""
+    """Service-layer range reads AND writes go through the request
+    scheduler (``sched.ensure_scheduler``/the KVService ``limiter``);
+    calling the backend/scanner scan or write entry points directly skips
+    priority lanes, group commit, and overload protection."""
 
     rule_id = "KB106"
-    summary = ("service-layer code must not call engine scan entry points "
-               "directly (server/etcd/, endpoint/); use the scheduler")
+    summary = ("service-layer code must not call engine scan/write entry "
+               "points directly (server/etcd/, endpoint/); use the scheduler")
 
     def applies(self, relpath: str) -> bool:
         return relpath.replace("\\", "/").startswith(
@@ -776,13 +786,27 @@ class RangeReadsThroughScheduler(Rule):
             func = node.func
             if not isinstance(func, ast.Attribute):
                 continue
-            if func.attr not in _SCAN_ENTRY_POINTS:
-                continue
             receiver = terminal_name(func.value)
-            if receiver in _SCAN_RECEIVERS:
+            if func.attr == _GROUP_COMMIT_ENTRY:
                 yield node, (
-                    f"direct scan call {receiver}.{func.attr}(); range reads "
-                    "go through the request scheduler (sched.ensure_scheduler)"
+                    f"direct group-commit call {receiver}.{func.attr}(); "
+                    "write groups form ONLY in the scheduler's dispatch "
+                    "(sched.ensure_scheduler create/update/delete)"
+                )
+                continue
+            if func.attr in _SCAN_ENTRY_POINTS:
+                if receiver in _SCAN_RECEIVERS:
+                    yield node, (
+                        f"direct scan call {receiver}.{func.attr}(); range "
+                        "reads go through the request scheduler "
+                        "(sched.ensure_scheduler)"
+                    )
+            elif func.attr in _WRITE_ENTRY_POINTS and receiver == "backend":
+                yield node, (
+                    f"direct write call {receiver}.{func.attr}(); writes go "
+                    "through the scheduler's write lanes "
+                    "(sched.ensure_scheduler) so group commit and admission "
+                    "control apply"
                 )
 
 
