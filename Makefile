@@ -73,16 +73,22 @@ bench-smoke:
 # docs/multichip.md), e.g.: make bench-cluster N=1000 STORAGE=tpu MESH_PART=8
 # SCENARIO=churn_heavy skews the trace to pod churn + a keepalive storm
 # (write-group commit exercised + asserted; docs/writes.md).
+# FAULTS=<preset> (smoke|storage|watch|merge|full) arms chaos mode
+# (docs/faults.md): churn_heavy replayed against a fault-injected server,
+# judged by the acknowledged-write consistency check; emits CHAOS_rNN.json.
 N ?= 1000
 STORAGE ?= memkv
 MESH_PART ?= 0
 SCAN_PARTS ?= 0
 SCENARIO ?= cluster
+FAULTS ?= none
+FAULT_SEED ?= 0
 bench-cluster:
 	JAX_PLATFORMS=cpu KB_BENCH_METRIC=cluster KB_BENCH_NODES=$(N) \
 	    KB_WORKLOAD_STORAGE=$(STORAGE) KB_WORKLOAD_MESH_PART=$(MESH_PART) \
 	    KB_WORKLOAD_SCAN_PARTITIONS=$(SCAN_PARTS) \
-	    KB_WORKLOAD_SCENARIO=$(SCENARIO) python bench.py
+	    KB_WORKLOAD_SCENARIO=$(SCENARIO) KB_WORKLOAD_FAULTS=$(FAULTS) \
+	    KB_WORKLOAD_FAULT_SEED=$(FAULT_SEED) python bench.py
 
 # Multichip sharded serving curve (docs/multichip.md): the scan workload
 # served through the scheduler at mesh sizes 1..8, byte-identical across

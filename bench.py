@@ -1399,11 +1399,8 @@ def bench_cluster() -> None:
 
     nodes = int(os.environ.get("KB_BENCH_NODES", os.environ.get("N", 1000)))
     scenario = os.environ.get("KB_WORKLOAD_SCENARIO", "cluster")
-    factory = {"cluster": WorkloadSpec.for_cluster,
-               "churn_heavy": WorkloadSpec.for_churn_heavy,
-               "churn-heavy": WorkloadSpec.for_churn_heavy}[scenario]
-    spec = factory(
-        nodes,
+    faults = os.environ.get("KB_WORKLOAD_FAULTS", "none")
+    common = dict(
         seed=int(os.environ.get("KB_WORKLOAD_SEED", 0)),
         duration_s=float(os.environ.get("KB_WORKLOAD_DURATION", 30.0)),
         time_scale=float(os.environ.get("KB_WORKLOAD_SCALE", 5.0)),
@@ -1411,6 +1408,19 @@ def bench_cluster() -> None:
         mesh_part=int(os.environ.get("KB_WORKLOAD_MESH_PART", 0)),
         scan_partitions=int(os.environ.get("KB_WORKLOAD_SCAN_PARTITIONS", 0)),
     )
+    if faults and faults != "none":
+        # chaos mode (docs/faults.md): churn_heavy traffic under an armed
+        # fault schedule; judged by the acknowledged-write consistency
+        # check + per-kind injection reconcile; report -> CHAOS_rNN.json
+        spec = WorkloadSpec.for_chaos(
+            nodes, preset=faults,
+            fault_seed=int(os.environ.get("KB_WORKLOAD_FAULT_SEED", 0)),
+            **common)
+    else:
+        factory = {"cluster": WorkloadSpec.for_cluster,
+                   "churn_heavy": WorkloadSpec.for_churn_heavy,
+                   "churn-heavy": WorkloadSpec.for_churn_heavy}[scenario]
+        spec = factory(nodes, **common)
     report = run_workload(spec, out_path=os.environ.get("KB_WORKLOAD_OUT") or None)
     lanes = {lane: {"p50_ms": s["p50_ms"], "p99_ms": s["p99_ms"],
                     "count": s["count"], "shed": s["shed"]}
@@ -1435,6 +1445,13 @@ def bench_cluster() -> None:
             "lease_expiries": report["leases"]["metrics"]["expired_delta"],
             "batched_requests": report["sched"]["batched_requests"],
             "reconcile_ok": report["reconcile"]["ok"],
+            "faults": ({
+                "preset": spec.faults,
+                "sha256": report["faults"]["schedule"]["sha256"],
+                "injected": report["faults"]["injected"],
+                "consistency_ok": report["faults"]["consistency"]["ok"],
+                "degraded_p99_ms": report["faults"]["degraded"]["p99_ms"],
+            } if report["faults"]["armed"] else {"preset": "none"}),
         },
     }))
 

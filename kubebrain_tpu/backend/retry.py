@@ -55,6 +55,22 @@ class AsyncFifoRetry:
         self._queue: deque[list] = deque()  # [event, enqueued_at, attempts]
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
+        self._metrics = None
+
+    def set_metrics(self, metrics) -> None:
+        """Arm repair observability: ``kb_retry_queue_depth`` (scrape-time
+        gauge) + ``kb_uncertain_repairs_total{outcome=}`` — under chaos the
+        uncertain-write FIFO is a serving-path component and its progress
+        must be scrape-visible (docs/faults.md)."""
+        self._metrics = metrics
+        if metrics is not None:
+            metrics.register_gauge_fn("kb.retry.queue.depth",
+                                      lambda: float(len(self)))
+
+    def _count_outcome(self, outcome: str) -> None:
+        if self._metrics is not None:
+            self._metrics.emit_counter("kb.uncertain.repairs", 1,
+                                       outcome=outcome)
 
     def append(self, event: WatchEvent) -> None:
         with self._lock:
@@ -101,6 +117,7 @@ class AsyncFifoRetry:
                     if give_up and self._queue and self._queue[0] is entry:
                         self._queue.popleft()
                 if give_up:
+                    self._count_outcome("gave_up")
                     logger.exception(
                         "uncertain-write repair for key=%r rev=%d dropped after "
                         "%d failed attempts; storage may disagree with the "
@@ -123,13 +140,19 @@ class AsyncFifoRetry:
     def _resolve(self, event: WatchEvent) -> None:
         record = self._read_rev_record(event.key)
         if record is None:
-            return  # key vanished entirely: op failed or was compacted away
+            # key vanished entirely: op failed or was compacted away
+            self._count_outcome("dropped")
+            return
         rev, deleted = record
         if rev != event.revision:
-            return  # op never landed, or a later write superseded it: drop
+            # op never landed, or a later write superseded it: drop
+            self._count_outcome("dropped")
+            return
         if deleted != (event.verb == Verb.DELETE):
+            self._count_outcome("dropped")
             return
         self._rewrite(event, record)
+        self._count_outcome("rewritten")
 
     # ----------------------------------------------------------------- daemon
     def run(self) -> None:

@@ -230,7 +230,9 @@ class WatcherHub:
             # poison pill: stream closed. If the queue is full (that's why the
             # watcher is being dropped), evict one batch so the pill fits —
             # the consumer must learn the stream ended and re-watch.
-            while True:
+            # structurally bounded: each pass evicts one batch from a
+            # bounded queue until the pill fits
+            while True:  # kblint: disable=KB118 -- drains a bounded queue
                 try:
                     q.put_nowait(None)
                     break
@@ -243,6 +245,12 @@ class WatcherHub:
     def watcher_count(self) -> int:
         with self._lock:
             return len(self._subs)
+
+    def watcher_ids(self) -> list[int]:
+        """Live watcher ids (the fault plane's watch-reset injection picks
+        its victims from this list)."""
+        with self._lock:
+            return list(self._subs)
 
     _on_tpu_cached: bool | None = None
 
@@ -377,6 +385,10 @@ class WatcherHub:
                 "kb.watch.lag.seconds", time.monotonic() - batch[0].ts,
                 point="queue",
             )
+        if dead and self._metrics is not None:
+            # the documented backlog-bound drop (SUBSCRIBER_BUFFER): visible
+            # on /metrics so the SLO report can count slow-consumer drops
+            self._metrics.emit_counter("kb.watch.dropped", len(dead))
         for wid in dead:
             self.delete_watcher(wid)
 

@@ -58,6 +58,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "the device key column (docs/compression.md), "
                         "'raw' = full-width packed keys; default follows "
                         "KB_ENCODE_KEYS (encoded)")
+    p.add_argument("--merge-threshold", type=int, default=0,
+                   help="TPU engine: delta rows that trigger an incremental "
+                        "mirror merge (0 = engine default 4096). Chaos runs "
+                        "lower it so merge-fault windows exercise the real "
+                        "merge/retry/escalation machinery (docs/faults.md)")
     p.add_argument("--scan-partitions", type=int, default=0,
                    help="mirror partition count, decoupled from the mesh "
                         "size (must be a multiple of --mesh-part; each "
@@ -146,6 +151,18 @@ def build_parser() -> argparse.ArgumentParser:
                         "explicit lease; an attached lease always wins. "
                         "--no-legacy-ttl-patterns makes leases the only "
                         "expiry mechanism")
+    p.add_argument("--faults", default="",
+                   help="chaos mode (docs/faults.md): arm a deterministic "
+                        "fault-injection plane with this preset (none, "
+                        "smoke, storage, watch, merge, full). The plane is "
+                        "INERT until GET /faults/arm on the info port "
+                        "starts the window clock; 'none'/empty = no plane")
+    p.add_argument("--fault-seed", type=int, default=0,
+                   help="seed for the fault schedule (same preset+seed+"
+                        "horizon => byte-identical schedule sha)")
+    p.add_argument("--fault-horizon-s", type=float, default=30.0,
+                   help="fault schedule horizon in real seconds from arm; "
+                        "after it the plane goes quiet (recovery window)")
     p.add_argument("--cluster-name", default="")
     p.add_argument("--compact-interval", type=float, default=60.0)
     p.add_argument("--jax-platform", default=os.environ.get("KB_JAX_PLATFORM", ""),
@@ -214,6 +231,15 @@ def validate_args(args) -> None:
         args.storage == "native" or (args.storage == "tpu" and args.inner_storage == "native")
     ):
         raise SystemExit("--data-dir requires --storage=native (or tpu over native)")
+    faults = getattr(args, "faults", "") or ""
+    if faults:
+        from .faults.schedule import PRESETS
+
+        if faults not in PRESETS:
+            raise SystemExit(
+                f"--faults {faults!r} unknown; presets: {', '.join(PRESETS)}")
+        if getattr(args, "fault_horizon_s", 1.0) <= 0:
+            raise SystemExit("--fault-horizon-s must be > 0")
 
 
 def build_endpoint(args):
@@ -240,6 +266,20 @@ def build_endpoint(args):
     TRACER.configure(metrics=metrics,
                      slow_ms=getattr(args, "trace_slow_ms", 500.0))
 
+    # chaos mode (docs/faults.md): build the deterministic fault plane.
+    # INERT until /faults/arm — a --faults none (or never-armed) server is
+    # byte-identical to a plain one by construction.
+    fault_plane = None
+    faults_preset = getattr(args, "faults", "") or ""
+    if faults_preset and faults_preset != "none":
+        from .faults import FaultPlane
+        from .faults import generate as generate_faults
+
+        fault_plane = FaultPlane(
+            generate_faults(faults_preset, getattr(args, "fault_seed", 0),
+                            getattr(args, "fault_horizon_s", 30.0)),
+            metrics=metrics)
+
     native_kw = {"partitions": args.native_partitions}
     if getattr(args, "data_dir", ""):
         native_kw.update({"data_dir": args.data_dir, "fsync": args.fsync})
@@ -257,6 +297,8 @@ def build_endpoint(args):
             inner_kw["use_pallas"] = True
         if getattr(args, "key_encoding", ""):
             inner_kw["encode_keys"] = args.key_encoding == "encoded"
+        if getattr(args, "merge_threshold", 0):
+            inner_kw["merge_threshold"] = args.merge_threshold
         # multichip sharded serving (docs/multichip.md): an explicit mesh
         # flag builds the partition mesh HERE, so the flag errors surface at
         # boot, not on the first scan; no flags = today's every-device mesh
@@ -280,6 +322,13 @@ def build_endpoint(args):
                 raise SystemExit(
                     f"--scan-partitions {scan_parts} must be a multiple of "
                     f"the mesh part-axis size {n_dev}")
+        if fault_plane is not None:
+            # wrap the INNER host engine so injected uncertainty poisons
+            # (and quarantines) the device mirror like a real engine fault
+            from .faults import FaultyStorage
+
+            inner_kw["inner_wrap"] = (
+                lambda s: FaultyStorage(s, fault_plane))
         store = new_storage("tpu", inner=args.inner_storage, mesh=mesh,
                             partitions=scan_parts, **inner_kw)
     elif args.storage == "native":
@@ -292,6 +341,10 @@ def build_endpoint(args):
         )
     else:
         store = new_storage(args.storage)
+    if fault_plane is not None and args.storage != "tpu":
+        from .faults import FaultyStorage
+
+        store = FaultyStorage(store, fault_plane)
     if args.enable_storage_metrics:
         from .storage.metrics_wrap import MetricsKvStorage
 
@@ -314,6 +367,20 @@ def build_endpoint(args):
     # watch-path lag instrumentation: commit->delivery histogram + per-
     # watcher backlog gauges on /metrics
     backend.watcher_hub.set_metrics(metrics)
+
+    # uncertain-write repair observability: queue-depth gauge + per-outcome
+    # repair counters (the chaos report reconciles against these)
+    backend.retry.set_metrics(metrics)
+
+    if fault_plane is not None:
+        # bind the endpoint-level injections: the watch-reset daemon picks
+        # victims from the hub; the TPU scanner gets the merge/encode
+        # hooks; the gRPC front adds the conn-drop interceptor (endpoint
+        # discovers the plane via backend._kb_faults)
+        fault_plane.bind_hub(backend.watcher_hub)
+        backend._kb_faults = fault_plane
+        if hasattr(backend.scanner, "set_fault_plane"):
+            backend.scanner.set_fault_plane(fault_plane)
 
     # per-shard HBM accounting (tpu engine): kb_mirror_bytes{device=}
     # scrape-time gauges off the live mirror (docs/multichip.md)
@@ -360,6 +427,13 @@ def build_endpoint(args):
         client_urls=[f"http://{identity.rsplit(':', 1)[0]}:{args.client_port}"],
         compact_interval=args.compact_interval,
     )
+    extra_http = {}
+    if fault_plane is not None:
+        # chaos-runner control surface on the info port: arm aligns the
+        # fault windows with replay start; state feeds the SLO report's
+        # injected/observed reconciliation
+        extra_http["/faults/arm"] = fault_plane.http_arm
+        extra_http["/faults/state"] = fault_plane.http_state
     endpoint = Endpoint(server, metrics, EndpointConfig(
         host=args.host,
         client_port=args.client_port,
@@ -370,6 +444,7 @@ def build_endpoint(args):
         ca_file=args.ca_file,
         insecure=not args.secure_only,
         grpc_workers=args.grpc_workers,
+        extra_http=extra_http,
     ))
     if args.aio_port:
         from .endpoint.aio import AioEndpoint

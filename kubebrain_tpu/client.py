@@ -30,6 +30,100 @@ from .trace import make_traceparent
 
 PARTITION_MAGIC_REVISION = 1888
 
+# --------------------------------------------------------- retry classification
+#
+# The safe-vs-ambiguous discipline (docs/faults.md): a write RPC may only be
+# retried when its failure provably means NOTHING was applied. The server
+# splits its status codes for exactly this (docs/writes.md):
+#
+# - RESOURCE_EXHAUSTED      — admission shed BEFORE a revision was dealt;
+# - "etcdserver:"-prefixed UNAVAILABLE — processed-and-refused (drift,
+#   storage fault refusal, not-leader): the handler ran and definitively
+#   declined;
+# - DEADLINE_EXCEEDED / CANCELLED / UNKNOWN / bare UNAVAILABLE — the op may
+#   have committed (result-wait timeout, engine uncertainty, connection
+#   drop mid-call): NEVER blind-retry a non-idempotent write here — a
+#   retried create/update that already landed reports a spurious conflict.
+#
+# Reads are idempotent: every failure is safe to retry.
+
+#: deterministic refusals: provably nothing applied AND re-sending the
+#: identical request cannot change the answer (bad lease, compacted
+#: revision, unsupported shape, auth) — retrying is pure waste
+_DETERMINISTIC_CODES = frozenset({
+    grpc.StatusCode.NOT_FOUND,
+    grpc.StatusCode.OUT_OF_RANGE,
+    grpc.StatusCode.UNIMPLEMENTED,
+    grpc.StatusCode.INVALID_ARGUMENT,
+    grpc.StatusCode.FAILED_PRECONDITION,
+    grpc.StatusCode.PERMISSION_DENIED,
+    grpc.StatusCode.UNAUTHENTICATED,
+})
+
+
+def classify_rpc_error(err: grpc.RpcError, write: bool) -> str:
+    """``"safe"`` (definitely not applied — a retry may succeed),
+    ``"definite"`` (definitely not applied — retrying the identical
+    request is pointless), or ``"ambiguous"`` (maybe applied — never
+    blind-retry a write). Reads are never worse than ``"safe"``."""
+    code = err.code() if hasattr(err, "code") else None
+    if code in _DETERMINISTIC_CODES:
+        return "definite"
+    if not write:
+        return "safe"
+    if code == grpc.StatusCode.RESOURCE_EXHAUSTED:
+        # admission shed BEFORE a revision was dealt: a backed-off retry
+        # lands in capacity that may have freed up
+        return "safe"
+    details = err.details() if hasattr(err, "details") else ""
+    if code == grpc.StatusCode.UNAVAILABLE and "etcdserver:" in (details or ""):
+        # server-side transient refusal (drift / not-leader / storage-fault
+        # refusal): the handler answered, nothing was applied, and the
+        # condition clears (fresh revision, new leader, fault window ends)
+        return "safe"
+    return "ambiguous"
+
+
+class _RetryingCall:
+    """Bounded, jitter-backoff retry around a unary call, gated by the
+    safe-vs-ambiguous classification — an ambiguous write failure is NEVER
+    retried (it surfaces to the caller, who owns the read-back). Attempts
+    beyond the first are counted in ``counter[method]`` so harnesses that
+    reconcile client RPC counts against server /metrics stay exact."""
+
+    __slots__ = ("_call", "_write", "_retries", "_backoff", "_method",
+                 "_counter")
+
+    def __init__(self, call, write: bool, retries: int, backoff_s: float,
+                 method: str, counter):
+        self._call = call
+        self._write = write
+        self._retries = retries
+        self._backoff = backoff_s
+        self._method = method
+        self._counter = counter
+
+    def __call__(self, request, timeout=None, metadata=None):
+        import random
+
+        attempt = 0
+        while True:
+            try:
+                return self._call(request, timeout=timeout, metadata=metadata)
+            except grpc.RpcError as e:
+                attempt += 1
+                if (attempt > self._retries
+                        or classify_rpc_error(e, self._write) != "safe"):
+                    raise
+                if self._counter is not None:
+                    self._counter[self._method] += 1
+                time.sleep(self._backoff * attempt
+                           * random.uniform(0.5, 1.5))
+
+    def future(self, request, timeout=None, metadata=None):
+        # the pipelined path manages its own windows; no transparent retry
+        return self._call.future(request, timeout=timeout, metadata=metadata)
+
 
 class _TracedCall:
     """Wraps a grpc multicallable so every invocation carries a W3C
@@ -69,16 +163,27 @@ class ClientKV:
 
 
 class EtcdCompatClient:
-    def __init__(self, target: str, credentials: grpc.ChannelCredentials | None = None):
+    def __init__(self, target: str, credentials: grpc.ChannelCredentials | None = None,
+                 retries: int = 0, retry_backoff_s: float = 0.05):
+        """``retries`` > 0 arms transparent retry of SAFE failures only
+        (classify_rpc_error): reads retry on anything, writes only on
+        provably-not-applied refusals — an ambiguous write outcome always
+        surfaces. ``self.retries_sent`` counts the extra attempts per
+        method (harnesses add them to their reconcile counts)."""
         self.channel = (
             grpc.secure_channel(target, credentials)
             if credentials
             else grpc.insecure_channel(target)
         )
+        self._retry_budget = retries
+        self._retry_backoff_s = retry_backoff_s
+        self.retries_sent: collections.Counter = collections.Counter()
         p = rpc_pb2
         self._range = self._unary("/etcdserverpb.KV/Range", p.RangeRequest, p.RangeResponse)
-        self._txn = self._unary("/etcdserverpb.KV/Txn", p.TxnRequest, p.TxnResponse)
-        self._compact = self._unary("/etcdserverpb.KV/Compact", p.CompactionRequest, p.CompactionResponse)
+        self._txn = self._unary("/etcdserverpb.KV/Txn", p.TxnRequest, p.TxnResponse,
+                                write=True)
+        self._compact = self._unary("/etcdserverpb.KV/Compact", p.CompactionRequest, p.CompactionResponse,
+                                    write=True)
         raw_watch = self.channel.stream_stream(
             "/etcdserverpb.Watch/Watch",
             request_serializer=p.WatchRequest.SerializeToString,
@@ -86,9 +191,11 @@ class EtcdCompatClient:
         )
         self._watch = _traced_call(raw_watch)
         self._lease_grant = self._unary(
-            "/etcdserverpb.Lease/LeaseGrant", p.LeaseGrantRequest, p.LeaseGrantResponse)
+            "/etcdserverpb.Lease/LeaseGrant", p.LeaseGrantRequest, p.LeaseGrantResponse,
+            write=True)
         self._lease_revoke = self._unary(
-            "/etcdserverpb.Lease/LeaseRevoke", p.LeaseRevokeRequest, p.LeaseRevokeResponse)
+            "/etcdserverpb.Lease/LeaseRevoke", p.LeaseRevokeRequest, p.LeaseRevokeResponse,
+            write=True)
         self._lease_ttl = self._unary(
             "/etcdserverpb.Lease/LeaseTimeToLive",
             p.LeaseTimeToLiveRequest, p.LeaseTimeToLiveResponse)
@@ -100,12 +207,17 @@ class EtcdCompatClient:
             response_deserializer=p.LeaseKeepAliveResponse.FromString,
         ))
 
-    def _unary(self, method, req, resp):
-        return _traced_call(self.channel.unary_unary(
+    def _unary(self, method, req, resp, write: bool = False):
+        call = _traced_call(self.channel.unary_unary(
             method,
             request_serializer=req.SerializeToString,
             response_deserializer=resp.FromString,
         ))
+        if self._retry_budget > 0:
+            call = _RetryingCall(call, write, self._retry_budget,
+                                 self._retry_backoff_s, method,
+                                 self.retries_sent)
+        return call
 
     # --------------------------------------------------------------- writes
     @staticmethod
@@ -519,19 +631,42 @@ class LeaseHandle:
 
 class MuxWatch:
     """One multiplexed watch (see :class:`WatchMux`): the server-assigned
-    watch id plus reader-thread-maintained delivery counters."""
+    watch id plus reader-thread-maintained delivery counters. With resume
+    armed, ``resumes`` counts server-side stream resets this watch
+    survived (re-registered from last-delivered revision + 1);
+    ``cancelled`` then only flips on TERMINAL cancels (compaction — the
+    client must re-list)."""
 
-    __slots__ = ("key", "range_end", "watch_id", "events", "cancelled",
-                 "last_revision", "ready")
+    __slots__ = ("key", "range_end", "start_revision", "watch_id", "events",
+                 "cancelled", "last_revision", "ready", "resumes",
+                 "revisions", "baselined", "stream")
 
-    def __init__(self, key: bytes, range_end: bytes):
+    def __init__(self, key: bytes, range_end: bytes, start_revision: int = 0,
+                 record: bool = False):
         self.key = key
         self.range_end = range_end
+        self.start_revision = start_revision
         self.watch_id = -1
         self.events = 0
         self.cancelled = False
         self.last_revision = 0
+        self.baselined = False  # watermark anchored at the created ack
         self.ready = threading.Event()
+        self.resumes = 0
+        self.revisions: list[int] | None = [] if record else None
+        #: the stream currently carrying this watch — revive uses it to
+        #: decide ownership, so one watch can never be re-registered on
+        #: two live streams (set by _send_create)
+        self.stream: object | None = None
+
+    def resume_revision(self) -> int:
+        """Where a re-registration must start so no event is lost or
+        duplicated: one past the delivery watermark (last delivered batch,
+        or the registration revision the created ack baselined), or the
+        original start when neither exists yet."""
+        if self.last_revision or self.baselined:
+            return self.last_revision + 1
+        return self.start_revision
 
 
 class _WatchMuxStream:
@@ -539,36 +674,49 @@ class _WatchMuxStream:
     handles create requests strictly in order, so created acks match the
     pending-add FIFO; event batches demux by ``watch_id``."""
 
-    def __init__(self, client: "EtcdCompatClient"):
+    def __init__(self, client: "EtcdCompatClient", mux: "WatchMux | None" = None):
         self._requests: queue.Queue = queue.Queue()
         self._responses = client._watch(iter(self._requests.get, None))
         self._lock = threading.Lock()
         self._pending: collections.deque[MuxWatch] = collections.deque()
         self._by_id: dict[int, MuxWatch] = {}
+        self._mux = mux
         self.dead = False
+        self.closing = False
         self._reader = threading.Thread(
             target=self._read_loop, name="kb-watchmux", daemon=True)
         self._reader.start()
 
-    def add(self, key: bytes, range_end: bytes, start_revision: int,
-            timeout: float) -> MuxWatch:
-        w = MuxWatch(key, range_end)
+    def _send_create(self, w: MuxWatch, start_revision: int) -> None:
+        """Append + send under one lock: concurrent adds must hit the wire
+        in pending-FIFO order or created acks mismatch. Raises if the
+        stream is already dead."""
         req = rpc_pb2.WatchRequest()
-        req.create_request.key = key
-        req.create_request.range_end = range_end
+        req.create_request.key = w.key
+        req.create_request.range_end = w.range_end
         req.create_request.start_revision = start_revision
         with self._lock:
             if self.dead:
                 raise TimeoutError("watch mux stream is dead")
-            # append + send under one lock: concurrent add() calls must hit
-            # the wire in pending-FIFO order or created acks mismatch
+            w.stream = self
             self._pending.append(w)
             self._requests.put(req)
+
+    def add(self, key: bytes, range_end: bytes, start_revision: int,
+            timeout: float, record: bool = False) -> MuxWatch:
+        w = MuxWatch(key, range_end, start_revision, record=record)
+        self._send_create(w, start_revision)
         if not w.ready.wait(timeout):
             raise TimeoutError(
                 f"watch registration not acked within {timeout}s "
                 f"(key={key!r})")
         return w
+
+    def readd(self, w: MuxWatch) -> None:
+        """Resume re-registration (no ready wait — called from reader/
+        revive threads; the ack arrives on this stream's read loop)."""
+        w.ready.clear()
+        self._send_create(w, w.resume_revision())
 
     def _read_loop(self) -> None:
         rpc_error = grpc.RpcError  # closure-bound, survives teardown
@@ -579,6 +727,15 @@ class _WatchMuxStream:
                         w = self._pending.popleft() if self._pending else None
                     if w is not None:
                         w.watch_id = resp.watch_id
+                        if (w.last_revision == 0 and w.start_revision == 0
+                                and not w.baselined):
+                            # live-only watch ("from now"): baseline the
+                            # resume watermark at the registration
+                            # revision the server acked, so a reset
+                            # BEFORE the first delivery replays exactly
+                            # the events committed since registration
+                            w.last_revision = resp.header.revision
+                            w.baselined = True
                         with self._lock:
                             self._by_id[resp.watch_id] = w
                         if resp.canceled:  # e.g. compacted start revision
@@ -590,27 +747,55 @@ class _WatchMuxStream:
                     if w is not None:
                         w.events += len(resp.events)
                         w.last_revision = resp.header.revision
+                        if w.revisions is not None:
+                            w.revisions.extend(
+                                ev.kv.mod_revision for ev in resp.events)
                 if resp.canceled and not resp.created:
                     with self._lock:
-                        w = self._by_id.get(resp.watch_id)
-                    if w is not None:
+                        w = self._by_id.pop(resp.watch_id, None)
+                    if w is None:
+                        continue
+                    mux = self._mux
+                    if (mux is not None and mux.resume
+                            and resp.compact_revision == 0):
+                        # server-side stream reset (watcher dropped /
+                        # fault-injected): re-register from the last
+                        # delivered revision + 1 — the watch cache replays
+                        # the gap, so no event is lost or duplicated
+                        w.resumes += 1
+                        try:
+                            self.readd(w)
+                        except TimeoutError:
+                            w.cancelled = True
+                            w.ready.set()
+                    else:
+                        # terminal: compacted history (client must
+                        # re-list) or resume not armed
                         w.cancelled = True
         except (rpc_error, ValueError):
             pass  # stream torn down (close() or channel death)
         finally:
             with self._lock:
                 self.dead = True
-                pending = list(self._pending)
+                stranded = list(self._pending) + list(self._by_id.values())
                 self._pending.clear()
-            for w in pending:
-                w.cancelled = True
-                w.ready.set()
-
-    def watchers(self) -> list[MuxWatch]:
-        with self._lock:
-            return list(self._by_id.values())
+                self._by_id.clear()
+                closing = self.closing
+            mux = self._mux
+            if mux is not None and mux.resume and not closing and stranded:
+                # whole-stream death: revive on a fresh stream (off this
+                # thread — the revive needs a new gRPC stream + re-adds)
+                threading.Thread(
+                    target=mux._revive, args=(self, stranded),
+                    name="kb-watchmux-revive", daemon=True).start()
+            else:
+                for w in stranded:
+                    w.cancelled = True
+                    w.ready.set()
 
     def close(self) -> None:
+        with self._lock:
+            self.closing = True
         self._requests.put(None)
 
 
@@ -624,12 +809,29 @@ class WatchMux:
     carries any number of watches, so N watchers cost ``streams`` threads
     total. Deliveries are *counted* per watch (the workload harness's
     need), not queued — wire-lag attribution lives in the server's
-    ``kb_watch_lag_seconds`` metric."""
+    ``kb_watch_lag_seconds`` metric.
 
-    def __init__(self, client: "EtcdCompatClient", streams: int = 4):
+    ``resume=True`` arms chaos-grade robustness (docs/faults.md): a
+    server-side stream reset (slow-consumer drop, fault injection) or a
+    whole-stream death re-registers every surviving watch from its
+    last-delivered revision + 1 — the server's watch cache replays the
+    gap, so the delivered event sequence has no loss and no duplicates;
+    only a compacted start revision is terminal (the client must
+    re-list)."""
+
+    def __init__(self, client: "EtcdCompatClient", streams: int = 4,
+                 resume: bool = False, record_revisions: bool = False):
         if streams < 1:
             raise ValueError("streams must be >= 1")
-        self._streams = [_WatchMuxStream(client) for _ in range(streams)]
+        self._client = client
+        self.resume = resume
+        self._record = record_revisions
+        self._streams = [_WatchMuxStream(client, mux=self)
+                         for _ in range(streams)]
+        self._revive_lock = threading.Lock()
+        self._all: list[MuxWatch] = []
+        self._all_lock = threading.Lock()
+        self._closed = False
         self._rr = 0
 
     def add(self, key: bytes, range_end: bytes = b"", start_revision: int = 0,
@@ -637,10 +839,76 @@ class WatchMux:
         if shard is None:
             shard, self._rr = self._rr, self._rr + 1
         s = self._streams[shard % len(self._streams)]
-        return s.add(key, range_end, start_revision, timeout)
+        w = s.add(key, range_end, start_revision, timeout,
+                  record=self._record)
+        with self._all_lock:
+            self._all.append(w)
+        return w
+
+    def _revive(self, dead_stream: "_WatchMuxStream",
+                stranded: list[MuxWatch]) -> None:
+        """Replace a dead stream and re-register its watches from their
+        resume revisions. Idempotent under partial failure: revives
+        serialize on ``_revive_lock``, each watch is re-added only while
+        it still BELONGS to the dead stream (``w.stream``), and a
+        replacement that dies mid-revive hands its already-moved watches
+        to its own revive — one watch can never be live on two streams.
+        Bounded attempts with jittered backoff; watches the server never
+        takes back get terminal cancels."""
+        import random
+
+        backoff = 0.1
+        for _attempt in range(6):
+            if self._closed:
+                break
+            todo = [w for w in stranded
+                    if not w.cancelled and w.stream is dead_stream]
+            if not todo:
+                return  # every watch moved on (or terminally ended)
+            # the lock covers ONLY the slot lookup/swap (never the backoff
+            # sleeps below — kblint KB118/KB102); double-add safety comes
+            # from the per-watch ownership gate (w.stream), not from
+            # serializing whole revives
+            target = None
+            with self._revive_lock:
+                try:
+                    slot = self._streams.index(dead_stream)
+                except ValueError:
+                    slot = None  # replaced by an earlier attempt/revive
+                if slot is not None:
+                    try:
+                        target = _WatchMuxStream(self._client, mux=self)
+                        # install BEFORE re-adding: add() must never
+                        # route to a stream this revive knows is gone
+                        self._streams[slot] = target
+                    except (grpc.RpcError, ValueError):
+                        target = None
+                else:
+                    # a newer revive owns the slot: adopt a live stream
+                    # from the rotation instead of minting an untracked
+                    # (unclosable) one. A dead adoptee in the slot heals
+                    # via its OWN revive.
+                    target = next(
+                        (s for s in self._streams if not s.dead), None)
+            if target is not None:
+                try:
+                    for w in todo:
+                        w.resumes += 1
+                        target.readd(w)  # moves w.stream to target
+                    return
+                except (grpc.RpcError, TimeoutError, ValueError):
+                    pass  # target died mid-re-add: watches already moved
+                    # ride its own revive; the rest retry here
+            time.sleep(backoff * random.uniform(0.5, 1.5))
+            backoff = min(backoff * 2.0, 2.0)
+        for w in stranded:
+            if not w.cancelled and w.stream is dead_stream:
+                w.cancelled = True
+                w.ready.set()
 
     def watchers(self) -> list[MuxWatch]:
-        return [w for s in self._streams for w in s.watchers()]
+        with self._all_lock:
+            return list(self._all)
 
     def total_events(self) -> int:
         return sum(w.events for w in self.watchers())
@@ -648,7 +916,11 @@ class WatchMux:
     def cancelled_count(self) -> int:
         return sum(1 for w in self.watchers() if w.cancelled)
 
+    def resumed_total(self) -> int:
+        return sum(w.resumes for w in self.watchers())
+
     def close(self) -> None:
+        self._closed = True
         for s in self._streams:
             s.close()
 

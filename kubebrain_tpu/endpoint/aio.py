@@ -183,7 +183,9 @@ class AioWatchService:
 
         async def pump(watch_id, wid, q, want_prev, no_put, no_delete, progress_notify):
             last_sent = loop.time()
-            while True:
+            # poll loop, not a retry loop: the TimeoutError tick is the
+            # progress-notify cadence; exits on the queue's poison pill
+            while True:  # kblint: disable=KB118 -- bounded by poison pill
                 if progress_notify:
                     try:
                         batch = await asyncio.wait_for(q.get(), timeout=0.5)

@@ -68,6 +68,39 @@ class _MetricsInterceptor(grpc.ServerInterceptor):
         return handler
 
 
+class _FaultInterceptor(grpc.ServerInterceptor):
+    """Chaos-mode connection drops (docs/faults.md): during an armed
+    ``conn_drop`` window, unary client RPCs abort with a bare UNAVAILABLE
+    BEFORE the handler runs — the wire shape of a dropped connection. No
+    ``etcdserver:`` prefix on purpose: clients must classify it ambiguous
+    (the handler never ran here, but a real connection drop gives the
+    client no way to know that — the asymmetry is the fault). Ordered
+    INSIDE the metrics interceptor so aborted RPCs still count in
+    ``rpc_server_count`` and the harness reconcile stays exact."""
+
+    def __init__(self, plane):
+        self._plane = plane
+
+    def intercept_service(self, continuation, handler_call_details):
+        handler = continuation(handler_call_details)
+        if handler is None or not handler.unary_unary:
+            return handler  # streams are covered by watch_reset injection
+        plane = self._plane
+        behavior = handler.unary_unary
+
+        def inner(request, context):
+            if plane.conn_drop():
+                context.abort(grpc.StatusCode.UNAVAILABLE,
+                              "connection dropped (fault injection)")
+            return behavior(request, context)
+
+        return grpc.unary_unary_rpc_method_handler(
+            inner,
+            request_deserializer=handler.request_deserializer,
+            response_serializer=handler.response_serializer,
+        )
+
+
 @dataclass
 class EndpointConfig:
     host: str = "0.0.0.0"
@@ -135,13 +168,19 @@ class Endpoint:
     # ------------------------------------------------------------------- run
     def run(self) -> None:
         cfg = self.config
+        interceptors = [_MetricsInterceptor(self.metrics)]
+        fault_plane = getattr(
+            getattr(self.server, "backend", None), "_kb_faults", None)
+        if fault_plane is not None:
+            # after metrics, so fault-aborted RPCs still reconcile
+            interceptors.append(_FaultInterceptor(fault_plane))
         self._grpc = grpc.server(
             futures.ThreadPoolExecutor(max_workers=cfg.grpc_workers),
             options=[
                 ("grpc.max_receive_message_length", 16 * 1024 * 1024),
                 ("grpc.max_send_message_length", 16 * 1024 * 1024),
             ],
-            interceptors=[_MetricsInterceptor(self.metrics)],
+            interceptors=interceptors,
         )
         for h in self.server.grpc_handlers:
             self._grpc.add_generic_rpc_handlers((h,))

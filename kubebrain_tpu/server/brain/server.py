@@ -24,7 +24,11 @@ from ...backend import (
     KeyExistsError,
 )
 from ...sched import SchedOverloadError, SchedResultTimeoutError, client_of
-from ...storage.errors import KeyNotFoundError
+from ...storage.errors import (
+    KeyNotFoundError,
+    StorageError,
+    UncertainResultError,
+)
 from ...proto import brain_pb2
 from ..etcd.server import _bidi, _unary
 
@@ -175,6 +179,13 @@ class BrainServer:
             # definite failure, retry deals a fresh revision (write.go analog
             # of the etcd shim's mapping, server/etcd/kv.py)
             context.abort(grpc.StatusCode.UNAVAILABLE, "revision drift, retry")
+        except UncertainResultError:
+            # engine cannot know whether the commit landed: the same
+            # ambiguous status as a result-wait timeout (docs/faults.md)
+            context.abort(grpc.StatusCode.DEADLINE_EXCEEDED, "request timed out")
+        except StorageError as e:
+            # definite engine refusal, nothing applied: safe to retry
+            context.abort(grpc.StatusCode.UNAVAILABLE, f"storage error: {e}")
 
     def Update(self, request, context) -> brain_pb2.UpdateResponse:
         self._check_leader_write(context)
@@ -194,6 +205,13 @@ class BrainServer:
                 resp.latest.value = e.value
                 resp.latest.revision = e.revision
             return resp
+        except UncertainResultError:
+            # engine cannot know whether the commit landed: the same
+            # ambiguous status as a result-wait timeout (docs/faults.md)
+            context.abort(grpc.StatusCode.DEADLINE_EXCEEDED, "request timed out")
+        except StorageError as e:
+            # definite engine refusal, nothing applied: safe to retry
+            context.abort(grpc.StatusCode.UNAVAILABLE, f"storage error: {e}")
 
     def Delete(self, request, context) -> brain_pb2.BrainDeleteResponse:
         self._check_leader_write(context)
@@ -216,6 +234,13 @@ class BrainServer:
             return brain_pb2.BrainDeleteResponse(
                 succeeded=False, revision=self.backend.current_revision()
             )
+        except UncertainResultError:
+            # engine cannot know whether the commit landed: the same
+            # ambiguous status as a result-wait timeout (docs/faults.md)
+            context.abort(grpc.StatusCode.DEADLINE_EXCEEDED, "request timed out")
+        except StorageError as e:
+            # definite engine refusal, nothing applied: safe to retry
+            context.abort(grpc.StatusCode.UNAVAILABLE, f"storage error: {e}")
 
     def Compact(self, request, context) -> brain_pb2.BrainCompactResponse:
         self._check_leader_write(context)

@@ -37,7 +37,11 @@ from ...sched import (
     client_of,
     ensure_scheduler,
 )
-from ...storage.errors import KeyNotFoundError
+from ...storage.errors import (
+    KeyNotFoundError,
+    StorageError,
+    UncertainResultError,
+)
 from ...proto import rpc_pb2
 from ...trace import TRACER, traceparent_of
 from . import shim
@@ -270,6 +274,20 @@ class KVService:
             # txn.go:171-175)
             context.abort(grpc.StatusCode.UNAVAILABLE,
                           "etcdserver: revision drift, retry txn")
+        except UncertainResultError:
+            # the engine cannot know whether the commit landed: the SAME
+            # ambiguous status as a post-dispatch result timeout (etcd
+            # ErrTimeout → DeadlineExceeded). Clients must NEVER blind-
+            # retry a non-idempotent write on this status — the async
+            # retry FIFO resolves the outcome server-side (docs/faults.md)
+            context.abort(grpc.StatusCode.DEADLINE_EXCEEDED,
+                          "etcdserver: request timed out")
+        except StorageError as e:
+            # definite engine refusal BEFORE anything applied (e.g. an
+            # injected storage fault): UNAVAILABLE with the etcdserver:
+            # prefix = processed-and-refused, safe to retry
+            context.abort(grpc.StatusCode.UNAVAILABLE,
+                          f"etcdserver: storage error: {e}")
 
     def _match(self, request, context):
         """Classify the txn (reference kv.go:160-230). Returns

@@ -139,6 +139,12 @@ class _WatchSession:
             end = bytes(creq.key) + b"\x00"  # single-key watch
         elif end == b"\x00":
             end = b""  # etcd convention: range_end "\0" = everything >= key
+        # the created ack's header revision is read BEFORE registration: it
+        # must lower-bound every event this subscription will deliver, so a
+        # resume-from-ack-revision+1 client (WatchMux resume) can never
+        # skip an owed event — a post-registration read races the pump
+        # (docs/faults.md)
+        created_rev = self.backend.current_revision()
         try:
             wid, q = self.backend.watch_range(
                 bytes(creq.key), end, int(creq.start_revision)
@@ -160,7 +166,7 @@ class _WatchSession:
             self._watches[watch_id] = (wid, stop)
         self._send(
             rpc_pb2.WatchResponse(
-                header=shim.header(self.backend.current_revision()),
+                header=shim.header(created_rev),
                 watch_id=watch_id,
                 created=True,
             )

@@ -25,6 +25,7 @@ correct.
 
 from __future__ import annotations
 
+import random
 import socket
 import struct
 import threading
@@ -517,7 +518,9 @@ class RemoteKvStorage(KvStorage):
                 self.find_leader()
             except StorageError:
                 pass  # nobody claims leadership yet; retry until deadline
-            time.sleep(0.25)
+            # jittered: a fleet of refused writers probing an in-flight
+            # election must not re-collide on the same beat (kblint KB118)
+            time.sleep(0.25 * random.uniform(0.6, 1.4))
         if status == ST_UNCERTAIN:
             raise UncertainResultError(f"{what}: {payload!r}")
         return status, payload

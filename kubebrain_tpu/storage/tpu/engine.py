@@ -132,6 +132,12 @@ class _DeltaIndex:
         """Rows appended after a ``snapshot_blocks`` that covered ``n``."""
         return self._rows[n:]
 
+    def force_overflow(self) -> None:
+        """Mark the index overflowed (chaos hook: forced EncodeOverflow) —
+        the next merge takes the full re-dictionary rebuild path, exactly
+        as if a sealed key had been inexpressible."""
+        self._overflow = True
+
     def __len__(self) -> int:
         return len(self._rows)
 
@@ -496,6 +502,27 @@ class TpuScanner(Scanner):
         # kept so a deterministic merge defect is never silent
         self.merge_bg_errors = 0
         self._merge_bg_last_error: Exception | None = None
+        # bounded-retry accounting for the background merge (docs/faults.md:
+        # a failing merge retries with jittered backoff, then escalates to
+        # ONE full rebuild from the authoritative store after K consecutive
+        # failures — one exception must never leave the delta growing
+        # forever while readers pay unbounded overlay cost)
+        self.merge_retries_total = 0
+        self.merge_escalations_total = 0
+        self._merge_max_retries = 4
+        # mirror degradation state machine (docs/faults.md): a poisoned
+        # (uncertain) mirror QUARANTINES — reads serve from the host store,
+        # byte-identical by construction, while a single-flight background
+        # rebuild runs — instead of the old poison-until-next-reader
+        # stop-the-world rebuild on the read path. States:
+        # serving | quarantined | rebuilding (kb_mirror_state gauge).
+        self._mirror_state = "serving"
+        self._poison_epoch = 0
+        self._degraded_since = 0.0
+        self.degraded_seconds_total = 0.0
+        self.rebuild_bg_count = 0
+        self._rebuild_kick = threading.Lock()  # single-flight rebuilds
+        self._fault_plane = None  # optional chaos-mode injection hooks
 
     # -------------------------------------------------------------- metrics
     def register_metrics(self, metrics) -> None:
@@ -509,6 +536,15 @@ class TpuScanner(Scanner):
         if metrics is None:
             return
         self._metrics = metrics  # also feeds kb_mirror_merge_* emissions
+        # degradation state machine: kb_mirror_state{state=} is a 0/1 gauge
+        # per state (exactly one is 1 at any scrape) so dashboards can plot
+        # quarantine/rebuild windows without string-valued series
+        for state in ("serving", "quarantined", "rebuilding"):
+            metrics.register_gauge_fn(
+                "kb.mirror.state",
+                functools.partial(self._state_gauge, state),
+                state=state,
+            )
         if self._mesh is None:
             return
         for d in self._mesh.devices.flat:
@@ -576,12 +612,137 @@ class TpuScanner(Scanner):
                              if mirror.encoding is not None else 0),
         }
 
+    # ---------------------------------------------------------- degradation
+    def set_fault_plane(self, plane) -> None:
+        """Arm chaos-mode injection hooks (kubebrain_tpu.faults): forced
+        merge failures, merge suppression (delta growth past threshold),
+        and forced EncodeOverflow — the TPU-engine fault taxonomy."""
+        self._fault_plane = plane
+
+    def _state_gauge(self, state: str) -> float:
+        return 1.0 if self._mirror_state == state else 0.0
+
+    def _enter_degraded_locked(self, state: str) -> None:
+        """Under ``_mlock``: transition into quarantined/rebuilding. The
+        degraded clock starts on the first non-serving transition."""
+        if self._mirror_state == "serving":
+            self._degraded_since = time.monotonic()
+        self._mirror_state = state
+
+    def _exit_degraded_locked(self) -> None:
+        """Under ``_mlock``: back to serving; account the degraded window
+        (kb_degraded_seconds — the SLO report's degraded-window source)."""
+        if self._mirror_state != "serving":
+            dt = time.monotonic() - self._degraded_since
+            self.degraded_seconds_total += dt
+            if self._metrics is not None:
+                self._metrics.emit_counter("kb.degraded.seconds", dt)
+        self._mirror_state = "serving"
+
+    def _degraded(self) -> bool:
+        """True while the mirror is quarantined/rebuilding — the query
+        paths then serve from the authoritative host store (byte-identical
+        by construction: the host scanner is the oracle the device path is
+        differentially tested against) and re-kick the background rebuild
+        in case a previous attempt gave up."""
+        with self._mlock:
+            degraded = self._mirror_state != "serving"
+        if degraded:
+            self._kick_rebuild()
+        return degraded
+
+    def _kick_rebuild(self) -> None:
+        """Single-flight background mirror rebuild from the authoritative
+        store, with bounded jittered-backoff retries — quarantine recovery
+        never runs on a reader's thread and never stops the world."""
+        if not self._rebuild_kick.acquire(blocking=False):
+            return
+
+        def run() -> None:
+            import random as _random
+
+            try:
+                backoff = 0.05
+                for _attempt in range(16):
+                    try:
+                        if self._rebuild_offline():
+                            return
+                    except Exception:
+                        self.merge_bg_errors += 1
+                        if self._metrics is not None:
+                            self._metrics.emit_counter(
+                                "kb.mirror.merge.errors", 1)
+                    time.sleep(backoff * _random.uniform(0.5, 1.5))
+                    backoff = min(backoff * 2.0, 1.0)
+                # gave up: stay quarantined (host store keeps serving);
+                # the next degraded read re-kicks this loop
+            finally:
+                self._rebuild_kick.release()
+
+        threading.Thread(target=run, name="kb-mirror-rebuild",
+                         daemon=True).start()
+
+    def _rebuild_offline(self) -> bool:
+        """One rebuild attempt OFF the engine lock: snapshot the store,
+        build a fresh mirror, then swap under ``_mlock`` — readers (all on
+        the host-store path while quarantined) are never blocked on the
+        store scan. Returns False when superseded by a newer poisoning
+        (the caller retries against the fresher store state)."""
+        with self._merge_lock:
+            with self._mlock:
+                if not self._force_rebuild and self._mirror is not None:
+                    self._exit_degraded_locked()
+                    return True  # something else already recovered
+                epoch = self._poison_epoch
+                delta0 = self._delta
+                n0 = len(delta0)
+                self._enter_degraded_locked("rebuilding")
+            m, _ts = self._build_mirror_from_store()
+            with self._mlock:
+                if self._poison_epoch != epoch or self._delta is not delta0:
+                    # superseded mid-build: poisoned again, or a foreground
+                    # rebuild/compact already swapped state under us — never
+                    # overwrite fresher state (and never discard its delta)
+                    return (not self._force_rebuild
+                            and self._mirror is not None)
+                self._mirror = m
+                tail = self._delta.tail_rows(n0)
+                self._force_rebuild = False
+                self._delta = self._fresh_delta()
+                if tail:
+                    self._delta.extend(tail)
+                self._pallas_cache = None
+                self._pallas_ttl_cache = None
+                self._probe_cache = None
+                self.rebuild_bg_count += 1
+                self._exit_degraded_locked()
+        return True
+
     # ------------------------------------------------------------ write feed
     def record_version_rows(self, rows: list[tuple[bytes, int, bytes]]) -> None:
+        plane = self._fault_plane
         with self._mlock:
             self._delta.extend(rows)  # O(log d) per row via the key index
-            kick = (self._mirror is not None and not self._force_rebuild
-                    and len(self._delta) >= self._merge_threshold)
+            if plane is not None and plane.encode_overflow():
+                # chaos: an inexpressible key landed — the next merge must
+                # take the full re-dictionary rebuild path
+                self._delta.force_overflow()
+            healthy = self._mirror is not None and not self._force_rebuild
+            kick = healthy and (
+                len(self._delta) >= self._merge_threshold
+                # an open merge-fail window kicks eagerly: the failing-
+                # merge retry/escalation machinery must actually run
+                or (plane is not None and len(self._delta) > 0
+                    and plane.merge_fail_active()))
+            pending = len(self._delta) > 0
+        if plane is not None and plane.merges_suppressed():
+            # chaos: merges suppressed — the delta grows (past the
+            # threshold, since kicks are denied) and readers pay the
+            # still-exact overlay; each write landing on a pending delta
+            # counts one denied merge opportunity
+            if pending:
+                plane.note_suppressed_merge()
+            return
         if kick:
             self._kick_merge()
 
@@ -591,25 +752,68 @@ class TpuScanner(Scanner):
         leaving the whole accumulated delta for the next reader to pay
         (docs/writes.md). If a merge is already in flight the kick is
         dropped — the next threshold crossing re-kicks, and the final
-        ``publish()`` sweeps any tail."""
+        ``publish()`` sweeps any tail.
+
+        Failure policy (docs/faults.md): a failing merge retries with
+        jittered exponential backoff up to ``_merge_max_retries``
+        consecutive failures, then ESCALATES to one full rebuild from the
+        authoritative store — readers keep serving mirror+overlay (exact)
+        throughout; the old behavior (one exception, delta grows until the
+        next kick) left a deterministic merge defect unrecovered forever."""
         if not self._merge_kick.acquire(blocking=False):
             return
 
         def run() -> None:
+            import random as _random
+
             try:
-                self._merge_delta()
-            except Exception as e:
-                # best-effort maintenance: a racing close/compact can pull
-                # the store out from under us — readers are unaffected
-                # (they still serve mirror + overlay) and the next
-                # publish()/read retries on the foreground path. NOT
-                # silent, though: a deterministic merge defect would fail
-                # every kick, so count it scrape-visibly and keep the
-                # last error for the foreground path to surface.
-                self.merge_bg_errors += 1
-                self._merge_bg_last_error = e
+                backoff = 0.05
+                for attempt in range(self._merge_max_retries):
+                    try:
+                        self._merge_delta()
+                        return
+                    except Exception as e:
+                        # NOT silent: counted scrape-visibly, last error
+                        # kept for the foreground path to surface
+                        self.merge_bg_errors += 1
+                        self._merge_bg_last_error = e
+                        if self._metrics is not None:
+                            self._metrics.emit_counter(
+                                "kb.mirror.merge.errors", 1)
+                        if attempt + 1 >= self._merge_max_retries:
+                            break
+                        self.merge_retries_total += 1
+                        if self._metrics is not None:
+                            self._metrics.emit_counter(
+                                "kb.mirror.merge.retries", 1)
+                        time.sleep(backoff * _random.uniform(0.5, 1.5))
+                        backoff = min(backoff * 2.0, 1.0)
+                # K consecutive failures: the merge path itself is broken
+                # (not a transient race) — escalate to one full rebuild
+                # from the store, which both absorbs the delta and resets
+                # the merge machinery. Readers stay on mirror+overlay.
+                self.merge_escalations_total += 1
                 if self._metrics is not None:
-                    self._metrics.emit_counter("kb.mirror.merge.errors", 1)
+                    self._metrics.emit_counter("kb.mirror.merge.escalations", 1)
+                try:
+                    with self._mlock:
+                        self._force_rebuild = True
+                        self._poison_epoch += 1
+                        # quarantine in the SAME lock block (exactly like
+                        # mark_uncertain): with _force_rebuild set but the
+                        # state still "serving", a racing reader would
+                        # take the synchronous stop-the-world rebuild in
+                        # _ensure_published — the very thing the
+                        # degradation machinery exists to avoid
+                        self._enter_degraded_locked("quarantined")
+                        n_before = self._mirror is not None
+                    if n_before:
+                        self.full_rebuild_total += 1
+                    self._rebuild_offline()
+                except Exception as e:  # keep the thread from dying silently
+                    self._merge_bg_last_error = e
+                    if self._metrics is not None:
+                        self._metrics.emit_counter("kb.mirror.merge.errors", 1)
             finally:
                 self._merge_kick.release()
 
@@ -618,24 +822,54 @@ class TpuScanner(Scanner):
 
     def mark_uncertain(self) -> None:
         """A commit with unknowable outcome may or may not have produced
-        rows; only the store knows — rebuild the mirror from it."""
+        rows; only the store knows. The mirror QUARANTINES: reads fall
+        back to the host store (authoritative, byte-identical) while a
+        single-flight background rebuild runs — degraded-mode serving
+        instead of poison-until-the-next-reader-pays-a-stop-the-world-
+        rebuild (docs/faults.md)."""
         with self._mlock:
             self._force_rebuild = True
+            self._poison_epoch += 1
+            self._enter_degraded_locked("quarantined")
+        self._kick_rebuild()
 
     # -------------------------------------------------------------- publish
     def _ensure_published(self, full: bool = False) -> None:
+        plane = self._fault_plane
         with self._mlock:
             if self._force_rebuild or self._mirror is None:
                 self._rebuild_from_store()
                 return
-            if not (self._delta
-                    and (full or len(self._delta) >= self._merge_threshold)):
+            want_merge = (self._delta
+                          and (full or len(self._delta) >= self._merge_threshold))
+            if not want_merge:
                 return
+        if not full and plane is not None and plane.merges_suppressed():
+            # chaos: serve mirror+overlay (the overlay stays exact); each
+            # read that would have merged counts one suppressed merge
+            plane.note_suppressed_merge()
+            return
         # threshold crossed: merge OFF the engine lock — concurrent readers
         # keep serving mirror+overlay (overlay-wins is exact either way)
-        self._merge_delta()
+        if full:
+            self._merge_delta()
+            return
+        try:
+            self._merge_delta()
+        except Exception as e:
+            # read-path merge failure must not fail the READ: mirror +
+            # overlay is still exact, only bigger. Counted like the
+            # background kick; the retry/escalation machinery recovers.
+            self.merge_bg_errors += 1
+            self._merge_bg_last_error = e
+            if self._metrics is not None:
+                self._metrics.emit_counter("kb.mirror.merge.errors", 1)
 
-    def _rebuild_from_store(self) -> None:
+    def _build_mirror_from_store(self) -> tuple[Mirror, int]:
+        """Build a fresh Mirror from the authoritative store — shared by
+        the synchronous rebuild (under ``_mlock``) and the quarantine
+        recovery path's offline rebuild (no locks held). Pure read: no
+        scanner state is mutated."""
         snapshot = self._store.get_timestamp_oracle()
         lo, hi = coder.internal_range(b"", b"")
         exporter = getattr(self._store, "untracked", lambda: self._store)()
@@ -660,24 +894,30 @@ class TpuScanner(Scanner):
                     "back to per-row iteration", exc,
                 )
         if arrays is not None:
-            self._mirror = build_mirror_from_arrays(
+            return build_mirror_from_arrays(
                 *arrays, self._mesh, self._kw, snapshot,
                 n_parts=self._partitions or None, encode=self._encode,
-            )
-        else:
-            rows: list[tuple[bytes, int, bytes]] = []
-            for ikey, value in self._store.iter(lo, hi, snapshot_ts=snapshot):
-                ukey, rev = coder.decode(ikey)
-                if rev != 0:
-                    rows.append((ukey, rev, value))
-            self._mirror = build_mirror(rows, self._mesh, self._kw, snapshot,
-                                        n_parts=self._partitions or None,
-                                        encode=self._encode)
+            ), snapshot
+        rows: list[tuple[bytes, int, bytes]] = []
+        for ikey, value in self._store.iter(lo, hi, snapshot_ts=snapshot):
+            ukey, rev = coder.decode(ikey)
+            if rev != 0:
+                rows.append((ukey, rev, value))
+        return build_mirror(rows, self._mesh, self._kw, snapshot,
+                            n_parts=self._partitions or None,
+                            encode=self._encode), snapshot
+
+    def _rebuild_from_store(self) -> None:
+        """Synchronous rebuild, caller holds ``_mlock`` (boot path and the
+        forced ``publish()``); also the foreground recovery from a
+        quarantined mirror — exiting the degraded window on success."""
+        self._mirror, _snapshot = self._build_mirror_from_store()
         self._delta = self._fresh_delta()
         self._force_rebuild = False
         self._pallas_cache = None  # old mirror's device copies must not pin
         self._pallas_ttl_cache = None
         self._probe_cache = None
+        self._exit_degraded_locked()
 
     def _fresh_delta(self) -> _DeltaIndex:
         """A delta index bound to the CURRENT mirror's stored domain, so
@@ -700,6 +940,12 @@ class TpuScanner(Scanner):
         when a delta key no longer fits the dictionary, re-dictionary)
         rebuild — counted separately so a bench can assert the steady
         state never takes it."""
+        plane = self._fault_plane
+        if plane is not None and plane.merge_fault():
+            # chaos: the merge fails here, BEFORE any state mutation —
+            # readers keep serving mirror+overlay; the kick loop's
+            # retry/backoff/escalation machinery must recover
+            raise RuntimeError("injected merge failure (fault plane)")
         with self._merge_lock:
             t0 = time.monotonic()
             with self._mlock:
@@ -939,6 +1185,10 @@ class TpuScanner(Scanner):
     def range_(self, start: bytes, end: bytes, read_revision: int, limit: int = 0):
         if limit and limit <= self._host_limit_threshold:
             return super().range_(start, end, read_revision, limit)
+        if self._degraded():
+            # quarantined/rebuilding mirror: serve from the authoritative
+            # host store (the differential oracle — byte-identical)
+            return Scanner.range_(self, start, end, read_revision, limit)
         self._snapshot_checked(read_revision)
         self._ensure_published()
         with self._mlock:
@@ -974,6 +1224,19 @@ class TpuScanner(Scanner):
         ``count`` calls: bounds/revision packing, index extraction, and
         host materialization all reuse the single-query code paths."""
         out: list = [None] * len(queries)
+        if self._degraded():
+            # degraded-mode serving: per-query host-store scans with the
+            # same per-query error demux (the engine-generic shape)
+            for i, spec in enumerate(queries):
+                try:
+                    if spec[0] == "count":
+                        out[i] = Scanner.count(self, spec[1], spec[2], spec[3])
+                    else:
+                        out[i] = Scanner.range_(self, spec[1], spec[2],
+                                                spec[3], spec[4])
+                except Exception as e:
+                    out[i] = e
+            return out
         device: list[tuple[int, tuple]] = []
         for i, spec in enumerate(queries):
             kind, start, end, read_rev = spec[0], spec[1], spec[2], spec[3]
@@ -1062,6 +1325,9 @@ class TpuScanner(Scanner):
         demand from the index list (reference receiver.go:105-160), with the
         delta overlay merged in key order — unbounded ranges never
         materialize in full on the host."""
+        if self._degraded():
+            return Scanner.range_stream(self, start, end, read_revision,
+                                        batch_size)
         self._snapshot_checked(read_revision)
         self._ensure_published()
         with self._mlock:
@@ -1119,6 +1385,8 @@ class TpuScanner(Scanner):
         return generate()
 
     def count(self, start: bytes, end: bytes, read_revision: int) -> int:
+        if self._degraded():
+            return Scanner.count(self, start, end, read_revision)
         self._snapshot_checked(read_revision)
         self._ensure_published()
         with self._mlock:
@@ -1674,14 +1942,22 @@ class _TrackedBatch(BatchWrite):
 
 def _tpu_factory(inner: str = "memkv", mesh=None, key_width: int = keyops.KEY_WIDTH,
                  use_pallas: bool | None = None, partitions: int = 0,
-                 encode_keys: bool | None = None, **inner_kw) -> TpuKvStorage:
+                 encode_keys: bool | None = None, inner_wrap=None,
+                 merge_threshold: int = 0, **inner_kw) -> TpuKvStorage:
     from .. import new_storage
 
     scanner_kw = {} if use_pallas is None else {"use_pallas": use_pallas}
     if encode_keys is not None:
         scanner_kw["encode_keys"] = encode_keys
+    if merge_threshold:
+        scanner_kw["merge_threshold"] = merge_threshold
+    host = new_storage(inner, **inner_kw)
+    if inner_wrap is not None:
+        # decorate the HOST engine (chaos mode wraps FaultyStorage here, so
+        # injected uncertainty exercises the mirror's quarantine machinery)
+        host = inner_wrap(host)
     return TpuKvStorage(
-        new_storage(inner, **inner_kw), mesh=mesh, key_width=key_width,
+        host, mesh=mesh, key_width=key_width,
         partitions=partitions, **scanner_kw
     )
 

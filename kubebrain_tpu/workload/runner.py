@@ -44,7 +44,8 @@ from dataclasses import asdict
 import grpc
 
 from .. import coder
-from ..client import EtcdCompatClient, LeaseMux, WatchMux
+from ..client import EtcdCompatClient, LeaseMux, WatchMux, classify_rpc_error
+from ..faults import schedule as fault_schedule
 from . import generator, slo
 from .clock import ReplayPacer
 from .generator import (
@@ -174,6 +175,30 @@ class WorkloadRunner:
         # /metrics lives on the target's host, not necessarily localhost
         self._info_host = (target.rsplit(":", 1)[0] if target
                            else "127.0.0.1")
+        # ---- chaos mode (docs/faults.md) ----
+        self.chaos = spec.faults != "none"
+        #: the deterministic fault schedule this run declares (regenerated
+        #: identically by the spawned server; sha echoed + self-checked)
+        self._fault_sched = None
+        if self.chaos:
+            self._fault_sched = fault_schedule.generate(
+                spec.faults, spec.fault_seed, self._fault_horizon_s())
+        self._fault_armed_at: float | None = None
+        # acknowledged-write ledger: POD key -> (state, revision) with
+        # state in {"live", "deleted", "ambiguous", "failed"} — the input
+        # to the keystone consistency check (every acked write present,
+        # every definite error absent, ambiguous either way)
+        self._ledger_lock = threading.Lock()
+        self._ledger: dict[bytes, tuple[str, int]] = {}
+        self._lease_keys_issued: set[bytes] = set()
+        # latency samples for ops that completed INSIDE an active fault
+        # window, per lane (the degraded-window p99 the report bounds)
+        self._degraded_samples: dict[str, list[float]] = {}
+
+    def _fault_horizon_s(self) -> float:
+        """Fault windows span the REAL replay duration: everything after
+        is the recovery window the final consistency scan runs in."""
+        return max(1.0, self.spec.duration_s / self.spec.time_scale)
 
     # ------------------------------------------------------------- plumbing
     def _count_rpc(self, what: str, n: int = 1) -> None:
@@ -187,12 +212,48 @@ class WorkloadRunner:
             if ok:
                 self._revs[key] = rev
 
-    def _execute(self, kind: str, fn, client) -> None:
+    # --------------------------------------------------- chaos: ack ledger
+    def _ledger_ack(self, key: bytes, state: str, rev: int = 0) -> None:
+        """An ACKNOWLEDGED outcome re-establishes certain state — a later
+        ack after an ambiguous op is only reachable when the ambiguous op
+        did not apply (its CAS chain would otherwise conflict), so
+        overwriting the ambiguous mark is sound."""
+        with self._ledger_lock:
+            self._ledger[key] = (state, rev)
+
+    def _ledger_ambiguous(self, key: bytes) -> None:
+        with self._ledger_lock:
+            self._ledger[key] = ("ambiguous", 0)
+
+    def _ledger_definite_failure(self, key: bytes) -> None:
+        """Definite (provably-not-applied) failure: only meaningful when
+        the key has no established state — it must then be ABSENT from the
+        final scan (a present key would be a definite-error ghost)."""
+        with self._ledger_lock:
+            self._ledger.setdefault(key, ("failed", 0))
+
+    def _in_fault_window(self) -> bool:
+        armed, sched = self._fault_armed_at, self._fault_sched
+        if armed is None or sched is None:
+            return False
+        t_ms = int((time.monotonic() - armed) * 1000)
+        return any(w.active(t_ms) for w in sched.windows)
+
+    def _execute(self, kind: str, fn, client, key: bytes | None = None,
+                 write: bool = False) -> None:
         t0 = time.monotonic()
+        in_window = self._in_fault_window()
         try:
             outcome = fn(client) or "ok"
         except grpc.RpcError as e:
             code = e.code() if hasattr(e, "code") else None
+            if key is not None and write:
+                # safe-vs-ambiguous classification (docs/faults.md): a
+                # maybe-applied write constrains the final-state check
+                if classify_rpc_error(e, write=True) == "ambiguous":
+                    self._ledger_ambiguous(key)
+                else:
+                    self._ledger_definite_failure(key)
             if code == grpc.StatusCode.RESOURCE_EXHAUSTED:
                 self.stats.record(kind, 0.0, "shed")
             else:
@@ -204,7 +265,13 @@ class WorkloadRunner:
             # see it, not vanish into a synthetic bucket
             self.stats.record(kind, 0.0, "error", err=repr(e))
             return
-        self.stats.record(kind, time.monotonic() - t0, outcome)
+        dt = time.monotonic() - t0
+        if in_window:
+            lane = LANE_OF.get(kind)
+            if lane is not None and outcome == "ok":
+                with self._ledger_lock:
+                    self._degraded_samples.setdefault(lane, []).append(dt)
+        self.stats.record(kind, dt, outcome)
 
     def _scrape(self) -> slo.PromSnapshot:
         with urllib.request.urlopen(
@@ -222,6 +289,12 @@ class WorkloadRunner:
             self._count_rpc("txn")
             ok, rev = client.create(op.key, b"v" * op.size)
             self._note_rev(op.key, rev, ok)
+            if ok:
+                self._ledger_ack(op.key, "live", rev)
+            else:
+                # a conflicting FIRST create on a unique key can only mean
+                # an earlier maybe-applied attempt landed: ambiguous
+                self._ledger_ambiguous(op.key)
             return None if ok else "conflict"
         return fn
 
@@ -234,6 +307,8 @@ class WorkloadRunner:
             self._count_rpc("txn")
             ok, newrev = client.update(op.key, b"u" * op.size, rev)
             self._note_rev(op.key, newrev, ok)
+            if ok:
+                self._ledger_ack(op.key, "live", newrev)
             return None if ok else "conflict"
         return fn
 
@@ -248,11 +323,14 @@ class WorkloadRunner:
             if ok:
                 with self._revs_lock:
                     self._revs.pop(op.key, None)
+                self._ledger_ack(op.key, "deleted")
             return None if ok else "conflict"
         return fn
 
     def _do_lease_grant(self, op):
         def fn(client):
+            with self._ledger_lock:
+                self._lease_keys_issued.add(op.key)
             self._count_rpc("lease_grant")
             lid, _granted = client.lease_grant(self.spec.lease_ttl_s)
             self._count_rpc("txn")
@@ -349,6 +427,17 @@ class WorkloadRunner:
                 # the replay owns compaction cadence; the server's own
                 # compactor would make the op trace's COMPACT accounting lie
                 "--compact-interval", "86400"]
+        if self.chaos:
+            # chaos mode: the server regenerates the SAME deterministic
+            # schedule (preset+seed+horizon); the /faults/arm echo is
+            # asserted against our local sha below
+            args += ["--faults", self.spec.faults,
+                     "--fault-seed", str(self.spec.fault_seed),
+                     "--fault-horizon-s", str(self._fault_horizon_s())]
+            if self.spec.storage == "tpu":
+                # a chaos-scale write count must actually cross the merge
+                # threshold, or the merge-fault windows never meet a merge
+                args += ["--merge-threshold", "32"]
         platform = os.environ.get("KB_WORKLOAD_JAX_PLATFORM", "cpu")
         if platform:
             args += ["--jax-platform", platform]
@@ -419,6 +508,8 @@ class WorkloadRunner:
             client.close()
         for op, (ok, rev) in zip(preload_ops, results):
             self._note_rev(op.key, rev, ok)
+            if ok:
+                self._ledger_ack(op.key, "live", rev)
             # outcome bookkeeping only: pipelined-burst latency is not a
             # per-op sample (it would be a fabricated 0)
             self.stats.record(PRELOAD_CREATE, 0.0, "ok" if ok else "conflict",
@@ -447,7 +538,197 @@ class WorkloadRunner:
             body = self._do_compact(op)
         else:  # pragma: no cover
             raise AssertionError(f"unroutable op kind {kind}")
-        shard.submit(lambda client, k=kind, b=body: self._execute(k, b, client))
+        is_write = kind in (POD_CREATE, POD_UPDATE, POD_DELETE, LEASE_GRANT)
+        wkey = op.key if is_write else None
+        shard.submit(lambda client, k=kind, b=body, wk=wkey, w=is_write:
+                     self._execute(k, b, client, key=wk, write=w))
+
+    # ------------------------------------------------------------ chaos
+    def _faults_http(self, path: str) -> dict:
+        with urllib.request.urlopen(
+            f"http://{self._info_host}:{self._info_port}{path}", timeout=15
+        ) as resp:
+            return json.loads(resp.read().decode())
+
+    def _arm_faults(self) -> None:
+        """Start the server's fault-window clock at replay start and
+        assert both sides generated the SAME schedule (sha echo)."""
+        ack = self._faults_http("/faults/arm")
+        self._fault_armed_at = time.monotonic()
+        want = self._fault_sched.sha256()
+        if ack.get("sha256") != want:
+            raise RuntimeError(
+                f"fault schedule divergence: server armed "
+                f"{ack.get('sha256')}, runner declared {want}")
+
+    def _consistency_check(self, drained: bool = True) -> dict:
+        """The keystone chaos invariant (docs/faults.md): one final
+        authoritative scan, judged against the acknowledged-write ledger —
+        every acked write present at its acked revision, every
+        definite-error key absent, ambiguous outcomes free to be either
+        (the linearizability discipline of tests/test_linearizability.py).
+
+        Only sound against a QUIESCENT server: with the drain timed out,
+        in-flight writes acked after the scan would read as phantom
+        losses, so the check reports itself unreliable (and fails — the
+        drain timeout is already its own SLO violation)."""
+        client = EtcdCompatClient(self._target, retries=4)
+        try:
+            st: dict = {}
+            try:
+                pod_kvs, _rev = client.list(
+                    PODS_PREFIX, coder.prefix_end(PODS_PREFIX),
+                    page=1000, stats=st)
+                lease_kvs, _ = client.list(
+                    LEASE_PREFIX, coder.prefix_end(LEASE_PREFIX),
+                    page=1000, stats=st)
+            finally:
+                # attempts (incl. transparent safe retries) must land in
+                # the reconcile counts — the server counted them too
+                self._count_rpc("range", st.get("rpcs", 0)
+                                + sum(client.retries_sent.values()))
+        finally:
+            client.close()
+        found = {kv.key: kv.mod_revision for kv in pod_kvs}
+        with self._ledger_lock:
+            ledger = dict(self._ledger)
+            lease_issued = set(self._lease_keys_issued)
+        losses: list[str] = []
+        ghosts: list[str] = []
+        rev_mismatches: list[str] = []
+        counts = Counter()
+        for key, (state, rev) in ledger.items():
+            if not key.startswith(PODS_PREFIX):
+                continue  # lease keys: reaper-owned, ghost-checked below
+            counts[state] += 1
+            if state == "live":
+                got = found.get(key)
+                if got is None:
+                    losses.append(key.decode(errors="replace"))
+                elif got != rev:
+                    rev_mismatches.append(
+                        f"{key.decode(errors='replace')}: acked {rev}, "
+                        f"found {got}")
+            elif state == "deleted":
+                if key in found:
+                    losses.append(
+                        f"{key.decode(errors='replace')} (acked delete, "
+                        "still present)")
+            elif state == "failed":
+                if key in found:
+                    ghosts.append(key.decode(errors="replace"))
+            # "ambiguous": present or absent, both legal
+        issued = set(ledger) | lease_issued
+        for key in found:
+            if key not in issued:
+                ghosts.append(key.decode(errors="replace") + " (never issued)")
+        for kv in lease_kvs:
+            if kv.key not in issued:
+                ghosts.append(kv.key.decode(errors="replace")
+                              + " (never issued)")
+        ok = drained and not losses and not ghosts and not rev_mismatches
+        return {
+            "ok": ok,
+            "reliable": drained,
+            "checked_keys": sum(counts.values()),
+            "acked_live": counts["live"],
+            "acked_deleted": counts["deleted"],
+            "ambiguous": counts["ambiguous"],
+            "definite_failures": counts["failed"],
+            "scanned": len(found) + len(lease_kvs),
+            "losses": losses[:20],
+            "ghosts": ghosts[:20],
+            "rev_mismatches": rev_mismatches[:20],
+        }
+
+    def _build_faults_section(self, baseline, final) -> dict:
+        """The report's ``faults`` section: schedule identity, per-kind
+        injected counts (server /metrics + /faults/state), the per-kind
+        injected-vs-scheduled reconcile, degraded-window latency stats,
+        and the keystone consistency check."""
+        if not self.chaos:
+            return {"armed": False}
+        state = self._faults_http("/faults/state")
+        injected = {k: int(v) for k, v in state.get("injected", {}).items()}
+        metrics_injected = {}
+        for labels, value in final.get("kb_faults_injected_total", ()):
+            metrics_injected[labels.get("kind", "?")] = int(value)
+        # reconcile per scheduled kind: a kind with windows AND eligible
+        # traffic must have observably injected. Engine kinds only exist
+        # on the tpu engine; conn_drop/watch_reset need the endpoint.
+        engine_kinds = {fault_schedule.MERGE_FAIL,
+                        fault_schedule.MERGE_SUPPRESS,
+                        fault_schedule.ENCODE_OVERFLOW}
+        reconcile: dict[str, dict] = {}
+        for kind in self._fault_sched.kinds():
+            eligible = (self.spec.storage == "tpu"
+                        if kind in engine_kinds else True)
+            n = injected.get(kind, 0)
+            reconcile[kind] = {
+                "scheduled": True,
+                "eligible": eligible,
+                "injected": n,
+                "metrics": metrics_injected.get(kind, 0),
+                # the /faults/state counter and the /metrics counter are
+                # two views of one increment; both must agree, and an
+                # eligible kind must have fired at least once
+                "ok": (n == metrics_injected.get(kind, 0)
+                       and (n > 0 or not eligible)),
+            }
+        with self._ledger_lock:
+            deg = {lane: list(s) for lane, s in self._degraded_samples.items()}
+        all_deg = [dt for s in deg.values() for dt in s]
+        degraded = {
+            "in_window_ops": len(all_deg),
+            "p50_ms": round(slo.percentile(all_deg, 0.5) * 1e3, 3),
+            "p99_ms": round(slo.percentile(all_deg, 0.99) * 1e3, 3)
+                      if all_deg else None,
+            "per_lane_p99_ms": {
+                lane: round(slo.percentile(s, 0.99) * 1e3, 3)
+                for lane, s in deg.items()},
+            "degraded_seconds": slo.series_sum(
+                final, "kb_degraded_seconds"),
+            "mirror_state": {
+                labels.get("state", "?"): value
+                for labels, value in final.get("kb_mirror_state", ())},
+        }
+        # schedule determinism self-check: regeneration must reproduce the
+        # declared sha (the fault-trace replay identity)
+        sha = self._fault_sched.sha256()
+        sha2 = fault_schedule.generate(
+            self.spec.faults, self.spec.fault_seed,
+            self._fault_horizon_s()).sha256()
+        if sha != sha2:
+            raise RuntimeError(
+                f"non-deterministic fault schedule: {sha} != {sha2}")
+        return {
+            "armed": True,
+            "schedule": self._fault_sched.to_dict(),
+            "determinism_checked": True,
+            "injected": injected,
+            "reconcile": reconcile,
+            "consistency": self._consistency,
+            "degraded": degraded,
+            "repairs": {
+                "rewritten": int(slo.delta(
+                    final, baseline, "kb_uncertain_repairs_total",
+                    outcome="rewritten")),
+                "dropped": int(slo.delta(
+                    final, baseline, "kb_uncertain_repairs_total",
+                    outcome="dropped")),
+                "gave_up": int(slo.delta(
+                    final, baseline, "kb_uncertain_repairs_total",
+                    outcome="gave_up")),
+            },
+            "merge": {
+                "errors": int(slo.delta(
+                    final, baseline, "kb_mirror_merge_errors_total")),
+                "retries": int(slo.delta(
+                    final, baseline, "kb_mirror_merge_retries_total")),
+                "escalations": int(slo.delta(
+                    final, baseline, "kb_mirror_merge_escalations_total")),
+            },
+        }
 
     def _drain(self, timeout_s: float = 60.0) -> bool:
         deadline = time.monotonic() + timeout_s
@@ -491,18 +772,32 @@ class WorkloadRunner:
             self._admin_shard = _Shard(
                 "kb-wl-admin", self._target, spec.shard_queue, self.stats)
             self._watch_client = EtcdCompatClient(self._target)
-            self._watchmux = WatchMux(self._watch_client, streams=spec.watch_streams)
+            # chaos: watches must survive injected server-side stream
+            # resets — resume from last-delivered revision + 1
+            self._watchmux = WatchMux(self._watch_client,
+                                      streams=spec.watch_streams,
+                                      resume=self.chaos)
             self._lease_client = EtcdCompatClient(self._target)
             self._leasemux = LeaseMux(self._lease_client, streams=spec.lease_streams)
 
+            if self.chaos:
+                # arm AFTER preload so the fault windows align with replay
+                self._arm_faults()
             replay_ops = schedule.replay
             pacer = ReplayPacer(spec.time_scale)
             for op in replay_ops:
                 pacer.wait_until(op.t_ms)
                 self._route(op)
-            drained = self._drain()
+            # chaos runs get a larger drain budget: the consistency scan
+            # is only sound against a quiescent server (an in-flight write
+            # acked after the scan would read as a phantom loss)
+            drained = self._drain(timeout_s=180.0 if self.chaos else 60.0)
             replay_wall = pacer.elapsed_s()
             time.sleep(0.3)  # let the last watch batches reach the wire
+            # the keystone chaos check runs BEFORE the final scrape so its
+            # Range RPCs land inside the reconcile window
+            self._consistency = (self._consistency_check(drained)
+                                 if self.chaos else None)
             final = self._scrape()
             report = self._build_report(
                 schedule, sha, baseline, final, preload_wall, replay_wall,
@@ -528,7 +823,8 @@ class WorkloadRunner:
         report["slo"]["pass"] = passed
         report["slo"]["violations"] = violations
         if self._write:
-            path = self._out_path or slo.next_report_path(REPO_ROOT)
+            path = self._out_path or slo.next_report_path(
+                REPO_ROOT, chaos=self.chaos)
             slo.write_report(report, path)
             print(f"[workload] SLO report: {path} "
                   f"({'PASS' if passed else 'FAIL'})", file=sys.stderr)
@@ -582,6 +878,11 @@ class WorkloadRunner:
             "watchers": live_watchers,
             "events": self._watchmux.total_events(),
             "cancelled": self._watchmux.cancelled_count(),
+            # chaos: server-side stream resets this run's watches survived
+            # (resume-from-revision+1; docs/faults.md)
+            "resumed": self._watchmux.resumed_total(),
+            "dropped_server_total": int(slo.delta(
+                final, baseline, "kb_watch_dropped_total")),
             "lag_wire_p99_s": slo.hist_quantile(
                 final, "kb_watch_lag_seconds", 0.99, point="wire"),
             "lag_queue_p99_s": slo.hist_quantile(
@@ -691,6 +992,7 @@ class WorkloadRunner:
             "slo": {"pass": False, "violations": [],
                     "bounds": asdict(spec.bounds)},
             "errors": list(stats.error_samples),
+            "faults": self._build_faults_section(baseline, final),
         }
         return report
 
@@ -737,12 +1039,25 @@ def main(argv=None) -> int:
                     help="traffic preset: cluster (default), smoke, or "
                          "churn-heavy (pod-churn + keepalive-storm write "
                          "skew exercising group commit; docs/writes.md)")
+    ap.add_argument("--faults", default="none",
+                    help="chaos mode (docs/faults.md): arm this fault "
+                         "preset on the spawned server (none, smoke, "
+                         "storage, watch, merge, full) and judge the run "
+                         "by the acknowledged-write consistency check; "
+                         "the report lands in CHAOS_rNN.json")
+    ap.add_argument("--fault-seed", type=int, default=0)
     args = ap.parse_args(argv)
 
     mesh_kw = {"mesh_part": args.mesh_part,
                "scan_partitions": args.scan_partitions}
+    chaos = args.faults and args.faults != "none"
     scenario = "smoke" if args.smoke else args.scenario
-    if scenario == "smoke":
+    if chaos:
+        spec = WorkloadSpec.for_chaos(
+            args.nodes, preset=args.faults, fault_seed=args.fault_seed,
+            seed=args.seed, duration_s=args.duration,
+            time_scale=args.scale, storage=args.storage, **mesh_kw)
+    elif scenario == "smoke":
         spec = WorkloadSpec.for_smoke(args.nodes, seed=args.seed,
                                       storage=args.storage, **mesh_kw)
     elif scenario == "churn-heavy":
@@ -756,13 +1071,18 @@ def main(argv=None) -> int:
     report = run_workload(spec, target=args.target or None,
                           info_port=args.target_info_port,
                           out_path=args.out or None)
-    print(json.dumps({
+    line = {
         "metric": "cluster-replay ops/sec",
         "value": report["replay"]["ops_per_sec"],
         "slo_pass": report["slo"]["pass"],
         "violations": report["slo"]["violations"],
         "trace_sha256": report["trace"]["sha256"],
-    }))
+    }
+    if report["faults"]["armed"]:
+        line["fault_sha256"] = report["faults"]["schedule"]["sha256"]
+        line["consistency_ok"] = report["faults"]["consistency"]["ok"]
+        line["injected"] = report["faults"]["injected"]
+    print(json.dumps(line))
     return 0 if report["slo"]["pass"] else 1
 
 

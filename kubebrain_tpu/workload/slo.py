@@ -136,9 +136,18 @@ _REQUIRED: dict[str, tuple[str, ...]] = {
     "reconcile": ("ok", "checks"),
     "slo": ("pass", "violations", "bounds"),
     "errors": (),
+    "faults": ("armed",),
 }
 
 _LANE_FIELDS = ("count", "p50_ms", "p99_ms", "shed", "errors")
+
+#: required inside report["faults"] when the fault plane was ARMED (chaos
+#: run): the schedule echo (identity), the per-kind injected/observed
+#: reconcile, the keystone consistency check, and the degraded-window stats
+_FAULTS_ARMED_FIELDS = ("schedule", "injected", "reconcile", "consistency",
+                        "degraded")
+_CONSISTENCY_FIELDS = ("ok", "checked_keys", "acked_live", "acked_deleted",
+                       "ambiguous", "losses", "ghosts", "rev_mismatches")
 
 
 def validate_report(report: dict) -> None:
@@ -157,6 +166,14 @@ def validate_report(report: dict) -> None:
         for f in _LANE_FIELDS:
             if f not in stats:
                 problems.append(f"lane {lane!r} missing {f!r}")
+    faults = report.get("faults", {})
+    if faults.get("armed"):
+        for sub in _FAULTS_ARMED_FIELDS:
+            if sub not in faults:
+                problems.append(f"missing field 'faults'.{sub!r}")
+        for sub in _CONSISTENCY_FIELDS:
+            if sub not in faults.get("consistency", {}):
+                problems.append(f"missing field 'faults'.'consistency'.{sub!r}")
     if problems:
         raise ValueError("invalid SLO report: " + "; ".join(problems))
 
@@ -225,19 +242,41 @@ def evaluate(report: dict, bounds) -> tuple[bool, list[str]]:
     if not report["reconcile"]["ok"]:
         bad = [c for c, r in report["reconcile"]["checks"].items() if not r["ok"]]
         v.append(f"client/server reconciliation failed: {', '.join(bad)}")
+    faults = report.get("faults", {})
+    if faults.get("armed"):
+        # the chaos gates (docs/faults.md): keystone consistency first
+        cons = faults["consistency"]
+        if not cons["ok"]:
+            v.append(
+                f"acknowledged-write consistency FAILED: "
+                f"{len(cons['losses'])} acked writes lost, "
+                f"{len(cons['ghosts'])} definite-error/unissued ghosts, "
+                f"{len(cons['rev_mismatches'])} revision mismatches")
+        bad_kinds = [k for k, r in faults["reconcile"].items() if not r["ok"]]
+        if bad_kinds:
+            v.append("fault injection reconcile failed (scheduled kind "
+                     f"never observed injecting): {', '.join(bad_kinds)}")
+        deg_p99 = faults["degraded"].get("p99_ms")
+        bound = getattr(bounds, "degraded_p99_ms", 0.0)
+        if deg_p99 is not None and bound and deg_p99 > bound:
+            v.append(f"degraded-window p99 {deg_p99:.1f}ms > {bound:.1f}ms")
     return (not v), v
 
 
 # ----------------------------------------------------------------- file IO
 
 _REPORT_RE = re.compile(r"^WORKLOAD_r(\d+)\.json$")
+_CHAOS_RE = re.compile(r"^CHAOS_r(\d+)\.json$")
 
 
-def next_report_path(root: str) -> str:
-    """``WORKLOAD_rNN.json`` with the next free round number under root."""
+def next_report_path(root: str, chaos: bool = False) -> str:
+    """``WORKLOAD_rNN.json`` (or ``CHAOS_rNN.json`` for fault-armed runs)
+    with the next free round number under root."""
+    pat, stem = (_CHAOS_RE, "CHAOS") if chaos else (_REPORT_RE, "WORKLOAD")
     rounds = [int(m.group(1)) for f in os.listdir(root)
-              if (m := _REPORT_RE.match(f))]
-    return os.path.join(root, "WORKLOAD_r%02d.json" % (max(rounds, default=0) + 1))
+              if (m := pat.match(f))]
+    return os.path.join(
+        root, "%s_r%02d.json" % (stem, max(rounds, default=0) + 1))
 
 
 def write_report(report: dict, path: str) -> str:

@@ -41,6 +41,10 @@ class SLOBounds:
     #: group formation; the churn_heavy scenario sets it > 0 and the
     #: reconcile section re-asserts the histogram moved.
     min_write_batched_ops: int = 0
+    #: chaos mode (docs/faults.md): p99 bound on ops completed INSIDE an
+    #: active fault window (the degraded-window bound the CHAOS report
+    #: asserts). Loose by default for the same 2-vCPU-CI reason as above.
+    degraded_p99_ms: float = 20000.0
 
 
 @dataclass(frozen=True)
@@ -90,6 +94,12 @@ class WorkloadSpec:
     watch_streams: int = 4
     lease_streams: int = 4
     shard_queue: int = 512               # bounded open-loop backpressure depth
+    #: chaos mode (docs/faults.md): fault-schedule preset armed on the
+    #: spawned server ("none" = no fault plane — provably inert). Runtime
+    #: only: the generated OP trace is untouched; the fault schedule has
+    #: its own deterministic trace + sha, echoed in the report.
+    faults: str = "none"
+    fault_seed: int = 0
 
     bounds: SLOBounds = field(default_factory=SLOBounds)
 
@@ -124,6 +134,11 @@ class WorkloadSpec:
             raise ValueError(
                 f"scan_partitions={self.scan_partitions} must be a multiple "
                 f"of mesh_part={self.mesh_part}")
+        from ..faults.schedule import PRESETS
+
+        if self.faults not in PRESETS:
+            raise ValueError(
+                f"faults={self.faults!r} unknown; presets: {PRESETS}")
 
     # ------------------------------------------------------------ factories
     @classmethod
@@ -162,6 +177,43 @@ class WorkloadSpec:
             lease_ttl_s=40,
             list_interval_s=20.0,       # thin the read load
             relist_interval_s=25.0,
+            lease_list_interval_s=10.0,
+            lease_listers=1,
+            grant_spread_s=2.0,
+        )
+        defaults.update(overrides)
+        return cls(**defaults)
+
+    @classmethod
+    def for_chaos(cls, nodes: int, preset: str = "smoke",
+                  **overrides) -> "WorkloadSpec":
+        """Chaos-mode replay (docs/faults.md): the churn_heavy traffic
+        shape under an armed fault schedule. Latency/shed/error bounds are
+        deliberately loose — the chaos gate is the KEYSTONE consistency
+        check (no acked write lost, no definite-error ghost) plus the
+        per-kind injected-fault reconcile, not happy-path p99s; lease
+        expiries are legal (keepalives legitimately fail inside conn-drop
+        windows) and the replay owns no compaction guarantee under
+        injected storage errors."""
+        namespaces = max(4, min(100, nodes // 10))
+        bounds = overrides.pop("bounds", SLOBounds(
+            max_shed_rate=0.5,
+            max_error_rate=0.5,
+            watch_wire_lag_p99_s=30.0,
+            max_lease_expiries=10_000,
+            max_watch_cancels=10_000,
+            min_compactions=0,
+            min_write_batched_ops=0,
+        ))
+        defaults = dict(
+            nodes=nodes, namespaces=namespaces, bounds=bounds,
+            faults=preset,
+            pods_per_node=6,
+            churn_interval_s=0.5,
+            keepalive_interval_s=4.0,
+            lease_ttl_s=40,
+            list_interval_s=10.0,
+            relist_interval_s=12.0,
             lease_list_interval_s=10.0,
             lease_listers=1,
             grant_spread_s=2.0,

@@ -697,7 +697,7 @@ def test_kb117_scoped_to_storage_tpu():
 def test_registry_has_all_rules():
     assert set(RULES) == {"KB101", "KB102", "KB103", "KB104", "KB105", "KB106",
                           "KB107", "KB108", "KB109", "KB110", "KB111",
-                          "KB116", "KB117"}
+                          "KB116", "KB117", "KB118"}
     for rule in RULES.values():
         assert rule.summary
 
@@ -723,3 +723,129 @@ def test_cli_list_rules():
     assert proc.returncode == 0
     for rid in ("KB101", "KB102", "KB103", "KB104", "KB105"):
         assert rid in proc.stdout
+
+
+# ------------------------------------------------------------------- KB118
+RETRY_PKG = "kubebrain_tpu/backend/x.py"
+
+
+def test_kb118_flags_unbounded_while_true_retry():
+    src = (
+        "import time\n"
+        "def f(op):\n"
+        "    while True:\n"
+        "        try:\n"
+        "            return op()\n"
+        "        except Exception:\n"
+        "            continue\n"
+    )
+    assert ids(src, RETRY_PKG) == ["KB118"]
+
+
+def test_kb118_allows_bounded_retry_and_deadline():
+    bounded = (
+        "import time, random\n"
+        "def f(op):\n"
+        "    for attempt in range(5):\n"
+        "        try:\n"
+        "            return op()\n"
+        "        except Exception:\n"
+        "            time.sleep(0.1 * random.uniform(0.5, 1.5))\n"
+    )
+    assert ids(bounded, RETRY_PKG) == []
+    deadline = (
+        "import time, random\n"
+        "def f(op):\n"
+        "    deadline = time.monotonic() + 5\n"
+        "    while True:\n"
+        "        try:\n"
+        "            return op()\n"
+        "        except Exception:\n"
+        "            if time.monotonic() > deadline:\n"
+        "                raise\n"
+    )
+    assert ids(deadline, RETRY_PKG) == []
+
+
+def test_kb118_flags_constant_sleep_without_jitter():
+    src = (
+        "import time\n"
+        "def f(op):\n"
+        "    attempts = 0\n"
+        "    while attempts < 5:\n"
+        "        try:\n"
+        "            return op()\n"
+        "        except Exception:\n"
+        "            attempts += 1\n"
+        "        time.sleep(0.25)\n"
+    )
+    assert ids(src, RETRY_PKG) == ["KB118"]
+    jittered = src.replace("time.sleep(0.25)",
+                           "time.sleep(0.25 * jitter())")
+    assert ids(jittered, RETRY_PKG) == []
+
+
+def test_kb118_flags_sleep_under_lock_in_retry_loop():
+    src = (
+        "import time, random\n"
+        "def f(self, op):\n"
+        "    for attempt in range(4):\n"
+        "        with self._lock:\n"
+        "            try:\n"
+        "                return op()\n"
+        "            except Exception:\n"
+        "                pass\n"
+        "            time.sleep(0.1 * random.uniform(0.5, 1.5))\n"
+    )
+    out = [f for f in lint_source(src, RETRY_PKG) if f.rule_id == "KB118"]
+    assert [f.rule_id for f in out] == ["KB118"]
+    assert "lock" in out[0].message
+
+
+def test_kb118_error_captured_for_delivery_is_not_a_retry():
+    # a dispatcher loop that binds the exception and hands it to the
+    # waiting caller is delivering, not retrying (the scheduler's shape)
+    src = (
+        "def f(q):\n"
+        "    while True:\n"
+        "        req = q.get()\n"
+        "        try:\n"
+        "            result, err = req.fn(), None\n"
+        "        except Exception as e:\n"
+        "            result, err = None, e\n"
+        "        req.finish(result, err)\n"
+    )
+    assert ids(src, RETRY_PKG) == []
+
+
+def test_kb118_scoped_to_serving_packages_and_suppressible():
+    src = (
+        "def f(op):\n"
+        "    while True:\n"
+        "        try:\n"
+        "            return op()\n"
+        "        except Exception:\n"
+        "            continue\n"
+    )
+    # tools/tests are out of scope
+    assert ids(src, "tools/kblint/x.py") == []
+    assert ids(src, "tests/x.py") == []
+    assert ids(src, "kubebrain_tpu/workload/x.py") == []
+    # faults/ and client.py are serving-path
+    assert ids(src, "kubebrain_tpu/faults/x.py") == ["KB118"]
+    assert ids(src, "kubebrain_tpu/client.py") == ["KB118"]
+    sup = src.replace(
+        "    while True:",
+        "    while True:  # kblint: disable=KB118 -- test fixture")
+    assert ids(sup, RETRY_PKG) == []
+
+
+def test_kb110_covers_faults_package():
+    # the fault schedule's replayability contract extends KB110 to faults/
+    src = "import random\ndef lay():\n    return random.random()\n"
+    assert ids(src, "kubebrain_tpu/faults/x.py") == ["KB110"]
+    src2 = "import time\ndef lay():\n    return time.time()\n"
+    assert ids(src2, "kubebrain_tpu/faults/x.py") == ["KB110"]
+    seeded = ("import random\ndef lay(seed):\n"
+              "    return random.Random(seed).random()\n")
+    assert ids(seeded, "kubebrain_tpu/faults/x.py") == []

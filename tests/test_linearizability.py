@@ -478,20 +478,36 @@ def test_replicated_tier_failover_soak_is_linearizable(tmp_path):
         t_kill[0] = time.monotonic()
         prim.wait()
         time.sleep(0.3)
-        deadline = time.time() + 15
+        # load-aware promote bound (the test_raft_tier election-bound
+        # discipline): under full-suite load the follower's stream
+        # liveness check + promotion RPC lag far behind the standalone
+        # timings, so the bound covers observation lag, not just the
+        # nominal election window. Jittered probe cadence (kblint KB118).
+        deadline = time.time() + 60
         while time.time() < deadline and not stop_nemesis.is_set():
             try:
                 store.failover()
                 t_promote[0] = time.monotonic()
                 return
             except Exception:
-                time.sleep(0.3)
+                time.sleep(0.3 * random.uniform(0.7, 1.3))
 
     nt = threading.Thread(target=nemesis, daemon=True)
     nt.start()
     try:
-        _soak(rec, n_clients=6, n_ops=600, n_keys=8, seed=7)
+        # barrier_every bounds every op window by construction (the same
+        # rendezvous discipline the raw soak and test_raft_tier use) —
+        # without it, full-suite host load stretches preempted threads'
+        # op windows until the checker's per-key search fuses
+        _soak(rec, n_clients=6, n_ops=600, n_keys=8, seed=7,
+              barrier_every=12)
     finally:
+        # rendezvous with the nemesis BEFORE aborting it: the soak can
+        # finish while the promote loop is still probing a mid-election
+        # tier, and stop_nemesis aborting that loop was exactly the
+        # "failover never completed" full-suite flake — promotion then
+        # never happened and the assertion below misfired
+        nt.join(timeout=75)
         stop_nemesis.set()
         nt.join(timeout=20)
 

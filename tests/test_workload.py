@@ -149,6 +149,7 @@ def _minimal_report(**overrides) -> dict:
         "reconcile": {"ok": True, "checks": {}},
         "slo": {"pass": True, "violations": [], "bounds": {}},
         "errors": [],
+        "faults": {"armed": False},
     }
     report.update(overrides)
     return report

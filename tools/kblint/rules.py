@@ -466,7 +466,11 @@ class ReplayableWorkloadRandomness(Rule):
                "thread a seeded random.Random; clock via the event wheel")
 
     def applies(self, relpath: str) -> bool:
-        return relpath.replace("\\", "/").startswith("kubebrain_tpu/workload/")
+        # faults/ carries the same replayability contract: the fault
+        # schedule's sha IS the chaos run's replay identity
+        p = relpath.replace("\\", "/")
+        return (p.startswith("kubebrain_tpu/workload/")
+                or p.startswith("kubebrain_tpu/faults/"))
 
     def check(self, tree: ast.Module, src: str) -> Iterable[tuple[ast.AST, str]]:
         roots, from_names = _rng_alias_maps(tree)
@@ -855,4 +859,165 @@ class RevisionFlowsThroughHelpers(Rule):
                     yield node, (
                         f"raw in-place arithmetic on revision value {name!r}; "
                         "use a server/service/revision.py helper"
+                    )
+
+
+# --------------------------------------------------------------------- KB118
+#: names whose presence in a loop suggests the retry count/window is bounded
+_RETRY_BOUND_RE = re.compile(
+    r"attempt|retr|tries|deadline|budget|remain|give_up|max_|horizon",
+    re.IGNORECASE)
+#: names whose presence in a sleep argument suggests jittered backoff
+_JITTER_RE = re.compile(r"jitter|random|uniform|backoff|expov|rng",
+                        re.IGNORECASE)
+_LOCKISH_RE = re.compile(r"lock|mutex|cond", re.IGNORECASE)
+
+
+def _handler_swallows(handler: ast.ExceptHandler) -> bool:
+    """True when the except body neither re-raises, exits the loop, nor
+    captures the exception for delivery — the shape that turns a loop
+    into a retry loop. A handler that binds ``as e`` and then USES ``e``
+    is delivering the error somewhere (a waiter, a result slot), not
+    retrying past it."""
+    for node in ast.walk(handler):
+        if isinstance(node, (ast.Raise, ast.Return, ast.Break)):
+            return False
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            return False  # nested defs run later; be conservative
+    if handler.name:
+        for node in ast.walk(handler):
+            if isinstance(node, ast.Name) and node.id == handler.name \
+                    and isinstance(node.ctx, ast.Load):
+                return False
+    return True
+
+
+def _loop_names(loop: ast.AST) -> set[str]:
+    out: set[str] = set()
+    for node in walk_same_scope(getattr(loop, "body", [])):
+        if isinstance(node, ast.Name):
+            out.add(node.id)
+        elif isinstance(node, ast.Attribute):
+            out.add(node.attr)
+    # the loop test itself may carry the bound (while attempts < N)
+    test = getattr(loop, "test", None)
+    if test is not None:
+        for node in ast.walk(test):
+            if isinstance(node, ast.Name):
+                out.add(node.id)
+            elif isinstance(node, ast.Attribute):
+                out.add(node.attr)
+    return out
+
+
+def _is_while_true(loop: ast.AST) -> bool:
+    return (isinstance(loop, ast.While)
+            and isinstance(loop.test, ast.Constant)
+            and bool(loop.test.value))
+
+
+def _sleep_calls(body: list[ast.stmt]) -> Iterator[ast.Call]:
+    for node in walk_same_scope(body):
+        if isinstance(node, ast.Call) and dotted_name(node.func) in (
+                "time.sleep", "sleep"):
+            yield node
+
+
+def _sleep_has_jitter(call: ast.Call) -> bool:
+    for arg in list(call.args) + [kw.value for kw in call.keywords]:
+        for node in ast.walk(arg):
+            if isinstance(node, (ast.Name, ast.Attribute)):
+                if _JITTER_RE.search(terminal_name(node) or ""):
+                    return True
+            if isinstance(node, ast.Call):
+                if _JITTER_RE.search(terminal_name(node.func) or ""):
+                    return True
+    return False
+
+
+def _locks_enclosing(tree: ast.Module, line: int) -> list[ast.AST]:
+    """With-blocks whose context expression names a lock and whose span
+    covers ``line`` (lexical only — the transitive case is KB112's)."""
+    out = []
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.With, ast.AsyncWith)):
+            continue
+        end = getattr(node, "end_lineno", 0) or 0
+        if not (node.lineno <= line <= end):
+            continue
+        for item in node.items:
+            name = dotted_name(item.context_expr) or terminal_name(
+                item.context_expr)
+            if isinstance(item.context_expr, ast.Call):
+                name = dotted_name(item.context_expr.func)
+            if name and _LOCKISH_RE.search(name.rsplit(".", 1)[-1]):
+                out.append(node)
+    return out
+
+
+@register
+class RetryLoopHygiene(Rule):
+    """Serving-path retry loops must be BOUNDED, BACKED OFF WITH JITTER,
+    and never sleep while holding a lock (docs/faults.md). The chaos
+    harness makes every engine call failable — an unbounded `while True`
+    retry with a constant sleep turns one injected fault window into a
+    convoy: every retrier wakes at the same instant forever, and a lock
+    held across the sleep wedges every other thread for the full backoff.
+    KB112's interprocedural lock stacks cover the TRANSITIVE
+    sleep-under-lock case; this rule pins the lexical shapes:
+
+    - ``while True`` + an exception handler that swallows-and-retries,
+      with no attempt/deadline bound anywhere in the loop;
+    - ``time.sleep`` inside a retry loop with no jitter term in the
+      argument expression;
+    - ``time.sleep`` inside a retry loop lexically under a ``with *lock``.
+    """
+
+    rule_id = "KB118"
+    summary = ("serving-path retry loops: bounded attempts, jittered "
+               "backoff, no time.sleep under a lock")
+
+    _PACKAGES = ("kubebrain_tpu/backend/", "kubebrain_tpu/storage/",
+                 "kubebrain_tpu/server/", "kubebrain_tpu/sched/",
+                 "kubebrain_tpu/endpoint/", "kubebrain_tpu/lease/",
+                 "kubebrain_tpu/faults/", "kubebrain_tpu/client.py")
+
+    def applies(self, relpath: str) -> bool:
+        p = relpath.replace("\\", "/")
+        return any(p.startswith(pkg) for pkg in self._PACKAGES)
+
+    def check(self, tree: ast.Module, src: str) -> Iterable[tuple[ast.AST, str]]:
+        for loop in ast.walk(tree):
+            if not isinstance(loop, (ast.While, ast.For)):
+                continue
+            swallowing = [
+                h for node in walk_same_scope(loop.body)
+                if isinstance(node, ast.Try)
+                for h in node.handlers if _handler_swallows(h)
+            ]
+            if not swallowing:
+                continue  # not a retry loop
+            names = _loop_names(loop)
+            bounded = (isinstance(loop, ast.For)  # for i in range(N): bounded
+                       or any(_RETRY_BOUND_RE.search(n) for n in names)
+                       or not _is_while_true(loop))
+            if not bounded:
+                yield loop, (
+                    "unbounded `while True` retry loop (exception swallowed "
+                    "and retried with no attempt cap or deadline); bound it "
+                    "or escalate after K failures"
+                )
+            for call in _sleep_calls(loop.body):
+                if _locks_enclosing(tree, call.lineno):
+                    yield call, (
+                        "time.sleep in a retry loop while holding a lock: "
+                        "the backoff wedges every other thread on that lock "
+                        "(transitive case: KB112)"
+                    )
+                elif not _sleep_has_jitter(call):
+                    yield call, (
+                        "retry backoff without jitter: a fleet of retriers "
+                        "sleeping a constant re-collides forever; multiply "
+                        "by random.uniform(0.5, 1.5) or similar"
                     )
