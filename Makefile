@@ -4,8 +4,8 @@
 SHELL := /bin/bash  # test-tier1 needs pipefail
 
 .PHONY: all native test bench bench-all bench-smoke bench-cluster \
-        bench-multichip bench-write run clean protos lint typecheck check \
-        test-tier1
+        bench-multichip bench-write bench-compact run clean protos lint \
+        typecheck check test-tier1
 
 all: native
 
@@ -76,6 +76,9 @@ bench-smoke:
 # FAULTS=<preset> (smoke|storage|watch|merge|full) arms chaos mode
 # (docs/faults.md): churn_heavy replayed against a fault-injected server,
 # judged by the acknowledged-write consistency check; emits CHAOS_rNN.json.
+# COMPACT_S overrides the spec's compaction cadence in SIMULATED seconds
+# (0 = scenario default), e.g. the 5-min-compaction scenario of the
+# ROADMAP: make bench-cluster N=1000 DURATION=900 COMPACT_S=300.
 N ?= 1000
 STORAGE ?= memkv
 MESH_PART ?= 0
@@ -83,12 +86,14 @@ SCAN_PARTS ?= 0
 SCENARIO ?= cluster
 FAULTS ?= none
 FAULT_SEED ?= 0
+COMPACT_S ?= 0
 bench-cluster:
 	JAX_PLATFORMS=cpu KB_BENCH_METRIC=cluster KB_BENCH_NODES=$(N) \
 	    KB_WORKLOAD_STORAGE=$(STORAGE) KB_WORKLOAD_MESH_PART=$(MESH_PART) \
 	    KB_WORKLOAD_SCAN_PARTITIONS=$(SCAN_PARTS) \
 	    KB_WORKLOAD_SCENARIO=$(SCENARIO) KB_WORKLOAD_FAULTS=$(FAULTS) \
-	    KB_WORKLOAD_FAULT_SEED=$(FAULT_SEED) python bench.py
+	    KB_WORKLOAD_FAULT_SEED=$(FAULT_SEED) \
+	    KB_WORKLOAD_COMPACT_S=$(COMPACT_S) python bench.py
 
 # Multichip sharded serving curve (docs/multichip.md): the scan workload
 # served through the scheduler at mesh sizes 1..8, byte-identical across
@@ -102,6 +107,15 @@ bench-multichip:
 # state proving the incremental delta merge never takes a full rebuild.
 bench-write:
 	JAX_PLATFORMS=cpu KB_BENCH_METRIC=write python bench.py
+
+# Device-side compaction (docs/compaction.md): the stored-domain pipeline
+# vs the engine-generic host compactor over one ~1M-row store with a
+# realistic victim mix — byte-identity vs the sequential oracle asserted,
+# zero full rebuilds / re-dictionary encodes asserted, >= 2x host asserted
+# at acceptance size (CPU-sim; TPU bar pending_tpu off-TPU). Emits the
+# kubebrain-compact/v1 report to KB_COMPACT_OUT (COMPACT_rNN.json).
+bench-compact:
+	JAX_PLATFORMS=cpu KB_BENCH_METRIC=compact python bench.py
 
 run: native
 	python -m kubebrain_tpu.cli --single-node --storage=tpu --inner-storage=native

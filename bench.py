@@ -228,177 +228,296 @@ def bench_fanout() -> None:
     }))
 
 
+def _compact_dataset(n_keys: int, seed: int = 7):
+    """Deterministic pre-compaction store content with a realistic victim
+    mix (docs/compaction.md): superseded version chains, tombstoned chains
+    (fully doomed incl. the rev record), TTL-expired ``/events/`` rows, and
+    clean singleton survivors. Returns ``(rows, ttl_boundary_rev,
+    compact_rev, n_version_rows)`` where ``rows`` is a list of
+    ``(internal_key, value)`` pairs ready to batch-put into ANY engine —
+    the oracle and device stores load byte-identical content."""
+    import random as _random
+
+    from kubebrain_tpu import coder
+    from kubebrain_tpu.backend.common import TOMBSTONE
+
+    rng = _random.Random(seed)
+    rows: list[tuple[bytes, bytes]] = []
+    rev = 0
+    n_version_rows = 0
+    # kube-realistic object payloads (pods serialize to KBs, not tens of
+    # bytes): deterministic sizes in [256, 2048) sliced from one pattern
+    # buffer — content doesn't matter to compaction, footprint does
+    payload = bytes(range(256)) * 8
+
+    def body(i):
+        return payload[: rng.randrange(256, 2048)] + b"#%d" % i
+
+    def version(uk, value):
+        nonlocal rev, n_version_rows
+        rev += 1
+        n_version_rows += 1
+        rows.append((coder.encode_object_key(uk, rev), value))
+        return rev
+
+    def rev_record(uk, latest, deleted):
+        rows.append((coder.encode_revision_key(uk),
+                     coder.encode_rev_value(latest, deleted=deleted)))
+
+    # phase 1: expired /events/ rows — everything at or below this boundary
+    # revision is TTL-expired (the seeded compact history ages it past the
+    # EVENTS_TTL cutoff)
+    n_events = n_keys // 4
+    for i in range(n_events):
+        uk = b"/events/ns%02d/ev-%06d" % (i % 20, i)
+        r = version(uk, body(i))
+        rev_record(uk, r, False)
+    ttl_boundary_rev = rev
+
+    # phase 2: registry churn — chains, tombstones, singletons
+    for i in range(n_keys - n_events):
+        ns = i % 32
+        uk = b"/registry/pods/ns%02d/pod-%06d" % (ns, i)
+        shape = i % 3
+        if shape == 0:  # superseded chain: 2-4 doomed + 1 surviving version
+            r = version(uk, body(i))
+            for j in range(2 + rng.randrange(3)):
+                r = version(uk, body(i + j))
+            rev_record(uk, r, False)
+        elif shape == 1:  # tombstoned: the whole chain compacts away
+            version(uk, body(i))
+            r = version(uk, TOMBSTONE)
+            rev_record(uk, r, True)
+        else:  # clean singleton survivor
+            r = version(uk, body(i))
+            rev_record(uk, r, False)
+    # load in sorted key order: engines keeping a sorted key index (memkv's
+    # insort, LSM memtables) then pay O(1) tail appends instead of O(n)
+    # mid-list inserts — bulk loads are sorted in any real migration, and
+    # both engines load the identical sequence either way
+    rows.sort(key=lambda kv: kv[0])
+    return rows, ttl_boundary_rev, rev, n_version_rows
+
+
+def _load_store(store, rows, batch: int = 1024) -> None:
+    for b0 in range(0, len(rows), batch):
+        bw = store.begin_batch_write()
+        for k, v in rows[b0 : b0 + batch]:
+            bw.put(k, v)
+        bw.commit()
+
+
+def _dump_store(store) -> list:
+    from kubebrain_tpu import coder
+
+    lo, hi = coder.internal_range(b"", b"")
+    return list(store.iter(lo, hi))
+
+
 def bench_compact() -> None:
-    """BASELINE config 2: MVCC compact/GC — victim marking + block
-    compaction gather over a keys x revisions dataset, vs numpy baseline."""
+    """Engine-level compaction bench (make bench-compact; docs/compaction.md):
+    three compactors over byte-identical store content with a realistic
+    victim mix —
+
+    - **device**: the stored-domain pipeline (victim kernel → shard-local
+      index pull → victim-only decode GC → survivor gather + k-way merge,
+      dirty shards only);
+    - **host path**: the CURRENT-until-this-PR mirror half — identical
+      marking + GC, but the mirror absorbs the compaction through the
+      decode-everything → re-dictionary → re-partition full rebuild
+      (`compact_force_full`, preserved as the fallback rung);
+    - **oracle**: the engine-generic sequential compactor
+      (backend/scanner.py) — the semantic ground truth.
+
+    Gates: post-compact store state byte-identical across ALL three,
+    serving results identical, ZERO full rebuilds / re-dictionary encodes
+    on the device path, and (at the >= 1M-row acceptance size, on the
+    native engine) compact_rows_per_sec >= 2x the host path on CPU-sim —
+    the TPU bar is the same 2x asserted on-TPU and stamped pending_tpu
+    off it. The inner engine is the NATIVE store when its library loads
+    (KB_COMPACT_ENGINE=auto|native|memkv): that is the production
+    configuration — compaction GC rides the C `bulk_gc`/`prune` fast
+    paths in all three compactors, so the measured difference is the
+    mirror half this PR moved into the stored domain, not Python store
+    mutation (the memkv fallback still runs every identity gate, plus the
+    TTL-expiry class the native engine handles natively). One untimed
+    warm-up pass pays every jit compile before either timed pass (the
+    shapes are identical — same dataset). Report: COMPACT_rNN.json
+    (kubebrain-compact/v1) via KB_COMPACT_OUT."""
+    import time as _time
+
     import jax
-    import jax.numpy as jnp
 
-    from kubebrain_tpu.ops import keys as keyops
-    from kubebrain_tpu.ops.compact import compact_block, victim_mask
+    from kubebrain_tpu import coder
+    from kubebrain_tpu.backend.scanner import CompactHistory, Scanner
+    from kubebrain_tpu.storage import new_storage
 
-    n_keys = int(os.environ.get("KB_BENCH_KEYS", 100_000))
-    revs = int(os.environ.get("KB_BENCH_REVS", 100))
-    iters = int(os.environ.get("KB_BENCH_ITERS", 10))
-    chunks, rh, rl, tomb = build_dataset(n_keys, revs)
-    n = len(chunks)
-    ttl = np.zeros(n, dtype=bool)
-    compact_rev = np.uint64(n)
-    chi, clo = keyops.split_revs(np.array([compact_rev], dtype=np.uint64))
-    thi, tlo = keyops.split_revs(np.array([0], dtype=np.uint64))
+    # default sizes the acceptance shape: ~2 version rows per key on
+    # average, so 520k keys ≈ 1.04M version rows (>= the 1M-row bar)
+    n_keys = int(os.environ.get("KB_BENCH_KEYS", 520_000))
+    seed = int(os.environ.get("KB_BENCH_SEED", 7))
+    engine = os.environ.get("KB_COMPACT_ENGINE", "auto")
+    if engine == "auto":
+        try:
+            probe = new_storage("native")
+            probe.close()
+            engine = "native"
+        except Exception:
+            engine = "memkv"
+    inner_kw = {} if engine == "native" else {"ttl_supported": False}
+    rows, ttl_rev, compact_rev, n_version_rows = _compact_dataset(n_keys, seed)
+    lo, hi = coder.internal_range(b"", b"")
+    aged = _time.time() - 7200  # compact-history entry older than EVENTS_TTL
 
-    # numpy baseline: the FULL victim rule (rev compares included, same math
-    # as the kernel — no shortcuts even though this dataset's revs are all
-    # <= compact_rev)
-    t0 = time.time()
-    c_hi, c_lo = np.uint32(chi[0]), np.uint32(clo[0])
-    rev_le = (rh < c_hi) | ((rh == c_hi) & (rl <= c_lo))
-    same_next = np.zeros(n, dtype=bool)
-    same_next[:-1] = (chunks[1:] == chunks[:-1]).all(axis=1)
-    le_next = np.zeros(n, dtype=bool)
-    le_next[:-1] = rev_le[1:]
-    superseded = rev_le & same_next & le_next
-    is_last_le = rev_le & ~(same_next & le_next)
-    victims_np = superseded | (is_last_le & tomb)
-    # ...and the actual compaction gather, same as the device path
-    keep_idx = np.nonzero(~victims_np)[0]
-    kept_arrays = (chunks[keep_idx], rh[keep_idx], rl[keep_idx], tomb[keep_idx])
-    keep_np = len(keep_idx)
-    del kept_arrays
-    cpu_dt = time.time() - t0
-    cpu_rate = n / cpu_dt
+    def tpu_scanner():
+        store = new_storage("tpu", inner=engine, **inner_kw)
+        _load_store(store, rows)
+        hist = CompactHistory()
+        hist.log(ttl_rev, now=aged)
+        sc = store.make_scanner(
+            get_compact_revision=lambda *_a: 0, compact_history=hist)
+        sc.publish()  # mirror build off the clock (boot cost, not compact)
+        return store, sc
+
+    def run_tpu_path(force_full):
+        store, sc = tpu_scanner()
+        sc.compact_force_full = force_full
+        enc_before = sc._mirror.encoding
+        t0 = _time.time()
+        stats = sc.compact(lo, hi, compact_rev)
+        return store, sc, stats, _time.time() - t0, enc_before
+
+    # ---- warm-up: pays every jit compile off BOTH clocks (the legacy
+    # path shares the marking kernels; its full rebuild is numpy-only)
+    w_store, w_sc, _w_stats, _, _ = run_tpu_path(False)
+    w_sc.close()
+    w_store.close()
+
+    # ---- device path: the stored-domain pipeline ------------------------
+    dev_store, dev_sc, dev_stats, dev_dt, encoding_before = run_tpu_path(False)
+    dev_rate = n_version_rows / dev_dt
+
+    # ---- host path: identical marking + GC, legacy mirror rebuild -------
+    leg_store, leg_sc, leg_stats, leg_dt, _enc = run_tpu_path(True)
+    leg_rate = n_version_rows / leg_dt
+    assert leg_stats.mirror_path == "full_rebuild", leg_stats.mirror_path
+
+    # ---- oracle: the engine-generic sequential compactor ----------------
+    orc_store = new_storage(engine, **inner_kw)
+    _load_store(orc_store, rows)
+    hist = CompactHistory()
+    hist.log(ttl_rev, now=aged)
+    orc_sc = Scanner(orc_store, lambda *_a: 0, compact_history=hist)
+    t0 = _time.time()
+    orc_stats = orc_sc.compact(lo, hi, compact_rev)
+    orc_dt = _time.time() - t0
+    orc_rate = n_version_rows / orc_dt
+
+    # ---- gates ----------------------------------------------------------
+    # 1. post-compact store state byte-identical across all three
+    orc_dump = _dump_store(orc_store)
+    dev_dump = _dump_store(dev_store._inner)
+    leg_dump = _dump_store(leg_store._inner)
+    assert orc_dump == dev_dump, (
+        f"device store diverged from oracle: {len(orc_dump)} vs "
+        f"{len(dev_dump)} rows")
+    assert orc_dump == leg_dump, "legacy store diverged from oracle"
+    # 2. serving results identical (mirrors vs oracle host scan)
+    orc_kvs = [(kv.key, kv.value, kv.revision)
+               for kv in orc_sc.range_(b"", b"", compact_rev)[0]]
+    for sc in (dev_sc, leg_sc):
+        got = [(kv.key, kv.value, kv.revision)
+               for kv in sc.range_(b"", b"", compact_rev)[0]]
+        assert got == orc_kvs, "post-compact serving results diverged"
+    # 3. steady state: no full rebuild, no re-dictionary, stored path
+    assert dev_sc.full_rebuild_total == 0, \
+        f"device compact took {dev_sc.full_rebuild_total} full rebuild(s)"
+    assert dev_sc._mirror.encoding is encoding_before, \
+        "device compact re-dictionaried the mirror"
+    assert dev_stats.mirror_path == "stored_incremental", dev_stats.mirror_path
+    # 4. victim classification equal to the oracle's
+    for f in ("deleted_versions", "deleted_tombstones", "deleted_rev_records",
+              "expired_ttl"):
+        assert getattr(dev_stats, f) == getattr(orc_stats, f), (
+            f, getattr(dev_stats, f), getattr(orc_stats, f))
+        assert getattr(leg_stats, f) == getattr(orc_stats, f), f
 
     dev = jax.devices()[0]
     on_tpu = dev.platform in ("tpu", "axon")
-    env_pallas = os.environ.get("KB_BENCH_PALLAS")
-    use_pallas = on_tpu if env_pallas is None else env_pallas == "1"
+    speedup = dev_rate / leg_rate
+    # the CPU-sim acceptance bar holds at the >= 1M-row size on the
+    # production (native) engine — small smoke runs are identity gates
+    # only (fixed dispatch cost dominates them), and the memkv fallback
+    # measures Python store mutation, not the mirror pipeline; the TPU
+    # bar is the same 2x, asserted on-TPU, pending_tpu off it
+    at_acceptance_size = n_version_rows >= 1_000_000 and engine == "native"
+    acceptance_cpu = ("pass" if speedup >= 2.0 and engine == "native" else
+                      ("fail" if at_acceptance_size else
+                       ("memkv_fallback" if engine != "native" else "small_n")))
 
-    # THE PRODUCTION PATH (TpuScanner.compact, storage/tpu/engine.py): the
-    # victim rule runs as a device kernel (pallas on TPU, jnp elsewhere), the
-    # bool mask (1 byte/row) comes back, and the survivor gather + store
-    # deletes run on host arrays.
-    if use_pallas:
-        from kubebrain_tpu.ops import compact_pallas as cpal
-        from kubebrain_tpu.ops import scan_pallas as sp
-
-        revs_u64 = (rh.astype(np.uint64) << np.uint64(32)) | rl.astype(np.uint64)
-        keys_t, rh31, rl31, tomb8, n_real = sp.prepare_blocks(chunks, revs_u64, tomb)
-        ttl8 = np.zeros(keys_t.shape[1], dtype=np.int8)
-        chi31, clo31 = sp.split_revs31(np.array([compact_rev], dtype=np.uint64))
-        lo_bound = sp.pack_bound_flipped(pack_bound(b""))
-        d = [jax.device_put(jnp.asarray(x), dev)
-             for x in (keys_t, rh31, rl31, tomb8, ttl8)]
-        bounds_d = [jax.device_put(jnp.asarray(lo_bound), dev)] * 2
-
-        @jax.jit
-        def mask_step_pallas(kt, a, b, t8, x8, s, e):
-            return cpal.victim_mask_pallas(
-                kt, a, b, t8, x8, np.int32(n_real), s, e, np.int32(1),
-                np.int32(chi31[0]), np.int32(clo31[0]),
-                np.int32(0), np.int32(0),
-                with_ttl=False, interpret=not on_tpu,
-            )
-
-        def device_mask():
-            return mask_step_pallas(*d, *bounds_d)
-    else:
-        d = [jax.device_put(jnp.asarray(x), dev) for x in (chunks, rh, rl, tomb, ttl)]
-        nv = jnp.asarray(np.int32(n))
-        qs = [jnp.asarray(np.uint32(x[0])) for x in (chi, clo, thi, tlo)]
-
-        @jax.jit
-        def mask_step(keys, a, b, t, x, n_valid, c1, c2, t1, t2):
-            return victim_mask(keys, a, b, t, x, n_valid, c1, c2, t1, t2, with_ttl=False)
-
-        def device_mask():
-            return mask_step(*d, nv, *qs)
-
-    # Adaptive two-phase transfer (TpuScanner._pull_victim_mask): count on
-    # device, pull only the smaller index set. Over the axon tunnel this is
-    # the difference between moving the 10MB mask and moving ~360KB of
-    # survivor indices for this dataset (most rows are victims here).
-    # the SAME jitted helpers TpuScanner._pull_victim_mask dispatches (the
-    # engine helpers take [P, N] masks + per-partition n_valid; the bench's
-    # flat mask is one partition)
-    from kubebrain_tpu.storage.tpu.engine import (
-        _indices_of_mask, _pow2_bucket, _survivor_indices, _victim_counts,
-    )
-
-    nv1 = jnp.asarray(np.array([n], dtype=np.int32))
-
-    def compact_production():
-        m = device_mask().reshape(1, -1)
-        vic, _valid = (int(x) for x in jax.device_get(_victim_counts(m, nv1)))
-        survivors = (n - vic) < vic
-        want = (n - vic) if survivors else vic
-        bucket = _pow2_bucket(want, int(m.shape[1]))
-        if survivors:
-            idx = np.asarray(_survivor_indices(m, nv1, size=bucket))[:want]
-        else:
-            idx = np.asarray(_indices_of_mask(m, size=bucket))[:want]
-        if survivors:
-            return (chunks.take(idx, axis=0), rh.take(idx), rl.take(idx),
-                    tomb.take(idx))
-        keep = np.ones(n, dtype=bool)
-        keep[idx] = False
-        return chunks[keep], rh[keep], rl[keep], tomb[keep]
-
-    out = compact_production()
-    kept = len(out[0])
-    lat = []
-    for _ in range(iters):
-        t0 = time.time()
-        compact_production()
-        lat.append(time.time() - t0)
-    p50 = sorted(lat)[len(lat) // 2]
-    rate = n / p50
-
-    # all-device variant (mask + on-device gather; the TPU mirror-shrink
-    # shape that avoids pulling 70B keys to the host) for the record —
-    # row-major device copies + the jnp mask (the gather dominates it; the
-    # kernel choice is the production number above). Reuse the jnp branch's
-    # copies when they exist; only the pallas branch needs fresh ones.
-    if use_pallas:
-        dj = [jax.device_put(jnp.asarray(x), dev) for x in (chunks, rh, rl, tomb, ttl)]
-        nvj = jnp.asarray(np.int32(n))
-        qsj = [jnp.asarray(np.uint32(x[0])) for x in (chi, clo, thi, tlo)]
-    else:
-        dj, nvj, qsj = d, nv, qs
-
-    @jax.jit
-    def compact_all_device(keys, a, b, t, x, n_valid, c1, c2, t1, t2):
-        mask = victim_mask(keys, a, b, t, x, n_valid, c1, c2, t1, t2, with_ttl=False)
-        return compact_block(keys, a, b, t, mask)
-
-    out_dev = compact_all_device(*dj, nvj, *qsj)
-    jax.block_until_ready(out_dev)
-    lat_dev = []
-    for _ in range(max(3, iters // 2)):
-        t0 = time.time()
-        jax.block_until_ready(compact_all_device(*dj, nvj, *qsj))
-        lat_dev.append(time.time() - t0)
-    p50_dev = sorted(lat_dev)[len(lat_dev) // 2]
-    assert int(out_dev[4]) == kept == keep_np, (int(out_dev[4]), kept, keep_np)
-
-    row_bytes = WIDTH + 4 + 4 + 1
+    report = {
+        "schema": "kubebrain-compact/v1",
+        "platform": platform_info(),
+        "keys": n_keys,
+        "rows": n_version_rows,
+        "compact_rows_per_sec": round(dev_rate),
+        "host_rows_per_sec": round(leg_rate),
+        "oracle_rows_per_sec": round(orc_rate),
+        "speedup_vs_host": round(speedup, 3),
+        "compact_seconds": round(dev_dt, 3),
+        "host_seconds": round(leg_dt, 3),
+        "oracle_seconds": round(orc_dt, 3),
+        "victims": {
+            "superseded": dev_stats.deleted_versions,
+            "tombstone": dev_stats.deleted_tombstones,
+            "ttl_expired": dev_stats.expired_ttl,
+            "rev_record": dev_stats.deleted_rev_records,
+        },
+        "survivor_rows": dev_stats.survivor_rows,
+        "dirty_partitions": dev_stats.dirty_partitions,
+        "mirror_path": dev_stats.mirror_path,
+        "phase_seconds": {k: round(v, 4)
+                          for k, v in dev_stats.phase_seconds.items()},
+        "host_phase_seconds": {k: round(v, 4)
+                               for k, v in leg_stats.phase_seconds.items()},
+        "byte_identical_store": True,
+        "byte_identical_serving": True,
+        "full_rebuild_total": dev_sc.full_rebuild_total,
+        "re_dictionary": dev_sc._mirror.encoding is not encoding_before,
+        "kernel": dev_sc._scan_kernel,
+        "engine": engine,
+        # one untimed warm-up pass paid every jit compile before either
+        # timed pass (identical shapes — same dataset)
+        "warmed": True,
+        "acceptance_2x_cpu": acceptance_cpu,
+        "acceptance_2x_tpu": ("pass" if on_tpu and speedup >= 2.0
+                              else "pending_tpu"),
+    }
+    out_path = os.environ.get("KB_COMPACT_OUT")
+    if out_path:
+        with open(out_path, "w") as f:
+            json.dump(report, f, indent=2, sort_keys=True)
+            f.write("\n")
     print(json.dumps({
         "metric": "compaction rows/sec",
-        "value": round(rate),
+        "value": round(dev_rate),
         "unit": "rows/sec",
-        "vs_baseline": round(rate / cpu_rate, 3),
-        "platform": platform_info(),
-        "detail": {
-            "rows": n, "kept": kept,
-            "compact_p50_ms": round(p50 * 1e3, 2),
-            "mb_per_sec": round(rate * row_bytes / 1e6),
-            "all_device_p50_ms": round(p50_dev * 1e3, 2),
-            "all_device_rows_per_sec": round(n / p50_dev),
-            "cpu_numpy_rows_per_sec": round(cpu_rate),
-            "device": str(dev),
-            "kernel": "pallas" if use_pallas else "jnp",
-            "transfer": "two-phase-adaptive",
-        },
+        "vs_baseline": round(speedup, 3),
+        "platform": report["platform"],
+        "detail": {k: v for k, v in report.items()
+                   if k not in ("schema", "platform")},
     }))
+
+    for sc in (dev_sc, leg_sc, orc_sc):
+        sc.close()
+    for st in (dev_store, leg_store, orc_store):
+        st.close()
+    # asserted AFTER the report is emitted so a failing run still leaves
+    # the phase breakdown on record (the nonzero exit fails CI either way)
+    if at_acceptance_size:
+        assert speedup >= 2.0, (
+            f"device compact {dev_rate:.0f} rows/s < 2x host path "
+            f"{leg_rate:.0f} rows/s at acceptance size")
 
 
 def bench_insert() -> None:
@@ -1393,7 +1512,9 @@ def bench_cluster() -> None:
     (simulated seconds), KB_WORKLOAD_SCALE (sim seconds per real second),
     KB_WORKLOAD_STORAGE, KB_WORKLOAD_OUT (report path),
     KB_WORKLOAD_MESH_PART / KB_WORKLOAD_SCAN_PARTITIONS (sharded server,
-    requires KB_WORKLOAD_STORAGE=tpu; docs/multichip.md)."""
+    requires KB_WORKLOAD_STORAGE=tpu; docs/multichip.md),
+    KB_WORKLOAD_COMPACT_S (compaction cadence in simulated seconds —
+    the 5-min-compaction scenario; docs/compaction.md)."""
     from kubebrain_tpu.workload.runner import run_workload
     from kubebrain_tpu.workload.spec import WorkloadSpec
 
@@ -1408,6 +1529,12 @@ def bench_cluster() -> None:
         mesh_part=int(os.environ.get("KB_WORKLOAD_MESH_PART", 0)),
         scan_partitions=int(os.environ.get("KB_WORKLOAD_SCAN_PARTITIONS", 0)),
     )
+    # compaction-cadence knob (SIMULATED seconds; 0 = scenario default) —
+    # `make bench-cluster COMPACT_S=300` drives the 5-min-compaction
+    # scenario with serving-lane SLOs judged while compactions run
+    compact_s = float(os.environ.get("KB_WORKLOAD_COMPACT_S", 0) or 0)
+    if compact_s > 0:
+        common["compact_interval_s"] = compact_s
     if faults and faults != "none":
         # chaos mode (docs/faults.md): churn_heavy traffic under an armed
         # fault schedule; judged by the acknowledged-write consistency

@@ -46,6 +46,20 @@ class CompactStats:
     deleted_tombstones: int = 0
     deleted_rev_records: int = 0
     expired_ttl: int = 0
+    # device-mirror accounting (kubebrain_tpu.storage.tpu; the engine-generic
+    # host path reports mirror_path="host" and leaves the rest zero):
+    # how the mirror absorbed the compaction — "stored_incremental" is the
+    # steady path (survivor gather + k-way stored-domain merge, dirty shards
+    # only), "full_rebuild" the width-drift/dict-overflow fallback,
+    # "superseded" a mirror swapped under the compaction (the fresher mirror
+    # came from the post-GC store), "escalated" the bounded-retry give-up
+    # (mirror quarantined, background rebuild recovering).
+    mirror_path: str = "host"
+    survivor_rows: int = 0
+    dirty_partitions: int = 0
+    #: wall seconds per pipeline phase (mark | gc | merge | publish) —
+    #: the same split kb_compact_seconds{phase=} exports
+    phase_seconds: dict = field(default_factory=dict)
 
 
 @dataclass

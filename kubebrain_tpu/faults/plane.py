@@ -203,6 +203,20 @@ class FaultPlane:
     def note_suppressed_merge(self) -> None:
         self._count(_sched.MERGE_SUPPRESS)
 
+    def compact_fault(self) -> bool:
+        """Fail the compaction's mirror half (stored-domain survivor
+        merge), pre-mutation — the GC deletes stay durable; the engine's
+        bounded retries then re-roll here, and exhausting them must
+        escalate to quarantine + background rebuild (docs/compaction.md)."""
+        t = self._elapsed_ms()
+        if t is None:
+            return False
+        for w in self.schedule.active(t, _sched.COMPACT_FAIL):
+            if self._roll(w.rate):
+                self._count(_sched.COMPACT_FAIL)
+                return True
+        return False
+
     def encode_overflow(self) -> bool:
         t = self._elapsed_ms()
         if t is None:

@@ -35,11 +35,12 @@ CONN_DROP = "conn_drop"                # RPC aborted as if the conn dropped
 MERGE_FAIL = "merge_fail"              # background delta merge raises
 MERGE_SUPPRESS = "merge_suppress"      # merges suppressed: delta overlay grows
 ENCODE_OVERFLOW = "encode_overflow"    # forced EncodeOverflow -> re-dictionary
+COMPACT_FAIL = "compact_fail"          # compaction's mirror merge raises
 
 ALL_KINDS = (
     STORAGE_LATENCY, STORAGE_ERROR, STORAGE_UNCERTAIN,
     WATCH_RESET, CONN_DROP,
-    MERGE_FAIL, MERGE_SUPPRESS, ENCODE_OVERFLOW,
+    MERGE_FAIL, MERGE_SUPPRESS, ENCODE_OVERFLOW, COMPACT_FAIL,
 )
 
 #: kinds that fire at the storage write boundary
@@ -167,6 +168,12 @@ def generate(preset: str, seed: int, horizon_s: float) -> FaultSchedule:
         # replay are kernel-compile stall (no engine writes to overflow)
         windows += _spread(rng, horizon_ms, ENCODE_OVERFLOW,
                            1, 0.3, 0.5 if heavy else 0.25, lo=0.2, hi=0.9)
+        # compaction is CLIENT-cadenced (the workload's COMPACT ops), so
+        # the window is laid wide at rate 1.0: any compaction landing in
+        # ~80% of the horizon exercises the mirror-half's retry/backoff →
+        # quarantine+rebuild escalation path (docs/compaction.md)
+        windows += _spread(rng, horizon_ms, COMPACT_FAIL,
+                           1, 0.8, 1.0, lo=0.05, hi=0.95)
     # canonical order: by (t0, kind) so generation insertion order can't
     # leak into the trace identity
     windows.sort(key=lambda w: (w.t0_ms, w.kind, w.t1_ms))

@@ -553,6 +553,90 @@ def merge_partitions_stored(
     )
 
 
+def compact_partitions_stored(
+    mirror: Mirror,
+    keep_idx: dict[int, np.ndarray],  # dirty partition -> sorted survivor rows
+    mesh,
+    snapshot_ts: int,
+) -> Mirror | None:
+    """Shrink the mirror to the compaction survivors WITHOUT leaving the
+    stored domain — the mirror half of the device-side compaction pipeline
+    (docs/compaction.md).
+
+    ``keep_idx`` names only the DIRTY partitions (those with >= 1 victim);
+    each maps to the ascending row indices that survive. Survivors are
+    gathered as stored rows — ``(code, suffix)`` key bytes, host TTL
+    column, value-arena gather — so the steady compaction path performs no
+    key decode, no re-encode, and no re-dictionary: partition borders and
+    the published :class:`~.encode.KeyEncoding` are carried over unchanged,
+    and only dirty shards republish (:func:`_assemble_sharded`). A pending
+    write delta then lands through the ordinary
+    :func:`merge_partitions_stored` against the compacted mirror.
+
+    Returns None only for a pre-``ttl_host`` mirror (nothing to gather the
+    TTL flags from) — the caller falls back to the full host rebuild.
+    Shrinking can never overflow a partition's padded capacity."""
+    if not keep_idx:
+        return mirror
+    if mirror.ttl_host is None:
+        return None
+    P = mirror.partitions
+    cap = mirror.keys_host.shape[1]
+
+    # copy-on-write: readers hold the old Mirror object
+    keys_h = mirror.keys_host.copy()
+    lens_h = mirror.lens_host.copy()
+    revs_h = mirror.revs_host.copy()
+    tomb_h = mirror.tomb_host.copy()
+    ttl_h = mirror.ttl_host.copy()
+    n_valid = mirror.n_valid.copy()
+    arenas = list(mirror.val_arena)
+    offs = list(mirror.val_offsets)
+
+    for p, keep in keep_idx.items():
+        nv = int(n_valid[p])
+        keep = np.asarray(keep, dtype=np.int64)
+        mn = len(keep)
+        keys_h[p, :mn] = mirror.keys_host[p][keep]
+        lens_h[p, :mn] = mirror.lens_host[p][keep]
+        revs_h[p, :mn] = mirror.revs_host[p][keep]
+        tomb_h[p, :mn] = mirror.tomb_host[p][keep]
+        ttl_h[p, :mn] = mirror.ttl_host[p][keep]
+        # zero the vacated tail: stale rows beyond n_valid are kernel-masked
+        # but must not survive as garbage into later capacity-grow memcpys
+        keys_h[p, mn:nv] = 0
+        lens_h[p, mn:nv] = 0
+        revs_h[p, mn:nv] = 0
+        tomb_h[p, mn:nv] = False
+        ttl_h[p, mn:nv] = False
+        n_valid[p] = mn
+        arenas[p], offs[p] = keyops.gather_arena(
+            mirror.val_arena[p], mirror.val_offsets[p][: nv + 1], keep)
+
+    rh_all, rl_all = keyops.split_revs(revs_h.reshape(-1))
+    rh_all = rh_all.reshape(P, cap)
+    rl_all = rl_all.reshape(P, cap)
+
+    ds = set(keep_idx)
+    return Mirror(
+        keys_dev=_assemble_sharded(mesh, keys_h, mirror.keys_dev, ds),
+        rh_dev=_assemble_sharded(mesh, rh_all, mirror.rh_dev, ds),
+        rl_dev=_assemble_sharded(mesh, rl_all, mirror.rl_dev, ds),
+        tomb_dev=_assemble_sharded(mesh, tomb_h, mirror.tomb_dev, ds),
+        ttl_dev=_assemble_sharded(mesh, ttl_h, mirror.ttl_dev, ds),
+        n_valid_dev=(
+            jax.device_put(n_valid) if mesh is None
+            else jax.device_put(
+                n_valid, NamedSharding(mesh, PartitionSpec("part")))
+        ),
+        keys_host=keys_h, lens_host=lens_h, revs_host=revs_h, tomb_host=tomb_h,
+        n_valid=n_valid, val_arena=arenas, val_offsets=offs,
+        snapshot_ts=snapshot_ts,
+        max_rev=mirror.max_rev,
+        key_width=mirror.key_width, encoding=mirror.encoding, ttl_host=ttl_h,
+    )
+
+
 def merge_partitions_incremental(
     mirror: Mirror,
     delta,  # sorted row-array sextuple (keys_u8, lens, revs, tomb, arena, offsets)

@@ -44,6 +44,7 @@ from .blocks import (
     Mirror,
     build_mirror,
     build_mirror_from_arrays,
+    compact_partitions_stored,
     compute_ttl_flags,
     merge_partitions_incremental,  # noqa: F401  (raw-domain path, tests/compat)
     merge_partitions_stored,
@@ -248,19 +249,6 @@ def _vis_batch_pallas_q(keys_t, rh31, rl31, tomb8, nv, starts, ends, unbs,
     return mask, jnp.sum(mask, axis=2, dtype=jnp.int32)
 
 
-@functools.partial(jax.jit, static_argnames=("size",))
-def _indices_of_mask(mask, size):
-    """Flat indices (p*N + row) of visible rows, device-compacted so the
-    host transfer is O(results), not O(rows). ``size`` buckets to a power of
-    two to bound recompiles. Compaction-path only (`_pull_victim_mask`):
-    the GLOBAL nonzero forces a cross-shard gather on a multi-device mesh,
-    so the serving scan path uses the shard-local `_part_indices_of_mask`
-    instead."""
-    flat = mask.reshape(-1)
-    (idx,) = jnp.nonzero(flat, size=size, fill_value=flat.shape[0])
-    return idx
-
-
 @functools.partial(jax.jit, static_argnames=("size", "mesh"))
 def _part_indices_of_mask(mask, size, mesh=None):
     """Per-partition compacted row indices [P, size] (fill = N) of a
@@ -351,23 +339,33 @@ def _host_pull(x) -> np.ndarray:
 
 
 @jax.jit
-def _victim_counts(mask, nv):
-    """(victims, valid rows) as two device scalars — the host reads 8 bytes
-    to decide which index set (victims or survivors) is cheaper to pull."""
+def _victim_part_counts(mask, nv):
+    """Per-partition (victims [P], valid [P]) as two small device vectors —
+    the host reads 8·P bytes to size the index pull and to decide which
+    index set (victims or survivors) is cheaper to transfer. Elementwise +
+    per-partition reduction: GSPMD keeps the ``part`` axis sharded."""
     valid = jnp.arange(mask.shape[-1], dtype=jnp.int32)[None, :] < nv[:, None]
-    return jnp.sum(mask, dtype=jnp.int32), jnp.sum(valid, dtype=jnp.int32)
+    return (jnp.sum(mask, axis=1, dtype=jnp.int32),
+            jnp.sum(valid, axis=1, dtype=jnp.int32))
 
 
-@functools.partial(jax.jit, static_argnames=("size",))
-def _survivor_indices(mask, nv, size):
-    """Flat indices of valid non-victim rows, device-compacted like
-    ``_indices_of_mask`` (which serves the victim side directly — the victim
-    kernels already gate validity; only the survivor complement needs the
-    explicit ``valid`` conjunction)."""
-    valid = jnp.arange(mask.shape[-1], dtype=jnp.int32)[None, :] < nv[:, None]
-    flat = (valid & ~mask).reshape(-1)
-    (idx,) = jnp.nonzero(flat, size=size, fill_value=flat.shape[0])
-    return idx
+@functools.partial(jax.jit, static_argnames=("size", "mesh"))
+def _part_survivor_indices(mask, nv, size, mesh=None):
+    """Per-partition compacted SURVIVOR row indices [P, size] (fill = N) of
+    a victim mask [P, N] — the compaction twin of `_part_indices_of_mask`
+    (which serves the victim side directly: the victim kernels already gate
+    validity; only the survivor complement needs the explicit ``valid``
+    conjunction). Shard-local along ``part``: a multi-device mesh never
+    all-gathers the mask, and the host pull is O(survivors per shard)."""
+    def local(m, n):
+        valid = jnp.arange(m.shape[-1], dtype=jnp.int32)[None, :] < n[:, None]
+        keep = valid & ~m
+        per_row = lambda row: jnp.nonzero(
+            row, size=size, fill_value=row.shape[0])[0]
+        return jax.vmap(per_row)(keep)
+
+    f = _maybe_shard_map(local, mesh, n_part_args=2)
+    return f(mask, nv)
 
 
 def _resolve_key_encoding(encode_keys: bool | None) -> bool:
@@ -510,6 +508,28 @@ class TpuScanner(Scanner):
         self.merge_retries_total = 0
         self.merge_escalations_total = 0
         self._merge_max_retries = 4
+        # compaction accounting (docs/compaction.md; also exported through
+        # encoding_stats() and the kb_compact_* metrics): the bench compact
+        # phase asserts full_rebuild_total stays flat while compact_count
+        # advances — the steady path never decodes/re-encodes the keyspace
+        self.compact_count = 0
+        self.compact_victims_total = 0
+        self.compact_survivor_rows_total = 0
+        self.compact_retries_total = 0
+        self.compact_escalations_total = 0
+        self.compact_errors = 0
+        self._compact_last_error: Exception | None = None
+        # bench/legacy comparator (make bench-compact): force the mirror
+        # half onto the decode-everything full-rebuild rung — the
+        # pre-stored-domain compact shape — so the stored-domain win is
+        # measurable on identical marking + GC work. Never set in serving.
+        self.compact_force_full = False
+        # True while a compaction holds _merge_lock across its whole pass
+        # (mark → gc → mirror apply): read-path threshold merges SKIP
+        # instead of blocking on the lock for the compact's duration —
+        # mirror+overlay stays exact, and the post-compact kick sweeps
+        # the delta. Guarded by _mlock.
+        self._compact_active = False
         # mirror degradation state machine (docs/faults.md): a poisoned
         # (uncertain) mirror QUARANTINES — reads serve from the host store,
         # byte-identical by construction, while a single-flight background
@@ -610,6 +630,15 @@ class TpuScanner(Scanner):
                              if mirror.encoding is not None else 0),
             "suffix_width": (mirror.encoding.suffix_width
                              if mirror.encoding is not None else 0),
+            # compaction accounting (docs/compaction.md): steady-state
+            # compaction must advance compact_count with full_rebuild_total
+            # flat — every pass stayed in the stored domain
+            "compact_count": self.compact_count,
+            "compact_victims_total": self.compact_victims_total,
+            "compact_survivor_rows_total": self.compact_survivor_rows_total,
+            "compact_retries_total": self.compact_retries_total,
+            "compact_escalations_total": self.compact_escalations_total,
+            "full_rebuild_total": self.full_rebuild_total,
         }
 
     # ---------------------------------------------------------- degradation
@@ -843,6 +872,12 @@ class TpuScanner(Scanner):
             want_merge = (self._delta
                           and (full or len(self._delta) >= self._merge_threshold))
             if not want_merge:
+                return
+            if not full and self._compact_active:
+                # a compaction holds _merge_lock for its whole pass:
+                # serve mirror+overlay (exact) instead of parking this
+                # reader on the lock; the compaction's own apply merges
+                # the sealed delta prefix anyway
                 return
         if not full and plane is not None and plane.merges_suppressed():
             # chaos: serve mirror+overlay (the overlay stays exact); each
@@ -1512,46 +1547,89 @@ class TpuScanner(Scanner):
         return p
 
     # -------------------------------------------------------------- compact
-    def _pull_victim_mask(self, mask_dev, mirror) -> np.ndarray:
-        """Host bool victim mask via the adaptive two-phase transfer: read
-        two device scalars (victims, valid), then pull only the SMALLER
-        index set — victim indices on an incremental compact (few victims),
-        survivor indices on a bulk one (few survivors) — and rebuild the
-        mask locally. Over the axon tunnel the full [P, N] byte mask
-        dominates compaction latency (docs/bench_results_tpu.md: 429ms ->
-        286ms); the wire should carry victim identities, not the keyspace
-        (reference deletes victims by key batch, scanner.go:445-491)."""
-        nv_dev = mirror.n_valid_dev
-        vic, valid = (int(x) for x in jax.device_get(_victim_counts(mask_dev, nv_dev)))
-        shape = mask_dev.shape
-        n_flat = int(np.prod(shape))
-        survivors = (valid - vic) < vic
-        want = (valid - vic) if survivors else vic
-        bucket = _pow2_bucket(want, n_flat)
-        if survivors:
-            idx = np.asarray(_survivor_indices(mask_dev, nv_dev, size=bucket))[:want]
+    def _pull_victim_indices(self, mask_dev, mirror) -> dict[int, np.ndarray]:
+        """Per-partition victim row indices via the adaptive SHARD-LOCAL
+        two-phase transfer — the compact analogue of
+        :meth:`_dev_visible_indices` and a named KB111 materialization
+        funnel. Phase one pulls the per-partition (victims, valid) counts
+        (8·P bytes); phase two pulls only the SMALLER index set — victim
+        indices on an incremental compact (few victims), survivor indices
+        on a bulk one (few survivors) — as a [P, pow2(max per-partition
+        count)] block compacted INSIDE each shard (`_part_indices_of_mask`
+        / `_part_survivor_indices`: no cross-device mask gather on a
+        multi-device mesh), rebuilding the complement host-locally. The
+        [P, N] byte mask crosses the wire only when the index block would
+        be WIDER than the mask itself (victims AND survivors both dense —
+        then the mask is the cheaper format, and pulling it is not
+        avoidable). Over the axon tunnel the full mask otherwise dominates
+        compaction latency (docs/bench_results_tpu.md: 429ms -> 286ms);
+        the wire should carry victim identities, not the keyspace
+        (reference deletes victims by key batch, scanner.go:445-491).
+
+        Returns ``{partition -> ascending victim row indices}`` covering
+        exactly the partitions with >= 1 victim."""
+        n_rows = int(mask_dev.shape[-1])
+        vic_dev, valid_dev = _victim_part_counts(mask_dev, mirror.n_valid_dev)
+        vic_h = _host_pull(vic_dev)
+        valid_h = _host_pull(valid_dev)
+        total_vic = int(vic_h.sum())
+        if total_vic == 0:
+            return {}
+        surv_h = valid_h - vic_h
+        use_survivors = int(surv_h.sum()) < total_vic
+        want = int(surv_h.max()) if use_survivors else int(vic_h.max())
+        size = _pow2_bucket(want, n_rows)
+        out: dict[int, np.ndarray] = {}
+        if size * 8 > n_rows:
+            # dense on both sides: index words would out-weigh the byte
+            # mask, so the mask IS the minimal wire format here
+            mask_h = _host_pull(mask_dev).astype(bool)
+            for p in np.nonzero(vic_h)[0]:
+                p = int(p)
+                out[p] = np.nonzero(mask_h[p, : int(valid_h[p])])[0]
+            return out
+        if use_survivors:
+            idx = _host_pull(_part_survivor_indices(
+                mask_dev, mirror.n_valid_dev, size=size, mesh=self._mesh))
+            for p in np.nonzero(vic_h)[0]:
+                p = int(p)
+                pmask = np.ones(int(valid_h[p]), dtype=bool)
+                pmask[idx[p, : int(surv_h[p])].astype(np.int64)] = False
+                out[p] = np.nonzero(pmask)[0]
         else:
-            idx = np.asarray(_indices_of_mask(mask_dev, size=bucket))[:want]
-        if not survivors:
-            mask = np.zeros(n_flat, dtype=bool)
-            mask[idx] = True
-            return mask.reshape(shape)
-        # victims = valid & ~survivor
-        mask = np.arange(shape[-1], dtype=np.int64)[None, :] < np.asarray(
-            mirror.n_valid
-        )[:, None]
-        flat = mask.reshape(-1)
-        flat[idx] = False
-        return flat.reshape(shape)
+            idx = _host_pull(_part_indices_of_mask(
+                mask_dev, size=size, mesh=self._mesh))
+            for p in np.nonzero(vic_h)[0]:
+                p = int(p)
+                out[p] = idx[p, : int(vic_h[p])].astype(np.int64)
+        return out
+
+    def _compact_victim_rows(self, mirror: Mirror, p: int, rows: np.ndarray):
+        """THE victim-only decode point (kblint KB116): raw key bytes for
+        exactly the rows compaction is about to delete from the store (the
+        engine speaks raw keys) — never a whole partition. Everything else
+        the compaction pipeline touches stays in the stored domain."""
+        k_u8, lens = mirror.decoded_keys(p, rows)
+        return k_u8, np.asarray(lens, np.int32)
 
     def compact(self, start: bytes, end: bytes, compact_revision: int) -> CompactStats:
-        """Device-side victim marking + host deletes (the north-star
-        compaction path). ``start``/``end`` are internal-key borders from the
-        backend (compact.go:107-126); rev-record GC and TTL bookkeeping
-        follow the generic scanner's rules."""
+        """Device-side victim marking → victim-only host GC → stored-domain
+        survivor merge, off the engine lock (docs/compaction.md — the
+        north-star "pmap'd compact/GC merge"). ``start``/``end`` are
+        internal-key borders from the backend (compact.go:107-126);
+        rev-record GC and TTL bookkeeping follow the generic scanner's
+        rules, and the store-side deletes are semantically unchanged — only
+        the mirror half moved into the stored domain: raw key bytes are
+        materialized for VICTIM rows alone (`_compact_victim_rows`),
+        survivors are gathered as stored ``(code, suffix)`` blocks and
+        k-way merged with any pending delta
+        (:func:`blocks.compact_partitions_stored` +
+        :func:`blocks.merge_sorted_stored`), republishing only dirty
+        shards. No re-encode, no re-dictionary, no re-partition on the
+        steady path; ``_mlock`` is held only for the snapshot and the swap,
+        so readers keep serving mirror+overlay throughout, with the
+        delta-merge retry/backoff → escalate discipline on failure."""
         self._ensure_published(full=True)
-        with self._mlock:
-            mirror = self._mirror
         # bypass the delta tracker for our own GC deletes — compact updates
         # the mirror itself at the end
         store = getattr(self._store, "untracked", self._store.exclusive_client)()
@@ -1562,185 +1640,367 @@ class TpuScanner(Scanner):
 
             ttl_cutoff = self.compact_history.timeout_revision(EVENTS_TTL_SECONDS)
 
-        # internal borders → user-key bounds for the kernels
-        s_user = coder.decode(start)[0] if coder.is_internal_key(start) else b""
-        unbounded = not coder.is_internal_key(end)
-        e_user = b"" if unbounded else coder.decode(end)[0]
-        s, e, unb = self._query_bounds(mirror, s_user, e_user)
-        chi, clo = keyops.split_revs(np.array([compact_revision], dtype=np.uint64))
-        thi, tlo = keyops.split_revs(np.array([ttl_cutoff], dtype=np.uint64))
-        if self._scan_kernel == "jnp":
-            mask_dev = _victim_batch(
-                mirror.keys_dev, mirror.rh_dev, mirror.rl_dev, mirror.tomb_dev,
-                mirror.ttl_dev, mirror.n_valid_dev, s, e, unb,
-                jnp.asarray(chi[0]), jnp.asarray(clo[0]),
-                jnp.asarray(thi[0]), jnp.asarray(tlo[0]),
-                with_ttl=ttl_cutoff > 0,
-            )
-        else:
-            kt, rh31, rl31, t8, _n = self._pallas_layout(mirror)
-            ttl8 = self._pallas_ttl8(mirror, kt.shape[2])
-            mask_dev = _victim_batch_pallas(
-                kt, rh31, rl31, t8, ttl8, mirror.n_valid_dev, s, e, unb,
-                jnp.asarray(chi[0]), jnp.asarray(clo[0]),
-                jnp.asarray(thi[0]), jnp.asarray(tlo[0]),
-                with_ttl=ttl_cutoff > 0,
-                interpret=(self._scan_kernel == "pallas_interpret"),
-                mesh=self._kernel_mesh,
-            )  # padded cols are never victims (valid=False); mask[p][:nv] below
-        mask = self._pull_victim_mask(mask_dev, mirror)
-
-        stats = CompactStats(scanned=mirror.rows)
-        retry_min = self._retry_min_revision()
-        bulk = getattr(store, "bulk_gc", None)
-        BATCH = 256
-        pending: list[bytes] = []
-        bulk_victims: list[tuple[np.ndarray, np.ndarray, np.ndarray]] = []
-        bulk_recs: list[tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]] = []
-        surviving_parts = []
-        for p in range(mirror.partitions):
-            nv = int(mirror.n_valid[p])
-            if nv == 0:
-                continue
-            pmask = mask[p][:nv]
-            keys_p = mirror.keys_host[p, :nv]
-            # RAW key bytes: the store deletes below and the surviving-row
-            # rebuild both speak raw; version-chain grouping stays on the
-            # stored rows (encoded equality == raw equality — injective)
-            k_u8_all, lens_all = mirror.decoded_keys(p, np.arange(nv))
-            lens_all = np.asarray(lens_all, np.int32)
-            revs_all = mirror.revs_host[p, :nv]
-            tomb_all = mirror.tomb_host[p, :nv]
-            # group structure (one group = one user key's version chain)
-            same_prev = np.zeros(nv, dtype=bool)
-            same_prev[1:] = (keys_p[1:] == keys_p[:-1]).all(axis=1)
-            group_starts = np.nonzero(~same_prev)[0]
-            group_ends = np.append(group_starts[1:], nv)
-            group_sizes = group_ends - group_starts
-            doomed_per_group = np.add.reduceat(pmask.astype(np.int64), group_starts)
-            last_idx = group_ends - 1
-            gid = np.cumsum(~same_prev) - 1  # group id per row
-
-            # victim stats, fully vectorized (no per-row Python;
-            # VERDICT r1 weak #3: 1M-victim sweeps must not loop)
-            victims = np.nonzero(pmask)[0]
-            v_tomb = tomb_all[victims].astype(bool)
-            v_is_last = victims == last_idx[gid[victims]]
-            stats.deleted_tombstones += int(v_tomb.sum())
-            stats.deleted_versions += int((~v_tomb & ~v_is_last).sum())
-            stats.expired_ttl += int((~v_tomb & v_is_last).sum())
-
-            # rev-record GC candidates: fully-doomed groups whose last
-            # revision is below the uncertain-retry fence (scanner.go:472-491)
-            dg = np.nonzero(doomed_per_group == group_sizes)[0]
-            if len(dg):
-                d_last = last_idx[dg]
-                d_rev = revs_all[d_last].astype(np.uint64)
-                if retry_min:
-                    ok = d_rev < np.uint64(retry_min)
-                    dg, d_last, d_rev = dg[ok], d_last[ok], d_rev[ok]
-            else:
-                d_last = np.empty(0, dtype=np.int64)
-                d_rev = np.empty(0, dtype=np.uint64)
-
-            if bulk is not None:
-                bulk_victims.append((
-                    k_u8_all[victims], lens_all[victims],
-                    revs_all[victims].astype(np.uint64),
-                ))
-                firsts = group_starts[dg]
-                bulk_recs.append((
-                    k_u8_all[firsts], lens_all[firsts], d_rev,
-                    tomb_all[d_last].astype(np.uint8),
-                ))
-            else:
-                # k_u8_all/lens_all already hold the decoded partition —
-                # slice them instead of re-decoding one row at a time
-                # through mirror.user_key
-                for i in victims:
-                    i = int(i)
-                    uk = k_u8_all[i, : int(lens_all[i])].tobytes()
-                    pending.append(
-                        coder.encode_object_key(uk, int(revs_all[i]))
+        phases: dict[str, float] = {}
+        applied = False
+        superseded = False
+        # the WHOLE pass holds _merge_lock: a routine write-kicked delta
+        # merge can no longer swap the mirror mid-compaction (which would
+        # supersede — and hence quarantine+rebuild — EVERY compaction
+        # under ordinary write load). Readers never park on this lock:
+        # read-path threshold merges SKIP while _compact_active (the
+        # overlay stays exact) and the background merge thread simply
+        # waits its single-flight turn. Only an uncertainty rebuild
+        # (_force_rebuild under _mlock) can still supersede — the rare
+        # case the quarantine handling below exists for.
+        with self._merge_lock:
+            with self._mlock:
+                mirror = self._mirror
+                self._compact_active = True
+            try:
+                t0 = time.monotonic()
+                # internal borders → user-key bounds for the kernels
+                s_user = coder.decode(start)[0] if coder.is_internal_key(start) else b""
+                unbounded = not coder.is_internal_key(end)
+                e_user = b"" if unbounded else coder.decode(end)[0]
+                s, e, unb = self._query_bounds(mirror, s_user, e_user)
+                chi, clo = keyops.split_revs(np.array([compact_revision], dtype=np.uint64))
+                thi, tlo = keyops.split_revs(np.array([ttl_cutoff], dtype=np.uint64))
+                if self._scan_kernel == "jnp":
+                    mask_dev = _victim_batch(
+                        mirror.keys_dev, mirror.rh_dev, mirror.rl_dev, mirror.tomb_dev,
+                        mirror.ttl_dev, mirror.n_valid_dev, s, e, unb,
+                        jnp.asarray(chi[0]), jnp.asarray(clo[0]),
+                        jnp.asarray(thi[0]), jnp.asarray(tlo[0]),
+                        with_ttl=ttl_cutoff > 0,
                     )
-                for j, g in enumerate(dg):
-                    li = int(d_last[j])
-                    raw = coder.encode_rev_value(
-                        int(d_rev[j]), deleted=bool(tomb_all[li])
-                    )
-                    fi = int(group_starts[int(g)])
-                    uk = k_u8_all[fi, : int(lens_all[fi])].tobytes()
-                    try:
-                        store.del_current(coder.encode_revision_key(uk), raw)
-                        stats.deleted_rev_records += 1
-                    except CASFailedError:
-                        pass  # rewritten since the mirror snapshot
-
-            # surviving rows as arrays (numpy gather — no Python objects)
-            keep = np.nonzero(~pmask)[0]
-            k_u8 = k_u8_all[keep]
-            arena_p, off_p = keyops.gather_arena(
-                mirror.val_arena[p], mirror.val_offsets[p][: nv + 1], keep
-            )
-            surviving_parts.append((
-                k_u8, lens_all[keep], revs_all[keep], tomb_all[keep],
-                arena_p, off_p,
-            ))
-        if bulk is not None and bulk_victims:
-            # victims and recs are appended together, once per partition
-            vk, vl, vr = (np.concatenate([b[i] for b in bulk_victims]) for i in range(3))
-            rk, rl, rr, rt = (np.concatenate([b[i] for b in bulk_recs]) for i in range(4))
-            stats.deleted_rev_records += bulk(vk, vl, vr, rk, rl, rr, rt)
-        for b0 in range(0, len(pending), BATCH):
-            batch = store.begin_batch_write()
-            for k in pending[b0 : b0 + BATCH]:
-                batch.delete(k)
-            batch.commit()
-
-        # engine-level history pruning (see generic scanner): free version
-        # chains the logical GC deletes above made unreachable
-        pruner = getattr(store, "prune_versions", None)
-        if pruner is not None:
-            pruner(store.get_timestamp_oracle())
-
-        # shrink the mirror in place from the surviving rows + any delta
-        with self._mlock:
-            if self._mirror is mirror:
-                empty = rows_to_arrays([], self._kw)
-                # surviving parts are already in global sorted order:
-                # concatenate columns and rebuild the arena offsets
-                if surviving_parts:
-                    keys_u8 = np.concatenate([sp[0] for sp in surviving_parts])
-                    lens = np.concatenate([sp[1] for sp in surviving_parts])
-                    revs = np.concatenate([sp[2] for sp in surviving_parts])
-                    tombs = np.concatenate([sp[3] for sp in surviving_parts])
-                    arena = np.concatenate([sp[4] for sp in surviving_parts])
-                    row_lens = np.concatenate([
-                        sp[5].astype(np.int64)[1:] - sp[5].astype(np.int64)[:-1]
-                        for sp in surviving_parts
-                    ])
-                    offsets = np.zeros(len(row_lens) + 1, dtype=np.uint64)
-                    offsets[1:] = np.cumsum(row_lens).astype(np.uint64)
-                    surv = (keys_u8, lens, revs, tombs, arena, offsets)
                 else:
-                    surv = empty
-                merged = merge_sorted_arrays(
-                    surv, rows_to_arrays(self._delta.rows(), self._kw)
-                )
-                self._mirror = build_mirror_from_arrays(
-                    *merged, self._mesh, self._kw,
-                    self._store.get_timestamp_oracle(),
-                    n_parts=self._partitions or None, encode=self._encode,
-                )
-                # bind the fresh delta to the NEW mirror's stored domain —
-                # a bare _DeltaIndex() would seal raw default-width blocks
-                # that fail merge_partitions_stored's width check, forcing a
-                # full rebuild on the first post-compact merge
+                    kt, rh31, rl31, t8, _n = self._pallas_layout(mirror)
+                    ttl8 = self._pallas_ttl8(mirror, kt.shape[2])
+                    mask_dev = _victim_batch_pallas(
+                        kt, rh31, rl31, t8, ttl8, mirror.n_valid_dev, s, e, unb,
+                        jnp.asarray(chi[0]), jnp.asarray(clo[0]),
+                        jnp.asarray(thi[0]), jnp.asarray(tlo[0]),
+                        with_ttl=ttl_cutoff > 0,
+                        interpret=(self._scan_kernel == "pallas_interpret"),
+                        mesh=self._kernel_mesh,
+                    )  # padded cols are never victims (valid=False)
+                victims_by_part = self._pull_victim_indices(mask_dev, mirror)
+                phases["mark"] = time.monotonic() - t0
+
+                t0 = time.monotonic()
+                stats = CompactStats(scanned=mirror.rows, mirror_path="none",
+                                     phase_seconds=phases)
+                retry_min = self._retry_min_revision()
+                bulk = getattr(store, "bulk_gc", None)
+                BATCH = 256
+                pending: list[bytes] = []
+                bulk_victims: list[tuple[np.ndarray, np.ndarray, np.ndarray]] = []
+                bulk_recs: list[tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]] = []
+                keep_idx: dict[int, np.ndarray] = {}
+                for p in sorted(victims_by_part):
+                    victims = victims_by_part[p]
+                    nv = int(mirror.n_valid[p])
+                    pmask = np.zeros(nv, dtype=bool)
+                    pmask[victims] = True
+                    keys_p = mirror.keys_host[p, :nv]
+                    revs_all = mirror.revs_host[p, :nv]
+                    tomb_all = mirror.tomb_host[p, :nv]
+                    # group structure (one group = one user key's version chain),
+                    # computed on the STORED rows — encoded equality == raw
+                    # equality (the encoding is injective), so no decode here
+                    same_prev = np.zeros(nv, dtype=bool)
+                    same_prev[1:] = (keys_p[1:] == keys_p[:-1]).all(axis=1)
+                    group_starts = np.nonzero(~same_prev)[0]
+                    group_ends = np.append(group_starts[1:], nv)
+                    group_sizes = group_ends - group_starts
+                    doomed_per_group = np.add.reduceat(pmask.astype(np.int64), group_starts)
+                    last_idx = group_ends - 1
+                    gid = np.cumsum(~same_prev) - 1  # group id per row
+
+                    # victim stats, fully vectorized (no per-row Python;
+                    # VERDICT r1 weak #3: 1M-victim sweeps must not loop)
+                    v_tomb = tomb_all[victims].astype(bool)
+                    v_is_last = victims == last_idx[gid[victims]]
+                    stats.deleted_tombstones += int(v_tomb.sum())
+                    stats.deleted_versions += int((~v_tomb & ~v_is_last).sum())
+                    stats.expired_ttl += int((~v_tomb & v_is_last).sum())
+
+                    # rev-record GC candidates: fully-doomed groups whose last
+                    # revision is below the uncertain-retry fence (scanner.go:472-491)
+                    dg = np.nonzero(doomed_per_group == group_sizes)[0]
+                    if len(dg):
+                        d_last = last_idx[dg]
+                        d_rev = revs_all[d_last].astype(np.uint64)
+                        if retry_min:
+                            ok = d_rev < np.uint64(retry_min)
+                            dg, d_last, d_rev = dg[ok], d_last[ok], d_rev[ok]
+                    else:
+                        d_last = np.empty(0, dtype=np.int64)
+                        d_rev = np.empty(0, dtype=np.uint64)
+
+                    # victim-ONLY decode: the rows the store deletes below. A
+                    # fully-doomed group's first row (the rev-record GC key) is
+                    # itself a victim, so the decoded set already covers it.
+                    k_u8_v, lens_v = self._compact_victim_rows(mirror, p, victims)
+                    firsts = group_starts[dg]
+                    f_pos = np.searchsorted(victims, firsts)
+
+                    if bulk is not None:
+                        bulk_victims.append((
+                            k_u8_v, lens_v, revs_all[victims].astype(np.uint64),
+                        ))
+                        bulk_recs.append((
+                            k_u8_v[f_pos], lens_v[f_pos], d_rev,
+                            tomb_all[d_last].astype(np.uint8),
+                        ))
+                    else:
+                        # k_u8_v/lens_v hold the decoded victims — slice them
+                        # instead of decoding one row at a time via mirror.user_key
+                        for j, i in enumerate(victims):
+                            uk = k_u8_v[j, : int(lens_v[j])].tobytes()
+                            pending.append(
+                                coder.encode_object_key(uk, int(revs_all[int(i)]))
+                            )
+                        for j in range(len(dg)):
+                            li = int(d_last[j])
+                            raw = coder.encode_rev_value(
+                                int(d_rev[j]), deleted=bool(tomb_all[li])
+                            )
+                            fj = int(f_pos[j])
+                            uk = k_u8_v[fj, : int(lens_v[fj])].tobytes()
+                            try:
+                                store.del_current(coder.encode_revision_key(uk), raw)
+                                stats.deleted_rev_records += 1
+                            except CASFailedError:
+                                pass  # rewritten since the mirror snapshot
+
+                    keep_idx[p] = np.nonzero(~pmask)[0]
+                if bulk is not None and bulk_victims:
+                    # victims and recs are appended together, once per partition
+                    vk, vl, vr = (np.concatenate([b[i] for b in bulk_victims]) for i in range(3))
+                    rk, rl, rr, rt = (np.concatenate([b[i] for b in bulk_recs]) for i in range(4))
+                    stats.deleted_rev_records += bulk(vk, vl, vr, rk, rl, rr, rt)
+                for b0 in range(0, len(pending), BATCH):
+                    batch = store.begin_batch_write()
+                    for k in pending[b0 : b0 + BATCH]:
+                        batch.delete(k)
+                    batch.commit()
+
+                # engine-level history pruning (see generic scanner): free version
+                # chains the logical GC deletes above made unreachable
+                pruner = getattr(store, "prune_versions", None)
+                if pruner is not None:
+                    pruner(store.get_timestamp_oracle())
+                phases["gc"] = time.monotonic() - t0
+
+                n_victims = sum(len(v) for v in victims_by_part.values())
+                stats.survivor_rows = mirror.rows - n_victims
+                stats.dirty_partitions = len(keep_idx)
+
+                # mirror half, first attempt — still under the pass's
+                # merge lock (_mlock only for snapshot + swap)
+                try:
+                    superseded = self._compact_apply_locked(
+                        mirror, keep_idx, stats, phases)
+                    applied = True
+                except Exception as e:
+                    self.compact_errors += 1
+                    self._compact_last_error = e
+                    if self._metrics is not None:
+                        self._metrics.emit_counter("kb.compact.errors", 1)
+            finally:
+                with self._mlock:
+                    self._compact_active = False
+        if superseded:
+            self._quarantine_superseded_compact(stats)
+        elif not applied:
+            # attempts 2..K with jittered backoff (sleeps hold NO locks),
+            # then the quarantine+rebuild escalation
+            self._compact_retry_escalate(mirror, keep_idx, stats, phases)
+
+        self.compact_count += 1
+        self.compact_victims_total += n_victims
+        self.compact_survivor_rows_total += stats.survivor_rows
+        if self._metrics is not None:
+            for ph in ("mark", "gc", "merge", "publish"):
+                if ph in phases:
+                    self._metrics.emit_histogram(
+                        "kb.compact.seconds", phases[ph], phase=ph)
+            for kind, n in (("superseded", stats.deleted_versions),
+                            ("tombstone", stats.deleted_tombstones),
+                            ("ttl_expired", stats.expired_ttl),
+                            ("rev_record", stats.deleted_rev_records)):
+                if n:
+                    self._metrics.emit_counter(
+                        "kb.compact.victims.total", n, kind=kind)
+            if stats.mirror_path == "full_rebuild":
+                # a compaction that fell back to the full rebuild must be
+                # visible on the SAME series the workload report's
+                # steady-state invariant scrapes (kb_mirror_merge_seconds
+                # {kind=full_rebuild} — otherwise the "compactions don't
+                # drive full rebuilds" check passes vacuously)
+                self._metrics.emit_histogram(
+                    "kb.mirror.merge.seconds", phases.get("merge", 0.0),
+                    kind="full_rebuild")
+        return stats
+
+    def _compact_retry_escalate(self, mirror, keep_idx, stats, phases) -> None:
+        """Attempts 2..K of the compaction's mirror half with the
+        background merge's failure discipline (docs/faults.md): jittered-
+        backoff retries of :meth:`_compact_apply` (sleeps hold no locks),
+        then ESCALATE — the mirror quarantines and one background rebuild
+        from the (already GC'd, hence already compacted) authoritative
+        store recovers it. The engine deletes are durable either way;
+        readers serve the host store while quarantined, byte-identical by
+        construction."""
+        import random as _random
+
+        backoff = 0.05
+        for _attempt in range(1, self._merge_max_retries):
+            self.compact_retries_total += 1
+            if self._metrics is not None:
+                self._metrics.emit_counter("kb.compact.retries", 1)
+            time.sleep(backoff * _random.uniform(0.5, 1.5))
+            backoff = min(backoff * 2.0, 1.0)
+            try:
+                self._compact_apply(mirror, keep_idx, stats, phases)
+                return
+            except Exception as e:
+                self.compact_errors += 1
+                self._compact_last_error = e
+                if self._metrics is not None:
+                    self._metrics.emit_counter("kb.compact.errors", 1)
+        self.compact_escalations_total += 1
+        if self._metrics is not None:
+            self._metrics.emit_counter("kb.compact.escalations", 1)
+        stats.mirror_path = "escalated"
+        with self._mlock:
+            self._force_rebuild = True
+            self._poison_epoch += 1
+            self._enter_degraded_locked("quarantined")
+        self._kick_rebuild()
+
+    def _compact_apply(self, mirror, keep_idx, stats, phases) -> None:
+        """One RETRY attempt at the mirror half: re-acquire ``_merge_lock``
+        (the first attempt runs under :meth:`compact`'s own hold) and
+        apply; a supersede quarantines via
+        :meth:`_quarantine_superseded_compact`."""
+        with self._merge_lock:
+            with self._mlock:
+                self._compact_active = True
+            try:
+                superseded = self._compact_apply_locked(
+                    mirror, keep_idx, stats, phases)
+            finally:
+                with self._mlock:
+                    self._compact_active = False
+        if superseded:
+            self._quarantine_superseded_compact(stats)
+
+    def _quarantine_superseded_compact(self, stats) -> None:
+        """A mirror superseded mid-pass was rebuilt from the store — but
+        possibly from a snapshot PREDATING this compaction's GC deletes.
+        Quarantine + one background rebuild re-converges (readers serve
+        the host store meanwhile; a silent discard could leave GC'd —
+        e.g. TTL-expired, i.e. *visible* — rows serving from the mirror
+        indefinitely). With the whole pass under ``_merge_lock`` only an
+        uncertainty rebuild can cause this."""
+        stats.mirror_path = "superseded"
+        with self._mlock:
+            self._force_rebuild = True
+            self._poison_epoch += 1
+            self._enter_degraded_locked("quarantined")
+        self._kick_rebuild()
+
+    def _compact_apply_locked(self, mirror, keep_idx, stats, phases) -> bool:
+        """ONE attempt at the compaction's mirror half. Caller HOLDS
+        ``_merge_lock`` (serializing with delta merges); ``_mlock`` is
+        taken only for the delta snapshot and the swap, so readers keep
+        serving mirror+overlay throughout. Gathers survivors in the
+        stored domain (:func:`compact_partitions_stored`), k-way merges
+        any delta sealed before the snapshot, swaps. Returns True when
+        the mirror was superseded (an uncertainty rebuild swapped it) —
+        the caller must then quarantine."""
+        plane = self._fault_plane
+        if plane is not None and plane.compact_fault():
+            # chaos: fail here, BEFORE any state mutation — readers keep
+            # serving mirror+overlay; the caller's retry/backoff/escalation
+            # machinery must recover
+            raise RuntimeError("injected compact failure (fault plane)")
+        t0 = time.monotonic()
+        with self._mlock:
+            if self._force_rebuild or self._mirror is not mirror:
+                return True
+            blocks_, rows_prefix, overflow = self._delta.snapshot_blocks()
+        n_rows = len(rows_prefix)
+        ts = self._store.get_timestamp_oracle()
+        # an overflowed delta already commits us to the full rebuild —
+        # don't pay the stored-domain gather just to discard it
+        go_full = self.compact_force_full or (n_rows and overflow)
+        m = (None if go_full
+             else compact_partitions_stored(mirror, keep_idx, self._mesh, ts))
+        if m is not None and n_rows:
+            delta7 = merge_sorted_stored(blocks_)
+            m = merge_partitions_stored(m, delta7, self._mesh, ts)
+        full = m is None
+        if full:
+            # fallback ladder's last rung: pre-ttl_host mirror,
+            # stored-width drift, or a delta key the dictionary
+            # can't express — the decode-everything full rebuild
+            m = self._compact_full_rebuild(mirror, keep_idx, rows_prefix, ts)
+        phases["merge"] = time.monotonic() - t0
+        t1 = time.monotonic()
+        superseded = False
+        with self._mlock:
+            if self._force_rebuild or self._mirror is not mirror:
+                superseded = True
+            elif m is mirror and n_rows == 0:
+                # nothing to do (no victims, empty delta)
+                stats.mirror_path = "stored_incremental"
+            else:
+                self._mirror = m
+                tail = self._delta.tail_rows(n_rows)
+                # bind the fresh delta to the (unchanged) stored
+                # domain; rows appended mid-pass stay in the overlay
                 self._delta = self._fresh_delta()
+                if tail:
+                    self._delta.extend(tail)
                 self._pallas_cache = None
                 self._pallas_ttl_cache = None
                 self._probe_cache = None
-        return stats
+                if full:
+                    self.full_rebuild_total += 1
+                stats.mirror_path = (
+                    "full_rebuild" if full else "stored_incremental")
+        phases["publish"] = time.monotonic() - t1
+        return superseded
+
+    def _compact_full_rebuild(self, mirror, keep_idx, rows_prefix, ts):
+        """The width-drift/dict-overflow fallback: decode every surviving
+        row (``flat_arrays`` is the allowed whole-mirror decode path), drop
+        the victims, merge the raw delta, re-partition and (when enabled)
+        re-dictionary. Steady-state compaction never comes here — the
+        compact bench asserts ``full_rebuild_total`` stays flat."""
+        flat = mirror.flat_arrays()
+        keepm = np.ones(len(flat[0]), dtype=bool)
+        base = 0
+        for p in range(mirror.partitions):
+            nv = int(mirror.n_valid[p])
+            if p in keep_idx:
+                pm = np.zeros(nv, dtype=bool)
+                pm[keep_idx[p]] = True
+                keepm[base : base + nv] = pm
+            base += nv
+        ki = np.nonzero(keepm)[0]
+        arena, offsets = keyops.gather_arena(flat[4], flat[5], ki)
+        surv = (flat[0][ki], flat[1][ki], flat[2][ki], flat[3][ki],
+                arena, offsets)
+        sorted_delta = merge_sorted_arrays(
+            rows_to_arrays([], self._kw), rows_to_arrays(rows_prefix, self._kw))
+        merged = merge_sorted_arrays(surv, sorted_delta)
+        return build_mirror_from_arrays(
+            *merged, self._mesh, self._kw, ts,
+            n_parts=self._partitions or None, encode=self._encode)
 
 
 class TpuKvStorage(KvStorage):

@@ -658,7 +658,14 @@ class WorkloadRunner:
         # on the tpu engine; conn_drop/watch_reset need the endpoint.
         engine_kinds = {fault_schedule.MERGE_FAIL,
                         fault_schedule.MERGE_SUPPRESS,
-                        fault_schedule.ENCODE_OVERFLOW}
+                        fault_schedule.ENCODE_OVERFLOW,
+                        fault_schedule.COMPACT_FAIL}
+        # compact_fail fires only when a CLIENT-cadenced compaction lands
+        # inside its window (the replay owns the compact cadence) — unlike
+        # the write-kicked merge kinds there is no server-side activity to
+        # guarantee a hit, so its reconcile asserts the two counter views
+        # agree without requiring an injection
+        client_driven = {fault_schedule.COMPACT_FAIL}
         reconcile: dict[str, dict] = {}
         for kind in self._fault_sched.kinds():
             eligible = (self.spec.storage == "tpu"
@@ -673,7 +680,8 @@ class WorkloadRunner:
                 # two views of one increment; both must agree, and an
                 # eligible kind must have fired at least once
                 "ok": (n == metrics_injected.get(kind, 0)
-                       and (n > 0 or not eligible)),
+                       and (n > 0 or not eligible
+                            or kind in client_driven)),
             }
         with self._ledger_lock:
             deg = {lane: list(s) for lane, s in self._degraded_samples.items()}
@@ -925,6 +933,39 @@ class WorkloadRunner:
                 final, baseline, "kb_sched_coalesced_total")),
         }
 
+        # device-side compaction (docs/compaction.md): client-cadence
+        # accounting + the scanner's phase/victim scrape-deltas. All-zero
+        # metric deltas on non-tpu storage — only the TPU scanner emits
+        # kb_compact_*; the COMPACT op counts come from the client side
+        # either way.
+        compact_phases = {}
+        for ph in ("mark", "gc", "merge", "publish"):
+            c0, s0 = slo.hist_count_sum(baseline, "kb_compact_seconds",
+                                        phase=ph)
+            c1, s1 = slo.hist_count_sum(final, "kb_compact_seconds", phase=ph)
+            compact_phases[ph] = {"count": int(c1 - c0),
+                                  "seconds": round(s1 - s0, 4)}
+        compact = {
+            "completed": stats.count(COMPACT, "ok"),
+            "skipped": stats.count(COMPACT, "skip"),
+            "phases": compact_phases,
+            "victims": {k: int(slo.delta(
+                final, baseline, "kb_compact_victims_total", kind=k))
+                for k in ("superseded", "tombstone", "ttl_expired",
+                          "rev_record")},
+            "errors": int(slo.delta(
+                final, baseline, "kb_compact_errors_total")),
+            "retries": int(slo.delta(
+                final, baseline, "kb_compact_retries_total")),
+            "escalations": int(slo.delta(
+                final, baseline, "kb_compact_escalations_total")),
+            # the steady-state invariant: compactions must not drive the
+            # full-rebuild series (docs/compaction.md fallback ladder)
+            "full_rebuilds": int(slo.delta(
+                final, baseline, "kb_mirror_merge_seconds_count",
+                kind="full_rebuild")),
+        }
+
         with self._rpc_lock:
             rpc = dict(self._rpc)
         checks: dict[str, dict] = {}
@@ -988,6 +1029,7 @@ class WorkloadRunner:
             "watch": watch,
             "leases": leases,
             "sched": sched,
+            "compact": compact,
             "reconcile": {"ok": reconcile_ok, "checks": checks},
             "slo": {"pass": False, "violations": [],
                     "bounds": asdict(spec.bounds)},

@@ -133,6 +133,8 @@ _REQUIRED: dict[str, tuple[str, ...]] = {
     "sched": ("batched_launches", "batched_requests", "shed_total",
               "coalesced_total", "write_batched_groups",
               "write_batched_ops"),
+    "compact": ("completed", "skipped", "phases", "victims",
+                "escalations", "full_rebuilds"),
     "reconcile": ("ok", "checks"),
     "slo": ("pass", "violations", "bounds"),
     "errors": (),

@@ -100,6 +100,7 @@ def test_plane_inert_until_armed():
         assert not p.merge_fault()
         assert not p.merges_suppressed()
         assert not p.encode_overflow()
+        assert not p.compact_fault()
     assert p.snapshot() == {}
 
 

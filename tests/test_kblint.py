@@ -562,8 +562,13 @@ def test_kb111_flags_asarray_of_dev_column():
 def test_kb111_flags_asarray_of_kernel_result():
     src = ("import numpy as np\n"
            "def leak(m, nv):\n"
-           "    return np.asarray(_victim_counts(m, nv))\n")
+           "    return np.asarray(_victim_part_counts(m, nv))\n")
     assert ids(src, TPU) == ["KB111"]
+    # the compaction survivor-index producer is device-taint too
+    src1s = ("import numpy as np\n"
+             "def leak(m, nv):\n"
+             "    return np.asarray(_part_survivor_indices(m, nv, size=8))\n")
+    assert ids(src1s, TPU) == ["KB111"]
     # a scan-kernel reference outside the assembly points trips BOTH
     # disciplines: KB109 (stray dispatch) and KB111 (unmetered transfer)
     src1b = ("import numpy as np\n"
@@ -582,9 +587,15 @@ def test_kb111_allows_named_materialization_points():
            "    return np.asarray(x)\n"
            "def _pallas_ttl8(self, mirror, npad):\n"
            "    return jax.device_get(mirror.ttl_dev)\n"
-           "def _pull_victim_mask(self, mask_dev, mirror):\n"
-           "    return np.asarray(_survivor_indices(mask_dev, 1, size=4))\n")
+           "def _pull_victim_indices(self, mask_dev, mirror):\n"
+           "    return np.asarray(_part_survivor_indices(mask_dev, 1, size=4))\n")
     assert ids(src, TPU) == []
+    # the OLD compact transfer funnel is no longer a named point: the
+    # shard-local `_pull_victim_indices` replaced it (docs/compaction.md)
+    old = ("import numpy as np\n"
+           "def _pull_victim_mask(self, mask_dev, mirror):\n"
+           "    return np.asarray(mask_dev)\n")
+    assert ids(old, TPU) == ["KB111"]
 
 
 def test_kb111_ignores_host_array_conversions():
@@ -641,9 +652,22 @@ def test_kb116_allows_the_funnel_chain():
            "    return self.decoded_keys(0, [])\n"
            "def merge_partitions_incremental(mirror, p):\n"
            "    return mirror.decoded_keys(p, [])\n"
-           "def compact(self, start, end, rev):\n"
-           "    return self._mirror.decoded_keys(0, [])\n")
+           "def _compact_victim_rows(self, mirror, p, rows):\n"
+           "    return mirror.decoded_keys(p, rows)\n")
     assert ids(src, TPU) == []
+
+
+def test_kb116_flags_whole_partition_decode_in_compact():
+    """The pre-stored-domain compact shape — decode EVERY surviving row of
+    every partition (`decoded_keys(p, np.arange(nv))` straight from
+    ``compact``) — must now be flagged: since the stored-domain survivor
+    merge (docs/compaction.md) the only decode compaction may perform is
+    the victim-only ``_compact_victim_rows`` funnel."""
+    src = ("import numpy as np\n"
+           "def compact(self, start, end, rev):\n"
+           "    mirror = self._mirror\n"
+           "    return mirror.decoded_keys(0, np.arange(10))\n")
+    assert ids(src, TPU) == ["KB116"]
 
 
 def test_kb116_scoped_to_storage_tpu_and_exempts_encode_py():

@@ -72,15 +72,55 @@ class Cluster:
         for i in range(n):
             self.start(i)
 
-    def start(self, i):
+    def start(self, i, boot_timeout=30.0):
+        """Spawn member i and wait for its READY line — robustly.
+
+        The old one-shot ``assert b"READY" in readline()`` raced member
+        restarts under full-suite load (the ROADMAP leader-restart flake):
+        a freshly killed member's socket can linger, so the respawned
+        binary loses the bind race with its own predecessor and exits (or
+        logs a warning line) before READY ever appears, and a wedged boot
+        blocked readline() forever. Fresh-probe discipline instead: scan
+        stdout line-by-line under a deadline (log lines ahead of READY are
+        fine), and if the process dies before READY, respawn with backoff
+        until the bind succeeds or the deadline expires."""
+        import select
+
         path = os.path.join(self.tmp, f"n{i}")
         os.makedirs(path, exist_ok=True)
-        proc = subprocess.Popen(
-            [STORED_BIN, str(self.ports[i]), path,
-             "--peers", self.peers, "--self", str(i)],
-            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, env=self.env)
-        assert b"READY" in proc.stdout.readline()
-        self.procs[i] = proc
+        deadline = time.time() + boot_timeout
+        backoff = 0.1
+        while True:
+            proc = subprocess.Popen(
+                [STORED_BIN, str(self.ports[i]), path,
+                 "--peers", self.peers, "--self", str(i)],
+                stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, env=self.env)
+            ready = False
+            while time.time() < deadline:
+                r, _, _ = select.select([proc.stdout], [], [], 0.25)
+                if r:
+                    line = proc.stdout.readline()
+                    if not line:
+                        break  # EOF: died before READY (bind race)
+                    if b"READY" in line:
+                        ready = True
+                        break
+                    continue  # a log/warning line ahead of READY is fine
+                if proc.poll() is not None:
+                    break  # exited without flushing anything
+            if ready:
+                self.procs[i] = proc
+                return
+            try:
+                proc.kill()
+                proc.wait()
+            except Exception:
+                pass
+            if time.time() >= deadline:
+                raise AssertionError(
+                    f"member {i} never printed READY within {boot_timeout}s")
+            time.sleep(backoff)
+            backoff = min(backoff * 2, 1.0)
 
     def kill(self, i):
         self.procs[i].kill()

@@ -339,11 +339,12 @@ def test_delta_index_overlay_snapshot_semantics():
     store.close()
 
 
-def test_pull_victim_mask_adaptive_branches(tb):
-    """Both sides of the two-phase transfer (pull victim indices vs pull
-    survivor indices) must rebuild the exact same host mask. A bulk compact
-    of long version chains has few survivors; an incremental compact has
-    few victims — force each branch and differential-check the results."""
+def test_pull_victim_indices_adaptive_branches(tb):
+    """Both sides of the shard-local two-phase transfer (pull victim
+    indices vs pull survivor indices) must rebuild the exact same victim
+    identities as the device mask. A bulk compact of long version chains
+    has few survivors; an incremental compact has few victims — force each
+    branch and differential-check against the directly-pulled mask."""
     from unittest import mock
 
     # long chains: 6 keys x 30 revisions -> compacting makes most rows victims
@@ -360,30 +361,35 @@ def test_pull_victim_mask_adaptive_branches(tb):
     sc = tb.scanner
     sc._ensure_published(full=True)
     pulled = []
-    orig = type(sc)._pull_victim_mask
+    orig = type(sc)._pull_victim_indices
 
     def spy(self, mask_dev, mirror):
         out = orig(self, mask_dev, mirror)
-        # the differential: the rebuilt host mask must equal the device mask
-        # pulled directly (identities, not just counts)
-        assert np.array_equal(out, np.asarray(mask_dev).astype(bool))
+        # the differential: the per-partition victim identities must equal
+        # the device mask pulled directly (identities, not just counts)
+        mask_h = np.asarray(mask_dev).astype(bool)
+        for p in range(mask_h.shape[0]):
+            nv = int(mirror.n_valid[p])
+            want = np.nonzero(mask_h[p, :nv])[0]
+            got = out.get(p, np.empty(0, dtype=np.int64))
+            assert np.array_equal(np.asarray(got), want), (p, got, want)
         pulled.append(out)
         return out
 
-    with mock.patch.object(type(sc), "_pull_victim_mask", spy):
+    with mock.patch.object(type(sc), "_pull_victim_indices", spy):
         tb.compact(last)
     assert pulled, "compact did not route through the two-phase pull"
-    bulk_mask = pulled[-1]
+    n_bulk = sum(len(v) for v in pulled[-1].values())
     # bulk compact of 30-rev chains: victims outnumber survivors
-    assert bulk_mask.sum() > (6 * 30) // 2
+    assert n_bulk > (6 * 30) // 2
 
     # incremental compact right after: almost no victims -> victim branch
     r2 = tb.update(b"/registry/pods/c0", b"vz", revs[b"/registry/pods/c0"])
     assert wait_for_revision(tb, r2)
     pulled.clear()
-    with mock.patch.object(type(sc), "_pull_victim_mask", spy):
+    with mock.patch.object(type(sc), "_pull_victim_indices", spy):
         tb.compact(r2)
-    assert pulled and pulled[-1].sum() <= 2
+    assert pulled and sum(len(v) for v in pulled[-1].values()) <= 2
 
     # state still correct after both branches
     res = tb.list_(b"/registry/", b"/registry0")

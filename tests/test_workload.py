@@ -146,6 +146,9 @@ def _minimal_report(**overrides) -> dict:
         "sched": {"batched_launches": 0, "batched_requests": 0,
                   "write_batched_groups": 0, "write_batched_ops": 0,
                   "shed_total": 0, "coalesced_total": 0},
+        "compact": {"completed": 1, "skipped": 0, "phases": {},
+                    "victims": {}, "errors": 0, "retries": 0,
+                    "escalations": 0, "full_rebuilds": 0},
         "reconcile": {"ok": True, "checks": {}},
         "slo": {"pass": True, "violations": [], "bounds": {}},
         "errors": [],

@@ -506,8 +506,8 @@ class ReplayableWorkloadRandomness(Rule):
 #: dry run on real traffic
 _DEVICE_PRODUCER_NAMES = {
     "_vis_batch", "_vis_batch_q", "_vis_batch_pallas", "_vis_batch_pallas_q",
-    "_indices_of_mask", "_part_indices_of_mask", "_part_indices_of_mask_sel",
-    "_survivor_indices", "_victim_counts", "_victim_batch",
+    "_part_indices_of_mask", "_part_indices_of_mask_sel",
+    "_part_survivor_indices", "_victim_part_counts", "_victim_batch",
     "_victim_batch_pallas", "_dev_mask", "_dev_mask_batch",
 }
 #: numpy host-conversion entry points (device arrays convert implicitly)
@@ -520,8 +520,13 @@ _HOST_CONVERTERS = {
 #: blocks correctly and meters the bytes for the transfer-budget tests)
 _HOST_TRANSFER_ALLOWED = {
     "_host_pull", "_materialize_visible", "_host_visible",
-    "_host_visible_batch", "_pallas_ttl8", "_pull_victim_mask",
+    "_host_visible_batch", "_pallas_ttl8", "_pull_victim_indices",
     "merge_partitions_incremental",
+    # the compaction pipeline's named funnels (docs/compaction.md): the
+    # victim-only decode point and the stored-domain mirror-maintenance
+    # paths that rebuild sharded device arrays from host columns
+    "_compact_victim_rows", "compact_partitions_stored",
+    "merge_partitions_stored",
 }
 
 
@@ -554,7 +559,7 @@ class HostTransferOnlyAtMaterializationPoints(Rule):
     summary = ("storage/tpu/: jax.device_get / host conversion of device "
                "arrays only inside the named materialization points "
                "(_host_pull, _materialize_visible, _host_visible*, "
-               "_pallas_ttl8, _pull_victim_mask)")
+               "_pallas_ttl8, _pull_victim_indices)")
 
     def applies(self, relpath: str) -> bool:
         return relpath.replace("\\", "/").startswith("kubebrain_tpu/storage/tpu/")
@@ -607,9 +612,15 @@ class HostTransferOnlyAtMaterializationPoints(Rule):
 #: transfer-budget accounting).
 _DECODE_PRIMITIVES = {"decode_rows", "decode_one"}
 _DECODE_PRIMITIVE_FUNNELS = {"decoded_keys", "user_key"}
+#: NOTE: ``compact`` itself is deliberately NOT here — since the
+#: stored-domain compaction (docs/compaction.md) the only decode the
+#: compact pipeline may perform is the victim-only funnel
+#: ``_compact_victim_rows``; a whole-partition ``decoded_keys`` call from
+#: ``compact`` (the pre-PR-12 shape) is exactly the host decode tax the
+#: pipeline removed, and must be flagged.
 _DECODE_FUNNEL_CALLERS = {
-    "materialize", "flat_arrays", "merge_partitions_incremental", "compact",
-    "_materialize_visible",
+    "materialize", "flat_arrays", "merge_partitions_incremental",
+    "_compact_victim_rows", "_materialize_visible",
 }
 
 
@@ -619,10 +630,13 @@ class DecodeOnlyAtMaterializationFunnels(Rule):
     named funnels: ``KeyEncoding.decode_rows``/``decode_one`` inside
     ``Mirror.decoded_keys``/``user_key``, and ``decoded_keys`` itself only
     from the materialization/rebuild paths (``materialize``,
-    ``flat_arrays``, ``merge_partitions_incremental``, ``compact``). A
-    decode call anywhere else re-creates the full-width key column on the
-    host outside the visible-row sizing — the exact cost the
-    prefix-compressed mirror (docs/compression.md) removes."""
+    ``flat_arrays``, ``merge_partitions_incremental``, and compaction's
+    victim-only ``_compact_victim_rows``). A decode call anywhere else
+    re-creates the full-width key column on the host outside the
+    visible-row/victim-row sizing — the exact cost the prefix-compressed
+    mirror (docs/compression.md) and the stored-domain compaction
+    (docs/compaction.md) remove. In particular a whole-partition decode
+    from ``compact`` itself — the pre-stored-domain shape — is flagged."""
 
     rule_id = "KB116"
     summary = ("storage/tpu/: encoded-key decode only through the "
@@ -664,7 +678,7 @@ class DecodeOnlyAtMaterializationFunnels(Rule):
                         f"decoded_keys(){where}: decoded key bytes only "
                         "leave the mirror through the named materialization"
                         "/rebuild paths (materialize, flat_arrays, "
-                        "merge_partitions_incremental, compact)"
+                        "merge_partitions_incremental, _compact_victim_rows)"
                     )
 
         yield from scan(tree.body, None)
