@@ -79,6 +79,13 @@ bench-smoke:
 # COMPACT_S overrides the spec's compaction cadence in SIMULATED seconds
 # (0 = scenario default), e.g. the 5-min-compaction scenario of the
 # ROADMAP: make bench-cluster N=1000 DURATION=900 COMPACT_S=300.
+# REPLICAS=<n> spawns n follower replicas next to the leader
+# (docs/replication.md): controller list+watch traffic routes to the
+# followers (bounded-staleness local serving + local watch fan-out),
+# writes/leases round-robin and forward; emits REPLICA_rNN.json with the
+# per-replica served/forwarded/lag section. FAULTS=replica REPLICAS=2
+# arms the follower chaos kinds (replication reset, leader-unreachable,
+# fence timeout) and judges by the same acked-write consistency check.
 N ?= 1000
 STORAGE ?= memkv
 MESH_PART ?= 0
@@ -87,13 +94,15 @@ SCENARIO ?= cluster
 FAULTS ?= none
 FAULT_SEED ?= 0
 COMPACT_S ?= 0
+REPLICAS ?= 0
 bench-cluster:
 	JAX_PLATFORMS=cpu KB_BENCH_METRIC=cluster KB_BENCH_NODES=$(N) \
 	    KB_WORKLOAD_STORAGE=$(STORAGE) KB_WORKLOAD_MESH_PART=$(MESH_PART) \
 	    KB_WORKLOAD_SCAN_PARTITIONS=$(SCAN_PARTS) \
 	    KB_WORKLOAD_SCENARIO=$(SCENARIO) KB_WORKLOAD_FAULTS=$(FAULTS) \
 	    KB_WORKLOAD_FAULT_SEED=$(FAULT_SEED) \
-	    KB_WORKLOAD_COMPACT_S=$(COMPACT_S) python bench.py
+	    KB_WORKLOAD_COMPACT_S=$(COMPACT_S) \
+	    KB_WORKLOAD_REPLICAS=$(REPLICAS) python bench.py
 
 # Multichip sharded serving curve (docs/multichip.md): the scan workload
 # served through the scheduler at mesh sizes 1..8, byte-identical across

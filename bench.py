@@ -1528,6 +1528,10 @@ def bench_cluster() -> None:
         storage=os.environ.get("KB_WORKLOAD_STORAGE", "memkv"),
         mesh_part=int(os.environ.get("KB_WORKLOAD_MESH_PART", 0)),
         scan_partitions=int(os.environ.get("KB_WORKLOAD_SCAN_PARTITIONS", 0)),
+        # read scale-out (docs/replication.md): spawn follower replicas;
+        # the report then lands in REPLICA_rNN.json with a schema'd
+        # `replica` section (make bench-cluster REPLICAS=2)
+        replicas=int(os.environ.get("KB_WORKLOAD_REPLICAS", 0)),
     )
     # compaction-cadence knob (SIMULATED seconds; 0 = scenario default) —
     # `make bench-cluster COMPACT_S=300` drives the 5-min-compaction
@@ -1572,6 +1576,13 @@ def bench_cluster() -> None:
             "lease_expiries": report["leases"]["metrics"]["expired_delta"],
             "batched_requests": report["sched"]["batched_requests"],
             "reconcile_ok": report["reconcile"]["ok"],
+            "replica": ({
+                "replicas": spec.replicas,
+                "rows_per_sec": report["replica"]["rows_per_sec"],
+                "fence_probes": report["replica"]["fence_probes"],
+                "endpoint_failovers": report["replica"]["endpoint_failovers"],
+                "reconcile_ok": report["replica"]["reconcile"]["ok"],
+            } if spec.replicas else None),
             "faults": ({
                 "preset": spec.faults,
                 "sha256": report["faults"]["schedule"]["sha256"],

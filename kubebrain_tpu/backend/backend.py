@@ -652,6 +652,45 @@ class Backend:
                         self._ring[i] = None
             self._ring_cond.notify_all()
 
+    def ingest_replicated(self, events: list[WatchEvent], watermark: int) -> None:
+        """Follower role (kubebrain_tpu/replica): adopt an already-sequenced
+        replicated event block from the leader's stream — watch cache + hub
+        fan-out + the committed revision floor, strictly DOWNSTREAM of the
+        leader's sequencer. The local ring/TSO-deal path is never involved:
+        followers deal nothing, so the block needs no re-sequencing — the
+        stream's revision order IS the sequence. ``events`` may be empty
+        (a progress mark crossing the leader's revision gaps); ``watermark``
+        is the new applied floor (every leader event <= it has been applied
+        to the local store before this call)."""
+        now = time.monotonic()
+        for e in events:
+            e.ts = now
+        if events:
+            self._flush(events)
+        if watermark > self.tso.committed():
+            # commit (not init): fence waiters park on the TSO's committed
+            # condition, and the watermark advance is their wake-up
+            self.tso.commit(watermark)
+            with self._ring_cond:
+                if watermark + 1 > self._next_rev:
+                    self._next_rev = watermark + 1
+
+    def flushed_revision(self) -> int:
+        """Highest revision guaranteed fully streamed into every hub
+        subscriber queue (the sound floor for watch progress marks —
+        ``WatcherHub.post_progress``). -1 while the pipeline is mid-drain
+        or an event is pending at the floor (callers retry) — distinct
+        from the legitimate floor 0 of a store that has served no writes.
+        Gap revisions (failed/uncertain ops) count: every DEALT revision
+        passes through the ring, so ``_next_rev - 1`` means "nothing
+        below is owed"."""
+        with self._ring_cond:
+            if self._draining:
+                return -1
+            if self._ring[self._next_rev % self._ring_cap] is not None:
+                return -1
+            return self._next_rev - 1
+
     def get(self, user_key: bytes, revision: int = 0) -> KeyValue:
         """Point read at a snapshot: reverse-iterate the version chain from
         (key, read_rev) down, take the first row, reject tombstones.
@@ -785,12 +824,33 @@ class Backend:
             current = self._compact_revision_at(None)
             if target <= current:
                 return current
-            self._set_compact_record(target, current)
-            self._compact_rev_cache = target
-            self._compact_cache_time = time.monotonic()
+            self._persist_compact_floor_locked(target, current)
             for left, right in self._compact_borders():
                 self.scanner.compact(left, right, target)
             return target
+
+    def _persist_compact_floor_locked(self, target: int, current: int) -> None:
+        """Persist + cache the compact watermark (callers hold
+        ``_compact_lock``) — shared by :meth:`compact` and the follower's
+        GC-free :meth:`set_compact_floor` so the record format and cache
+        invalidation can never diverge between the two."""
+        self._set_compact_record(target, current)
+        self._compact_rev_cache = target
+        self._compact_cache_time = time.monotonic()
+
+    def set_compact_floor(self, revision: int) -> int:
+        """Persist the compact watermark WITHOUT running GC borders — the
+        follower bootstrap/resync case (kubebrain_tpu/replica): the local
+        store was built from post-GC leader state, so there is nothing to
+        collect, only history below ``revision`` to fence off (reads under
+        it refuse as compacted — the honest etcd answer for a follower
+        whose replicated history starts at its bootstrap revision)."""
+        with self._compact_lock:
+            current = self._compact_revision_at(None)
+            if revision <= current:
+                return current
+            self._persist_compact_floor_locked(revision, current)
+            return revision
 
     def _compact_borders(self) -> list[tuple[bytes, bytes]]:
         """Internal-key border pairs covering the configured prefix minus

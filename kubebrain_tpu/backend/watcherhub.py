@@ -28,6 +28,20 @@ from .common import WatchEvent
 SUBSCRIBER_BUFFER = 10000
 
 
+class ProgressMarker:
+    """A watch progress mark riding a subscriber queue IN ORDER with event
+    batches: by the time a consumer pulls it, every event with revision <=
+    ``revision`` has already been pulled (the poster guarantees all such
+    events were enqueued first — Backend.flushed_revision). The follower
+    replication stream uses these to advance its applied watermark across
+    the leader's revision gaps (docs/replication.md)."""
+
+    __slots__ = ("revision",)
+
+    def __init__(self, revision: int):
+        self.revision = revision
+
+
 def _in_range(key: bytes, start: bytes, end: bytes) -> bool:
     return key >= start and (not end or key < end)
 
@@ -227,11 +241,21 @@ class WatcherHub:
             self._metrics.unregister_gauge_fn("kb.watch.backlog",
                                               watcher=str(wid))
         if q is not None:
-            # poison pill: stream closed. If the queue is full (that's why the
-            # watcher is being dropped), evict one batch so the pill fits —
-            # the consumer must learn the stream ended and re-watch.
-            # structurally bounded: each pass evicts one batch from a
-            # bounded queue until the pill fits
+            # Drop protocol. Evicting buffered batches to fit the poison
+            # pill would let the consumer deliver a NEWER batch after an
+            # older one was discarded (the consumer races any eviction) —
+            # an invisible gap whose resume watermark skips the evicted
+            # events forever (docs/replication.md). Instead: flag the
+            # queue dropped FIRST — consumers check the flag before every
+            # delivery and truncate, so the delivered sequence stays a
+            # strict prefix of the enqueued order — then make room for
+            # the pill (the evictions are now provably undeliverable).
+            # Structurally bounded: each pass evicts one batch from a
+            # bounded queue until the pill fits.
+            try:
+                q.kb_dropped = True
+            except AttributeError:
+                pass  # exotic queue_factory without attribute support
             while True:  # kblint: disable=KB118 -- drains a bounded queue
                 try:
                     q.put_nowait(None)
@@ -241,6 +265,23 @@ class WatcherHub:
                         q.get_nowait()
                     except queue.Empty:
                         pass
+
+    def post_progress(self, wid: int, revision: int) -> None:
+        """Enqueue a ProgressMarker on watcher ``wid``'s own queue. The
+        caller must have established that every event with revision <=
+        ``revision`` was already enqueued (Backend.flushed_revision reads
+        the sequencer floor while the drainer is idle); queue FIFO then
+        carries the ordering to the wire. Best-effort: a full queue drops
+        the mark (that watcher is about to be dropped as a slow consumer
+        anyway), never an event."""
+        with self._lock:
+            q = self._subs.get(wid)
+        if q is None:
+            return
+        try:
+            q.put_nowait(ProgressMarker(revision))
+        except queue.Full:
+            pass
 
     def watcher_count(self) -> int:
         with self._lock:

@@ -91,6 +91,35 @@ def build_parser() -> argparse.ArgumentParser:
                    help="stub leader election (always leader)")
     p.add_argument("--enable-etcd-proxy", action="store_true",
                    help="followers forward writes to the leader")
+    p.add_argument("--role", choices=("leader", "follower"), default="leader",
+                   help="serving role (docs/replication.md): 'follower' "
+                        "keeps a local mirror fed by a resumable "
+                        "replication stream from --leader-address, serves "
+                        "explicit-revision + bounded-staleness reads and "
+                        "Watch locally, fences linearizable reads on the "
+                        "leader's revision, and forwards writes/leases/"
+                        "compaction")
+    p.add_argument("--leader-address", default="",
+                   help="leader client (gRPC) host:port (--role follower): "
+                        "replication stream source + write/lease forward "
+                        "target")
+    p.add_argument("--leader-info", default="",
+                   help="leader info/peer (HTTP) host:port (--role "
+                        "follower): /status for the linearizable-read "
+                        "revision fence + compact-watermark sync")
+    p.add_argument("--max-staleness-rev", type=int, default=0,
+                   help="follower bounded-staleness bound in revisions: "
+                        "serializable reads REFUSE (etcdserver: replica "
+                        "too stale) once the replication lag exceeds it; "
+                        "0 = unbounded")
+    p.add_argument("--max-staleness-ms", type=float, default=5000.0,
+                   help="follower bounded-staleness bound in wall ms since "
+                        "the watermark last covered the leader head; "
+                        "refusal past it, 0 = unbounded")
+    p.add_argument("--fence-timeout-ms", type=float, default=3000.0,
+                   help="follower linearizable-read fence: how long the "
+                        "applied watermark may chase the leader revision "
+                        "before the read refuses (never answers stale)")
     p.add_argument("--enable-storage-metrics", action="store_true")
     p.add_argument("--tpu-fanout", action="store_true",
                    help="vectorized watch fan-out on the device mesh")
@@ -231,6 +260,29 @@ def validate_args(args) -> None:
         args.storage == "native" or (args.storage == "tpu" and args.inner_storage == "native")
     ):
         raise SystemExit("--data-dir requires --storage=native (or tpu over native)")
+    if getattr(args, "role", "leader") == "follower":
+        if not getattr(args, "leader_address", ""):
+            raise SystemExit("--role follower requires --leader-address")
+        if not getattr(args, "leader_info", ""):
+            raise SystemExit("--role follower requires --leader-info "
+                             "(the leader's info/peer HTTP host:port)")
+        if getattr(args, "aio_port", 0) or getattr(args, "front_port", 0):
+            # those fronts build their services WITHOUT the replica gate:
+            # they would serve ungated (silently stale) "linearizable"
+            # reads and refuse lease RPCs instead of forwarding — refuse
+            # loudly until they grow replica routing
+            raise SystemExit("--role follower serves the sync gRPC front "
+                             "only (--aio-port/--front-port have no "
+                             "replica read gate yet)")
+        if getattr(args, "fence_timeout_ms", 1.0) <= 0:
+            raise SystemExit("--fence-timeout-ms must be > 0")
+        if (getattr(args, "max_staleness_rev", 0) < 0
+                or getattr(args, "max_staleness_ms", 0.0) < 0):
+            raise SystemExit("--max-staleness-rev/--max-staleness-ms "
+                             "must be >= 0 (0 = unbounded)")
+    elif getattr(args, "leader_address", "") or getattr(args, "leader_info", ""):
+        raise SystemExit("--leader-address/--leader-info require "
+                         "--role follower")
     faults = getattr(args, "faults", "") or ""
     if faults:
         from .faults.schedule import PRESETS
@@ -401,7 +453,37 @@ def build_endpoint(args):
     ), metrics=metrics)
 
     identity = args.identity or f"{get_host()}:{args.peer_port}"
-    if args.single_node:
+    replica_role = None
+    if getattr(args, "role", "leader") == "follower":
+        # follower role (docs/replication.md): the role object IS the
+        # peers surface (is_leader False, no-op revision sync) so every
+        # existing service works unchanged, plus the per-RPC replica
+        # routing the etcd terminals consult
+        from .replica import FollowerConfig, FollowerRole
+
+        leader_creds = None
+        if args.ca_file:
+            # a TLS-serving leader: verify it against the configured CA
+            # on the forwarding + replication channels
+            import grpc as _grpc
+
+            with open(args.ca_file, "rb") as f:
+                leader_creds = _grpc.ssl_channel_credentials(
+                    root_certificates=f.read())
+        replica_role = FollowerRole(
+            backend,
+            FollowerConfig(
+                leader_address=args.leader_address,
+                leader_info=args.leader_info,
+                max_staleness_rev=getattr(args, "max_staleness_rev", 0),
+                max_staleness_ms=getattr(args, "max_staleness_ms", 5000.0),
+                fence_timeout_s=getattr(args, "fence_timeout_ms", 3000.0)
+                / 1000.0,
+                credentials=leader_creds,
+            ),
+            metrics=metrics, fault_plane=fault_plane, identity=identity)
+        peers = replica_role
+    elif args.single_node:
         peers = SingleNodePeerService(backend, identity)
     else:
         peers = PeerService(
@@ -426,6 +508,7 @@ def build_endpoint(args):
         backend, peers, metrics, identity,
         client_urls=[f"http://{identity.rsplit(':', 1)[0]}:{args.client_port}"],
         compact_interval=args.compact_interval,
+        replica=replica_role,
     )
     extra_http = {}
     if fault_plane is not None:
@@ -490,6 +573,21 @@ def build_endpoint(args):
 
         endpoint.run = run_with_front
         endpoint.close = close_with_front
+    if replica_role is not None:
+        # start the replication stream once the listeners are up; stop it
+        # (and the forwarding channel) before the backend goes away
+        _rp_run, _rp_close = endpoint.run, endpoint.close
+
+        def run_with_replica():
+            _rp_run()
+            replica_role.start()
+
+        def close_with_replica(grace: float = 1.0):
+            replica_role.close()
+            _rp_close(grace)
+
+        endpoint.run = run_with_replica
+        endpoint.close = close_with_replica
     return endpoint, backend, store
 
 

@@ -155,6 +155,69 @@ def _traced_call(callable_):
     return _TracedCall(callable_)
 
 
+class _FailoverCall:
+    """One logical unary method over several endpoints (docs/replication.md):
+    round-robin selection per call, with SAFE-ONLY failover — an attempt
+    whose failure classifies ``safe`` (provably nothing applied: replica
+    staleness refusal, admission shed, not-leader) moves on to the next
+    endpoint; ``ambiguous`` and ``definite`` failures surface immediately,
+    exactly like a single-endpoint call. Extra attempts land in
+    ``client.endpoint_failovers`` (the kb_client_endpoint_failovers count
+    the workload harness surfaces), and every successful response's header
+    revision is recorded per endpoint — the harness's
+    response-revision <= applied-watermark reconcile reads it."""
+
+    __slots__ = ("_client", "_calls", "_targets", "_write", "_method")
+
+    def __init__(self, client, calls, targets, write: bool, method: str):
+        self._client = client
+        self._calls = calls
+        self._targets = targets
+        self._write = write
+        self._method = method
+
+    def __call__(self, request, timeout=None, metadata=None):
+        n = len(self._calls)
+        start = self._client._next_endpoint()
+        last: grpc.RpcError | None = None
+        for k in range(n):
+            i = (start + k) % n
+            try:
+                resp = self._calls[i](request, timeout=timeout,
+                                      metadata=metadata)
+            except grpc.RpcError as e:
+                last = e
+                if k == n - 1 or classify_rpc_error(e, self._write) != "safe":
+                    raise
+                self._client._note_failover(self._method)
+                continue
+            self._client._note_endpoint_revision(self._targets[i], resp)
+            return resp
+        raise last  # unreachable; keeps the contract explicit
+
+    def future(self, request, timeout=None, metadata=None):
+        # pipelined bulk paths manage their own windows; no failover
+        i = self._client._next_endpoint() % len(self._calls)
+        return self._calls[i].future(request, timeout=timeout,
+                                     metadata=metadata)
+
+
+class _RotatingStreamCall:
+    """Stream multicallable over several endpoints: each stream OPEN picks
+    the next endpoint round-robin. Failover for streams is the consumer's
+    re-open (WatchMux revive opens a fresh stream → next endpoint)."""
+
+    __slots__ = ("_client", "_calls")
+
+    def __init__(self, client, calls):
+        self._client = client
+        self._calls = calls
+
+    def __call__(self, request_iterator):
+        i = self._client._next_endpoint() % len(self._calls)
+        return self._calls[i](request_iterator)
+
+
 @dataclass
 class ClientKV:
     key: bytes
@@ -163,33 +226,74 @@ class ClientKV:
 
 
 class EtcdCompatClient:
-    def __init__(self, target: str, credentials: grpc.ChannelCredentials | None = None,
-                 retries: int = 0, retry_backoff_s: float = 0.05):
+    def __init__(self, target: str | list[str] | tuple[str, ...] | None = None,
+                 credentials: grpc.ChannelCredentials | None = None,
+                 retries: int = 0, retry_backoff_s: float = 0.05,
+                 endpoints: list[str] | None = None):
         """``retries`` > 0 arms transparent retry of SAFE failures only
         (classify_rpc_error): reads retry on anything, writes only on
         provably-not-applied refusals — an ambiguous write outcome always
         surfaces. ``self.retries_sent`` counts the extra attempts per
-        method (harnesses add them to their reconcile counts)."""
-        self.channel = (
-            grpc.secure_channel(target, credentials)
-            if credentials
-            else grpc.insecure_channel(target)
-        )
+        method (harnesses add them to their reconcile counts).
+
+        Multi-endpoint mode (``endpoints=[...]`` or a list ``target``):
+        one channel per endpoint, unary calls round-robin across them
+        with SAFE-ONLY failover to the next endpoint (a replica staleness
+        refusal or not-leader moves on; an ambiguous write failure never
+        does) — ``self.endpoint_failovers`` counts the extra attempts,
+        and ``self.max_header_revision[endpoint]`` tracks the highest
+        response revision each endpoint served (the replica harness's
+        revision-consistency reconcile). Streams (Watch/LeaseKeepAlive)
+        pick an endpoint per stream open."""
+        if endpoints is None and not isinstance(target, str):
+            endpoints = list(target or ())
+        if endpoints is not None:
+            eps = [e for e in endpoints if e]
+            if not eps:
+                raise ValueError("endpoints must name at least one target")
+        else:
+            eps = [target]
+        self._endpoints = eps
+        self._multi = endpoints is not None
+        mk = (lambda t: grpc.secure_channel(t, credentials)) if credentials \
+            else grpc.insecure_channel
+        self.channels = [mk(t) for t in eps]
+        self.channel = self.channels[0]  # single-endpoint back-compat
         self._retry_budget = retries
         self._retry_backoff_s = retry_backoff_s
         self.retries_sent: collections.Counter = collections.Counter()
+        #: safe-only endpoint failovers (kb_client_endpoint_failovers)
+        self.endpoint_failovers = 0
+        self.failovers_by_method: collections.Counter = collections.Counter()
+        #: endpoint -> highest response header revision it served
+        self.max_header_revision: dict[str, int] = {}
+        self._ep_lock = threading.Lock()
+        self._ep_rr = 0
         p = rpc_pb2
         self._range = self._unary("/etcdserverpb.KV/Range", p.RangeRequest, p.RangeResponse)
+        #: per-endpoint Range callables for snapshot-pinned pagination
+        #: (list()): later pages MUST stay on the endpoint that pinned
+        #: page 1's revision — a different replica may not have applied
+        #: that revision yet (or may have a higher compact floor)
+        self._range_per_ep = [
+            _RetryingCall(call, False, retries, retry_backoff_s,
+                          "/etcdserverpb.KV/Range", self.retries_sent)
+            if retries > 0 else call
+            for call in (
+                _traced_call(ch.unary_unary(
+                    "/etcdserverpb.KV/Range",
+                    request_serializer=p.RangeRequest.SerializeToString,
+                    response_deserializer=p.RangeResponse.FromString,
+                ))
+                for ch in self.channels
+            )
+        ] if self._multi else None
         self._txn = self._unary("/etcdserverpb.KV/Txn", p.TxnRequest, p.TxnResponse,
                                 write=True)
         self._compact = self._unary("/etcdserverpb.KV/Compact", p.CompactionRequest, p.CompactionResponse,
                                     write=True)
-        raw_watch = self.channel.stream_stream(
-            "/etcdserverpb.Watch/Watch",
-            request_serializer=p.WatchRequest.SerializeToString,
-            response_deserializer=p.WatchResponse.FromString,
-        )
-        self._watch = _traced_call(raw_watch)
+        self._watch = self._stream(
+            "/etcdserverpb.Watch/Watch", p.WatchRequest, p.WatchResponse)
         self._lease_grant = self._unary(
             "/etcdserverpb.Lease/LeaseGrant", p.LeaseGrantRequest, p.LeaseGrantResponse,
             write=True)
@@ -201,23 +305,62 @@ class EtcdCompatClient:
             p.LeaseTimeToLiveRequest, p.LeaseTimeToLiveResponse)
         self._lease_leases = self._unary(
             "/etcdserverpb.Lease/LeaseLeases", p.LeaseLeasesRequest, p.LeaseLeasesResponse)
-        self._lease_keepalive = _traced_call(self.channel.stream_stream(
+        self._lease_keepalive = self._stream(
             "/etcdserverpb.Lease/LeaseKeepAlive",
-            request_serializer=p.LeaseKeepAliveRequest.SerializeToString,
-            response_deserializer=p.LeaseKeepAliveResponse.FromString,
-        ))
+            p.LeaseKeepAliveRequest, p.LeaseKeepAliveResponse)
+
+    # ------------------------------------------------- endpoint selection
+    def _next_endpoint(self) -> int:
+        with self._ep_lock:
+            i = self._ep_rr
+            self._ep_rr += 1
+            return i
+
+    def _note_failover(self, method: str) -> None:
+        with self._ep_lock:
+            self.endpoint_failovers += 1
+            self.failovers_by_method[method] += 1
+
+    def _note_endpoint_revision(self, target: str, resp) -> None:
+        header = getattr(resp, "header", None)
+        rev = int(getattr(header, "revision", 0) or 0)
+        if not rev:
+            return
+        with self._ep_lock:
+            if rev > self.max_header_revision.get(target, 0):
+                self.max_header_revision[target] = rev
 
     def _unary(self, method, req, resp, write: bool = False):
-        call = _traced_call(self.channel.unary_unary(
-            method,
-            request_serializer=req.SerializeToString,
-            response_deserializer=resp.FromString,
-        ))
+        calls = [
+            _traced_call(ch.unary_unary(
+                method,
+                request_serializer=req.SerializeToString,
+                response_deserializer=resp.FromString,
+            ))
+            for ch in self.channels
+        ]
+        if self._multi:
+            call = _FailoverCall(self, calls, self._endpoints, write, method)
+        else:
+            call = calls[0]
         if self._retry_budget > 0:
             call = _RetryingCall(call, write, self._retry_budget,
                                  self._retry_backoff_s, method,
                                  self.retries_sent)
         return call
+
+    def _stream(self, method, req, resp):
+        calls = [
+            _traced_call(ch.stream_stream(
+                method,
+                request_serializer=req.SerializeToString,
+                response_deserializer=resp.FromString,
+            ))
+            for ch in self.channels
+        ]
+        if self._multi:
+            return _RotatingStreamCall(self, calls)
+        return calls[0]
 
     # --------------------------------------------------------------- writes
     @staticmethod
@@ -296,8 +439,10 @@ class EtcdCompatClient:
         self._compact(rpc_pb2.CompactionRequest(revision=revision))
 
     # ---------------------------------------------------------------- reads
-    def get(self, key: bytes, revision: int = 0) -> ClientKV | None:
-        r = self._range(rpc_pb2.RangeRequest(key=key, revision=revision))
+    def get(self, key: bytes, revision: int = 0,
+            serializable: bool = False) -> ClientKV | None:
+        r = self._range(rpc_pb2.RangeRequest(key=key, revision=revision,
+                                             serializable=serializable))
         if not r.kvs:
             return None
         kv = r.kvs[0]
@@ -306,23 +451,60 @@ class EtcdCompatClient:
     def list(
         self, start: bytes, end: bytes, revision: int = 0, limit: int = 0,
         page: int = 1000, stats: dict | None = None,
+        serializable: bool = False,
     ) -> tuple[list[ClientKV], int]:
         """Paginated list; returns (kvs, list_revision). ``stats`` (if
         given) has its ``"rpcs"`` entry incremented per Range RPC *issued*
         (before the call, so shed/errored pages are still counted) — the
         workload harness reconciles client-side RPC counts against the
         server's /metrics, which counts failed RPCs too, and pagination
-        makes ops != RPCs."""
+        makes ops != RPCs. ``serializable`` marks the read bounded-
+        staleness-tolerant: a replica serves it locally at its applied
+        watermark instead of fencing on the leader (docs/replication.md);
+        later pages pin the first page's snapshot revision either way.
+
+        Multi-endpoint clients pin the whole pagination to ONE endpoint:
+        once page 1 pinned a snapshot revision, a different replica may
+        not have applied it yet (bounded wait then a future-revision
+        refusal) or may have compacted/bootstrapped above it — so only
+        the FIRST page fails over (safe-classified errors rotate to the
+        next endpoint, counted in ``endpoint_failovers``)."""
         out: list[ClientKV] = []
         key = start
         list_rev = revision
+        if self._range_per_ep is not None:
+            n = len(self._range_per_ep)
+            ep = self._next_endpoint() % n
+        first_attempts = 0
         while True:
             want = min(page, limit - len(out)) if limit else page
             if stats is not None:
                 stats["rpcs"] = stats.get("rpcs", 0) + 1
-            r = self._range(rpc_pb2.RangeRequest(
-                key=key, range_end=end, revision=list_rev, limit=want
-            ))
+            req = rpc_pb2.RangeRequest(
+                key=key, range_end=end, revision=list_rev, limit=want,
+                serializable=serializable,
+            )
+            if self._range_per_ep is None:
+                r = self._range(req)
+            else:
+                try:
+                    r = self._range_per_ep[ep](req)
+                except grpc.RpcError as e:
+                    first_attempts += 1
+                    if (not out and list_rev == revision
+                            and first_attempts < n
+                            and classify_rpc_error(e, False) == "safe"):
+                        # nothing pinned yet: rotate like _FailoverCall.
+                        # endpoint_failovers only — the retried page is
+                        # already counted in the caller's stats["rpcs"],
+                        # so failovers_by_method (which reconciles as an
+                        # EXTRA server-side RPC) must not count it twice
+                        ep = (ep + 1) % n
+                        with self._ep_lock:
+                            self.endpoint_failovers += 1
+                        continue
+                    raise
+                self._note_endpoint_revision(self._endpoints[ep], r)
             if list_rev == 0:
                 list_rev = r.header.revision  # pin the snapshot for later pages
             out.extend(ClientKV(kv.key, kv.value, kv.mod_revision) for kv in r.kvs)
@@ -331,20 +513,32 @@ class EtcdCompatClient:
             key = r.kvs[-1].key + b"\x00"
 
     def list_unpaged(
-        self, start: bytes, end: bytes, revision: int = 0
+        self, start: bytes, end: bytes, revision: int = 0,
+        serializable: bool = False,
     ) -> tuple[list[ClientKV], int]:
         """One unpaged Range (limit=0) — the informer-relist/snapshot shape
         the scheduler classifies BACKGROUND. ``list()`` always pages and so
         always rides the NORMAL lane; replaying realistic relist storms
         needs the heavyweight shape on the wire."""
         r = self._range(rpc_pb2.RangeRequest(
-            key=start, range_end=end, revision=revision))
+            key=start, range_end=end, revision=revision,
+            serializable=serializable))
         return ([ClientKV(kv.key, kv.value, kv.mod_revision) for kv in r.kvs],
                 r.header.revision)
 
-    def count(self, start: bytes, end: bytes) -> int:
-        r = self._range(rpc_pb2.RangeRequest(key=start, range_end=end, count_only=True))
+    def count(self, start: bytes, end: bytes,
+              serializable: bool = False) -> int:
+        r = self._range(rpc_pb2.RangeRequest(key=start, range_end=end,
+                                             count_only=True,
+                                             serializable=serializable))
         return r.count
+
+    def current_revision(self) -> int:
+        """The server's committed revision (one linearizable empty-count
+        Range) — the replica harness's fence-probe anchor."""
+        return self._range(rpc_pb2.RangeRequest(
+            key=b"\x00kb-probe", range_end=b"\x00kb-probe0",
+            count_only=True)).header.revision
 
     def partition_borders(self, start: bytes, end: bytes) -> list[bytes]:
         """Storage partition borders (magic revision; reference kv.go:33)."""
@@ -532,7 +726,8 @@ class EtcdCompatClient:
         return events(), cancel
 
     def close(self) -> None:
-        self.channel.close()
+        for ch in self.channels:
+            ch.close()
 
 
 class LeaseHandle:
@@ -639,10 +834,10 @@ class MuxWatch:
 
     __slots__ = ("key", "range_end", "start_revision", "watch_id", "events",
                  "cancelled", "last_revision", "ready", "resumes",
-                 "revisions", "baselined", "stream")
+                 "revisions", "baselined", "stream", "prev_kv", "sink")
 
     def __init__(self, key: bytes, range_end: bytes, start_revision: int = 0,
-                 record: bool = False):
+                 record: bool = False, prev_kv: bool = False, sink=None):
         self.key = key
         self.range_end = range_end
         self.start_revision = start_revision
@@ -658,6 +853,14 @@ class MuxWatch:
         #: decide ownership, so one watch can never be re-registered on
         #: two live streams (set by _send_create)
         self.stream: object | None = None
+        #: request prev_kv on (re-)registration (replication needs delete
+        #: fidelity for the follower's own watchers)
+        self.prev_kv = prev_kv
+        #: optional delivery callback ``sink(events, header_revision)``,
+        #: invoked on the reader thread IN ORDER — event batches with the
+        #: wire events, progress marks with an empty tuple. The follower
+        #: replication stream is the consumer (docs/replication.md).
+        self.sink = sink
 
     def resume_revision(self) -> int:
         """Where a re-registration must start so no event is lost or
@@ -695,6 +898,7 @@ class _WatchMuxStream:
         req.create_request.key = w.key
         req.create_request.range_end = w.range_end
         req.create_request.start_revision = start_revision
+        req.create_request.prev_kv = w.prev_kv
         with self._lock:
             if self.dead:
                 raise TimeoutError("watch mux stream is dead")
@@ -702,9 +906,22 @@ class _WatchMuxStream:
             self._pending.append(w)
             self._requests.put(req)
 
+    def request_progress(self) -> None:
+        """Ask the server for ordered per-watch progress marks (bare
+        headers carrying the fully-flushed floor, delivered through each
+        watch's own queue so they cannot overtake owed events)."""
+        req = rpc_pb2.WatchRequest()
+        req.progress_request.SetInParent()
+        with self._lock:
+            if self.dead:
+                return
+            self._requests.put(req)
+
     def add(self, key: bytes, range_end: bytes, start_revision: int,
-            timeout: float, record: bool = False) -> MuxWatch:
-        w = MuxWatch(key, range_end, start_revision, record=record)
+            timeout: float, record: bool = False, prev_kv: bool = False,
+            sink=None) -> MuxWatch:
+        w = MuxWatch(key, range_end, start_revision, record=record,
+                     prev_kv=prev_kv, sink=sink)
         self._send_create(w, start_revision)
         if not w.ready.wait(timeout):
             raise TimeoutError(
@@ -745,11 +962,29 @@ class _WatchMuxStream:
                     with self._lock:
                         w = self._by_id.get(resp.watch_id)
                     if w is not None:
+                        # sink BEFORE advancing the resume watermark: a
+                        # consumer crash mid-apply must re-receive this
+                        # batch after the revive, never skip it
+                        if w.sink is not None:
+                            w.sink(list(resp.events), resp.header.revision)
                         w.events += len(resp.events)
                         w.last_revision = resp.header.revision
                         if w.revisions is not None:
                             w.revisions.extend(
                                 ev.kv.mod_revision for ev in resp.events)
+                elif not resp.created and not resp.canceled:
+                    # bare header on a registered watch id = ordered
+                    # progress mark: everything <= header.revision was
+                    # already delivered on this stream, so the resume
+                    # watermark may advance across the leader's revision
+                    # gaps (watch_id -1 stream-level headers miss the map
+                    # and are ignored)
+                    with self._lock:
+                        w = self._by_id.get(resp.watch_id)
+                    if w is not None and resp.header.revision > w.last_revision:
+                        if w.sink is not None:
+                            w.sink((), resp.header.revision)
+                        w.last_revision = resp.header.revision
                 if resp.canceled and not resp.created:
                     with self._lock:
                         w = self._by_id.pop(resp.watch_id, None)
@@ -835,15 +1070,23 @@ class WatchMux:
         self._rr = 0
 
     def add(self, key: bytes, range_end: bytes = b"", start_revision: int = 0,
-            shard: int | None = None, timeout: float = 30.0) -> MuxWatch:
+            shard: int | None = None, timeout: float = 30.0,
+            prev_kv: bool = False, sink=None) -> MuxWatch:
         if shard is None:
             shard, self._rr = self._rr, self._rr + 1
         s = self._streams[shard % len(self._streams)]
         w = s.add(key, range_end, start_revision, timeout,
-                  record=self._record)
+                  record=self._record, prev_kv=prev_kv, sink=sink)
         with self._all_lock:
             self._all.append(w)
         return w
+
+    def request_progress(self) -> None:
+        """Ordered per-watch progress marks from every live stream (the
+        replication stream's watermark-advance tick)."""
+        for s in self._streams:
+            if not s.dead:
+                s.request_progress()
 
     def _revive(self, dead_stream: "_WatchMuxStream",
                 stranded: list[MuxWatch]) -> None:

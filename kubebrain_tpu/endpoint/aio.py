@@ -201,7 +201,11 @@ class AioWatchService:
                     # event-driven: at 10k idle streams, a 0.5s poll per pump
                     # is 20k timer events/s of pure loop overhead
                     batch = await q.get()
-                if batch is None:
+                if batch is None or getattr(q, "kb_dropped", False):
+                    # the drop flag is checked BEFORE every delivery so
+                    # buffered batches past the drop point never reach the
+                    # wire — the delivered sequence stays a prefix (the
+                    # hub drop protocol's no-invisible-gap contract)
                     await out.put(dropped_response(self.backend.current_revision(), watch_id))
                     return
                 resp = events_response(batch, watch_id, want_prev, no_put, no_delete)

@@ -25,9 +25,13 @@ from .schedule import (
     ALL_KINDS,
     CONN_DROP,
     ENCODE_OVERFLOW,
+    FENCE_TIMEOUT,
+    LEADER_UNREACH,
     MERGE_FAIL,
     MERGE_SUPPRESS,
     PRESETS,
+    REPL_RESET,
+    REPLICA_KINDS,
     STORAGE_ERROR,
     STORAGE_LATENCY,
     STORAGE_UNCERTAIN,
@@ -42,5 +46,6 @@ __all__ = [
     "FaultSchedule", "FaultWindow", "generate", "PRESETS", "ALL_KINDS",
     "STORAGE_LATENCY", "STORAGE_ERROR", "STORAGE_UNCERTAIN",
     "WATCH_RESET", "CONN_DROP", "MERGE_FAIL", "MERGE_SUPPRESS",
-    "ENCODE_OVERFLOW",
+    "ENCODE_OVERFLOW", "REPL_RESET", "LEADER_UNREACH", "FENCE_TIMEOUT",
+    "REPLICA_KINDS",
 ]

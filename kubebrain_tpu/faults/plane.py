@@ -217,6 +217,47 @@ class FaultPlane:
                 return True
         return False
 
+    # -------------------------------------------------------- replica role
+    def repl_reset(self) -> bool:
+        """Tear the follower's replication stream down (checked once per
+        stream ticker tick): the next pass must resume from the applied
+        watermark + 1 with no event lost or duplicated."""
+        t = self._elapsed_ms()
+        if t is None:
+            return False
+        for w in self.schedule.active(t, _sched.REPL_RESET):
+            if self._roll(w.rate):
+                self._count(_sched.REPL_RESET)
+                return True
+        return False
+
+    def leader_unreachable(self) -> bool:
+        """Window gate consulted before every leader-touching action on a
+        follower (fence fetch, write/lease forward, stream reconnect).
+        Counted per gated action — both counter views (plane state and
+        /metrics) increment together, so the chaos reconcile stays exact."""
+        t = self._elapsed_ms()
+        if t is None:
+            return False
+        for w in self.schedule.active(t, _sched.LEADER_UNREACH):
+            if self._roll(w.rate):
+                self._count(_sched.LEADER_UNREACH)
+                return True
+        return False
+
+    def fence_timeout(self) -> bool:
+        """Force a linearizable-read fence to report the follower stale
+        (checked once per fence): the read must REFUSE, proving bounded
+        staleness degrades to refusals, never stale answers."""
+        t = self._elapsed_ms()
+        if t is None:
+            return False
+        for w in self.schedule.active(t, _sched.FENCE_TIMEOUT):
+            if self._roll(w.rate):
+                self._count(_sched.FENCE_TIMEOUT)
+                return True
+        return False
+
     def encode_overflow(self) -> bool:
         t = self._elapsed_ms()
         if t is None:

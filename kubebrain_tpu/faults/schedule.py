@@ -36,19 +36,28 @@ MERGE_FAIL = "merge_fail"              # background delta merge raises
 MERGE_SUPPRESS = "merge_suppress"      # merges suppressed: delta overlay grows
 ENCODE_OVERFLOW = "encode_overflow"    # forced EncodeOverflow -> re-dictionary
 COMPACT_FAIL = "compact_fail"          # compaction's mirror merge raises
+#: replica (follower-role) boundary — docs/replication.md
+REPL_RESET = "repl_reset"              # replication stream torn down client-side
+LEADER_UNREACH = "leader_unreachable"  # fence/forward/stream gated off
+FENCE_TIMEOUT = "fence_timeout"        # linearizable-read fences forced stale
 
 ALL_KINDS = (
     STORAGE_LATENCY, STORAGE_ERROR, STORAGE_UNCERTAIN,
     WATCH_RESET, CONN_DROP,
     MERGE_FAIL, MERGE_SUPPRESS, ENCODE_OVERFLOW, COMPACT_FAIL,
+    REPL_RESET, LEADER_UNREACH, FENCE_TIMEOUT,
 )
+
+#: kinds that only act on a --role follower process (the chaos runner arms
+#: followers with the `replica` preset; on a leader they never fire)
+REPLICA_KINDS = (REPL_RESET, LEADER_UNREACH, FENCE_TIMEOUT)
 
 #: kinds that fire at the storage write boundary
 WRITE_KINDS = (STORAGE_LATENCY, STORAGE_ERROR, STORAGE_UNCERTAIN)
 #: kinds that fire at the storage read boundary (reads are never uncertain)
 READ_KINDS = (STORAGE_LATENCY, STORAGE_ERROR)
 
-PRESETS = ("none", "smoke", "storage", "watch", "merge", "full")
+PRESETS = ("none", "smoke", "storage", "watch", "merge", "full", "replica")
 
 
 @dataclass(frozen=True)
@@ -174,6 +183,23 @@ def generate(preset: str, seed: int, horizon_s: float) -> FaultSchedule:
         # quarantine+rebuild escalation path (docs/compaction.md)
         windows += _spread(rng, horizon_ms, COMPACT_FAIL,
                            1, 0.8, 1.0, lo=0.05, hi=0.95)
+    if preset == "replica":
+        # follower-role chaos (docs/replication.md). Windows are laid
+        # DISJOINT by design: a replication reset while the leader is
+        # "unreachable" would just be the same outage twice, and the
+        # fence-timeout window must meet a HEALTHY stream so it proves the
+        # refusal path, not the outage. Early replication resets exercise
+        # resume-from-watermark; the mid-run unreachable window grows lag
+        # until bounded-staleness refusals provably fire; the late window
+        # forces fences stale while serving is otherwise healthy.
+        # wide enough that several 0.2s stream-ticker ticks land inside
+        # each window even on a smoke-sized horizon
+        windows += _spread(rng, horizon_ms, REPL_RESET,
+                           2, 0.3, 0.6, lo=0.02, hi=0.42)
+        windows += _spread(rng, horizon_ms, LEADER_UNREACH,
+                           1, 0.5, 1.0, lo=0.45, hi=0.70)
+        windows += _spread(rng, horizon_ms, FENCE_TIMEOUT,
+                           1, 0.6, 1.0, lo=0.75, hi=1.0)
     # canonical order: by (t0, kind) so generation insertion order can't
     # leak into the trace identity
     windows.sort(key=lambda w: (w.t0_ms, w.kind, w.t1_ms))

@@ -29,14 +29,18 @@ class Server:
         identity: str = "kubebrain-tpu",
         client_urls: list[str] | None = None,
         compact_interval: float = 60.0,
+        replica=None,
     ):
         self.backend = backend
         self.peers = peers
         self.metrics = metrics or NoopMetrics()
         self.identity = identity
+        #: follower role (kubebrain_tpu/replica; docs/replication.md)
+        self.replica = replica
         self.brain = BrainServer(backend, peers, compact_interval=compact_interval)
         self.grpc_handlers = (
-            make_etcd_handlers(backend, peers, identity, client_urls or [])
+            make_etcd_handlers(backend, peers, identity, client_urls or [],
+                               replica=replica)
             + make_brain_handlers(self.brain)
             + [self._health_handler()]
         )
@@ -117,7 +121,7 @@ class Server:
         return "application/json", json.dumps({"health": "true"}).encode()
 
     def _status(self):
-        return "application/json", json.dumps({
+        payload = {
             "revision": self.backend.current_revision(),
             "compact_revision": self.backend.compact_revision(),
             "is_leader": self.peers.is_leader(),
@@ -125,7 +129,12 @@ class Server:
             "identity": self.identity,
             "watchers": self.backend.watcher_hub.watcher_count(),
             "version": __version__,
-        }).encode()
+        }
+        if self.replica is not None:
+            # follower: replication watermark/lag + served/forwarded/
+            # refused counters (the workload harness's per-replica view)
+            payload["replica"] = self.replica.status()
+        return "application/json", json.dumps(payload).encode()
 
     def _election(self):
         return "application/json", json.dumps({

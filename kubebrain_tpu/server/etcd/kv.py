@@ -63,9 +63,14 @@ class _RawResponse(bytes):
 
 
 class KVService:
-    def __init__(self, backend: Backend, peers=None, limiter=None):
+    def __init__(self, backend: Backend, peers=None, limiter=None,
+                 replica=None):
         self.backend = backend
         self.peers = peers  # PeerService: leader check / proxy / revision sync
+        #: follower role (kubebrain_tpu/replica): per-RPC routing — reads
+        #: gate on the replication watermark and then ride the SAME
+        #: scheduler lanes below; writes/compaction forward to the leader
+        self.replica = replica
         # the device-aware request scheduler: every range read goes through
         # its admission lanes (kblint KB106). All services over one backend
         # share one scheduler, or priority lanes mean nothing.
@@ -94,6 +99,14 @@ class KVService:
             single_key = not range_end
             if range_end == b"\x00":
                 range_end = b""
+        if (self.replica is not None
+                and request.revision != PARTITION_MAGIC_REVISION):
+            # follower read gate (docs/replication.md): explicit revisions
+            # <= watermark and bounded-staleness serializable reads serve
+            # locally; rev-0 linearizable reads fence on the leader's
+            # committed revision first; past-bound lag REFUSES (clients
+            # fail over) instead of answering stale
+            self._replica_gate(request, context)
         try:
             if request.count_only:
                 if not self.backend.config.enable_etcd_compatibility:
@@ -128,6 +141,28 @@ class KVService:
             context.abort(grpc.StatusCode.OUT_OF_RANGE, ERR_COMPACTED)
         except FutureRevisionError:
             context.abort(grpc.StatusCode.OUT_OF_RANGE, ERR_FUTURE_REV)
+
+    def _replica_gate(self, request, context) -> None:
+        from ...replica import (
+            FutureRevisionWaitError,
+            ReplicaRefusedError,
+        )
+
+        try:
+            self.replica.gate_read(int(request.revision),
+                                   bool(request.serializable))
+        except FutureRevisionWaitError:
+            # same wire shape a leader gives for a revision it has not
+            # dealt yet: the client's classification (definite) and the
+            # apiserver's re-list behavior both already handle it
+            context.abort(grpc.StatusCode.OUT_OF_RANGE, ERR_FUTURE_REV)
+        except ReplicaRefusedError as e:
+            # etcdserver:-prefixed UNAVAILABLE = processed-and-refused,
+            # provably nothing served: classify_rpc_error calls it safe,
+            # so multi-endpoint clients fail over to the next replica
+            context.abort(grpc.StatusCode.UNAVAILABLE,
+                          f"etcdserver: replica refused ({e.reason}): {e}")
+        self.replica.note_served("range")
 
     def _get(self, request) -> rpc_pb2.RangeResponse:
         try:
@@ -218,6 +253,12 @@ class KVService:
 
     def _txn(self, request: rpc_pb2.TxnRequest, context) -> rpc_pb2.TxnResponse:
         with TRACER.stage("endpoint_recv"):
+            if self.replica is not None:
+                # follower role: every write forwards to the leader with
+                # status passthrough — the client's safe-vs-ambiguous
+                # classification must see exactly what a direct call would
+                # (docs/replication.md)
+                return self.replica.forward_unary("txn", request, context)
             if self.peers is not None and not self.peers.is_leader():
                 fwd = self.peers.forward_txn(request)
                 if fwd is not None:
@@ -365,6 +406,10 @@ class KVService:
 
     # ----------------------------------------------------------------- Compact
     def Compact(self, request: rpc_pb2.CompactionRequest, context) -> rpc_pb2.CompactionResponse:
+        if self.replica is not None:
+            # compaction is the leader's job; the follower adopts the new
+            # watermark through the replication stream's compact sync
+            return self.replica.forward_unary("compact", request, context)
         if self.peers is not None and not self.peers.is_leader():
             # compaction is the leader's job; accept and no-op on followers
             return rpc_pb2.CompactionResponse(

@@ -46,9 +46,14 @@ class LeaseService:
     cascading failure admission control exists to prevent.
     """
 
-    def __init__(self, backend, peers=None):
+    def __init__(self, backend, peers=None, replica=None):
         self.backend = backend
         self.peers = peers
+        #: follower role (kubebrain_tpu/replica): lease state lives on the
+        #: leader, so every lease RPC forwards there with status passthrough
+        #: — unlike the election-follower refusal below, a replica-role
+        #: follower is a full serving endpoint for lease clients
+        self.replica = replica
         self.registry = ensure_lease(backend, peers=peers)
         self.reaper = backend._kb_lease_reaper
         self.limiter = ensure_scheduler(backend)
@@ -60,6 +65,8 @@ class LeaseService:
             context.abort(grpc.StatusCode.UNAVAILABLE, ERR_NOT_LEADER)
 
     def LeaseGrant(self, request, context) -> rpc_pb2.LeaseGrantResponse:
+        if self.replica is not None:
+            return self.replica.forward_unary("lease_grant", request, context)
         with TRACER.span("etcd.Lease/LeaseGrant",
                          traceparent=traceparent_of(context)):
             with TRACER.stage("endpoint_recv"):
@@ -78,6 +85,8 @@ class LeaseService:
                 )
 
     def LeaseRevoke(self, request, context) -> rpc_pb2.LeaseRevokeResponse:
+        if self.replica is not None:
+            return self.replica.forward_unary("lease_revoke", request, context)
         with TRACER.span("etcd.Lease/LeaseRevoke",
                          traceparent=traceparent_of(context)):
             with TRACER.stage("endpoint_recv"):
@@ -96,6 +105,12 @@ class LeaseService:
                 )
 
     def LeaseKeepAlive(self, request_iterator, context):
+        if self.replica is not None:
+            # the whole stream pipes through the leader (the etcd-proxy
+            # watch-piping shape applied to keepalives)
+            yield from self.replica.forward_keepalive(request_iterator,
+                                                      context)
+            return
         tp = traceparent_of(context)
         try:
             for req in request_iterator:
@@ -127,6 +142,8 @@ class LeaseService:
                 )
 
     def LeaseTimeToLive(self, request, context) -> rpc_pb2.LeaseTimeToLiveResponse:
+        if self.replica is not None:
+            return self.replica.forward_unary("lease_ttl", request, context)
         with TRACER.span("etcd.Lease/LeaseTimeToLive",
                          traceparent=traceparent_of(context)):
             self._check_leader(context)  # a follower's table is stale
@@ -143,6 +160,8 @@ class LeaseService:
                 return resp
 
     def LeaseLeases(self, request, context) -> rpc_pb2.LeaseLeasesResponse:
+        if self.replica is not None:
+            return self.replica.forward_unary("lease_leases", request, context)
         with TRACER.span("etcd.Lease/LeaseLeases",
                          traceparent=traceparent_of(context)):
             self._check_leader(context)  # a follower's table is stale

@@ -30,12 +30,15 @@ def _bidi(fn, req_cls, resp_cls):
     )
 
 
-def make_etcd_handlers(backend, peers=None, identity="kubebrain-tpu", client_urls=None):
+def make_etcd_handlers(backend, peers=None, identity="kubebrain-tpu",
+                       client_urls=None, replica=None):
     """Generic handlers for the etcd3 surface; mount with
-    ``server.add_generic_rpc_handlers``."""
-    kv = KVService(backend, peers)
-    watch = WatchService(backend, peers)
-    lease = LeaseService(backend, peers)
+    ``server.add_generic_rpc_handlers``. ``replica`` (a FollowerRole)
+    switches the per-RPC routing to follower mode: local/fence/forward
+    (docs/replication.md)."""
+    kv = KVService(backend, peers, replica=replica)
+    watch = WatchService(backend, peers, replica=replica)
+    lease = LeaseService(backend, peers, replica=replica)
     cluster = ClusterService(backend, identity, client_urls)
     maint = MaintenanceService(backend)
     p = rpc_pb2

@@ -20,6 +20,7 @@ import queue
 import threading
 
 from ...backend import Backend, WatchExpiredError
+from ...backend.watcherhub import ProgressMarker
 from ...proto import rpc_pb2
 from ...trace import emit_histogram
 from . import shim
@@ -60,11 +61,17 @@ def compacted_response(current_revision, compact_revision, watch_id):
 
 
 class WatchService:
-    def __init__(self, backend: Backend, peers=None):
+    def __init__(self, backend: Backend, peers=None, replica=None):
         self.backend = backend
         self.peers = peers
+        #: follower role: watches serve from the LOCAL pipeline — the
+        #: replication applier feeds the local cache + hub, so follower
+        #: watchers ride the same fan-out machinery as on the leader
+        self.replica = replica
 
     def Watch(self, request_iterator, context):
+        if self.replica is not None:
+            self.replica.note_served("watch")
         if self.peers is not None and not self.peers.is_leader():
             # followers serve watches from the leader's pipeline
             # (reference etcd_proxy.go:239: watch forwarding)
@@ -81,7 +88,12 @@ class WatchService:
         try:
             while True:
                 item = out.get()
-                if item is None:
+                # poisoned (a _send could not deliver in order): stop
+                # BEFORE yielding, so the wire sequence stays a strict
+                # PREFIX of the enqueued order — truncation is harmless
+                # (clients resume from their last delivered revision),
+                # an internal gap is not (docs/replication.md)
+                if item is None or session.poisoned:
                     return
                 yield item
         finally:
@@ -100,6 +112,10 @@ class _WatchSession:
         self._watches: dict[int, tuple[int, threading.Event]] = {}  # watch_id -> (hub wid, stop)
         self._next_id = 0
         self._closed = False
+        #: set when a response could not be enqueued in order: the stream
+        #: writer truncates at its next pop instead of delivering past an
+        #: invisible gap (set-once, read without the lock)
+        self.poisoned = False
 
     # --------------------------------------------------------------- requests
     def read_loop(self, request_iterator) -> None:
@@ -117,6 +133,13 @@ class _WatchSession:
                             watch_id=-1,
                         )
                     )
+                    # ordered per-watch progress marks (docs/replication.md):
+                    # the out-of-band -1 header above can overtake event
+                    # batches still in the per-watch queues, so replication
+                    # watermarks ride markers through those SAME queues —
+                    # a mark's revision is sound exactly because every owed
+                    # event was enqueued before it
+                    self._post_progress()
         except Exception:
             pass  # stream closed / client gone
         self._send(None)
@@ -181,6 +204,29 @@ class _WatchSession:
         )
         pump.start()
 
+    def _post_progress(self) -> None:
+        """Queue a ProgressMarker for each of this session's watches at the
+        sequencer's fully-flushed floor. The floor read returns -1 while
+        the drainer is mid-pass — retry briefly; under sustained writes
+        the events themselves advance the client's watermark, so giving up
+        is only a skipped idle-time mark, never a correctness issue. A
+        floor of 0 (fresh store, nothing ever written) is valid but not
+        worth a mark — watermarks start at 0."""
+        import time as _time
+
+        rev = -1
+        for _ in range(50):
+            rev = self.backend.flushed_revision()
+            if rev >= 0:
+                break
+            _time.sleep(0.002)
+        if rev <= 0:
+            return
+        with self._lock:
+            hub_wids = [wid for wid, _stop in self._watches.values()]
+        for hw in hub_wids:
+            self.backend.watcher_hub.post_progress(hw, rev)
+
     # ----------------------------------------------------------------- pumps
     PROGRESS_INTERVAL = 60.0  # etcd sends ~10min; apiserver only needs "periodic"
 
@@ -213,12 +259,27 @@ class _WatchSession:
                         )
                     )
                 continue
-            if batch is None:
-                # hub dropped us (slow consumer) or backend closed: cancel so
-                # the client re-watches (watcherhub.go:82-90)
+            if batch is None or getattr(q, "kb_dropped", False):
+                # hub dropped us (slow consumer) or backend closed: cancel
+                # so the client re-watches (watcherhub.go:82-90). The
+                # dropped flag is checked BEFORE every delivery so batches
+                # buffered past the drop point are never sent — the
+                # delivered sequence stays a prefix (the drop protocol's
+                # no-invisible-gap contract, watcherhub.delete_watcher)
                 self._send(dropped_response(self.backend.current_revision(), watch_id))
                 self._remove(watch_id)
                 return
+            if isinstance(batch, ProgressMarker):
+                # ordered progress mark: bare header on THIS watch id,
+                # after every owed event (queue FIFO carries the proof)
+                last_sent = _time.monotonic()
+                self._send(
+                    rpc_pb2.WatchResponse(
+                        header=shim.header(batch.revision),
+                        watch_id=watch_id,
+                    )
+                )
+                continue
             resp = events_response(batch, watch_id, want_prev, no_put, no_delete)
             if resp is not None:
                 last_sent = _time.monotonic()
@@ -313,7 +374,21 @@ class _WatchSession:
         try:
             self.out.put(item, timeout=5.0)
         except queue.Full:
-            pass  # stream writer wedged; the gRPC context will cancel us
+            # Stream writer wedged. Silently dropping one response would
+            # open an invisible GAP in a delivered-in-order stream: a
+            # later response (an event batch, or worse a progress mark)
+            # would vouch for revisions the client never received, and a
+            # resume watermark would skip them forever — the replica
+            # watermark-corruption shape (docs/replication.md). Evicting
+            # queued responses to fit a pill is just as gappy (the
+            # consumer races the eviction and can deliver a newer queued
+            # response after an older one was discarded). Poison the
+            # session instead: the stream writer truncates BEFORE its
+            # next delivery, so the wire sequence is a strict prefix of
+            # the enqueued order — the client sees the stream end and
+            # resumes from its last delivered revision, losing nothing.
+            self.poisoned = True
+            self.close()
 
     def close(self) -> None:
         with self._lock:

@@ -87,6 +87,13 @@ class HttpRevisionSyncer:
             flight.set()
 
     def _fetch(self) -> int:
+        return int(self.fetch_status()["revision"])
+
+    def fetch_status(self) -> dict:
+        """The leader's full /status payload, with http/https schema
+        auto-probing + per-address caching — the ONE transport for every
+        leader-status consumer (the follower fence and the replication
+        stream's compact sync share it; docs/replication.md)."""
         address = self._get_leader_address()
         if not address:
             raise RevisionSyncError("no leader")
@@ -97,14 +104,14 @@ class HttpRevisionSyncer:
             if schema is None:
                 continue
             try:
-                rev = self._fetch_one(f"{schema}://{address}/status")
+                payload = self._fetch_one(f"{schema}://{address}/status")
                 self._schema_cache[address] = schema
-                return rev
+                return payload
             except BaseException as e:  # wrong schema / transient: try next
                 last_err = e
         raise RevisionSyncError(f"leader /status unreachable: {last_err}")
 
-    def _fetch_one(self, url: str) -> int:
+    def _fetch_one(self, url: str) -> dict:
         import ssl
 
         ctx = None
@@ -113,5 +120,4 @@ class HttpRevisionSyncer:
             ctx.check_hostname = False
             ctx.verify_mode = ssl.CERT_NONE  # peer identity comes from the lock record
         with urllib.request.urlopen(url, timeout=self._timeout, context=ctx) as resp:
-            payload = json.loads(resp.read().decode())
-        return int(payload["revision"])
+            return json.loads(resp.read().decode())

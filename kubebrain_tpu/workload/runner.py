@@ -117,11 +117,14 @@ class _Stats:
 
 
 class _Shard(threading.Thread):
-    """One worker thread + one channel + a bounded op queue."""
+    """One worker thread + one client + a bounded op queue. ``target`` may
+    be a list of endpoints: the client then round-robins with safe-only
+    failover (the replica topology's load-balanced apiserver shape)."""
 
-    def __init__(self, name: str, target: str, qsize: int, stats: _Stats):
+    def __init__(self, name: str, target, qsize: int, stats: _Stats):
         super().__init__(name=name, daemon=True)
-        self.client = EtcdCompatClient(target)
+        self.client = (EtcdCompatClient(target) if isinstance(target, str)
+                       else EtcdCompatClient(endpoints=list(target)))
         self.q: queue.Queue = queue.Queue(maxsize=qsize)
         self._stats = stats
         self.start()
@@ -175,6 +178,23 @@ class WorkloadRunner:
         # /metrics lives on the target's host, not necessarily localhost
         self._info_host = (target.rsplit(":", 1)[0] if target
                            else "127.0.0.1")
+        # ---- read scale-out (docs/replication.md) ----
+        if spec.replicas and target:
+            raise ValueError(
+                "replicas>0 needs the runner to own the topology; "
+                "--target mode drives a single external server")
+        #: all endpoints, leader first; parallel info-port list. Single-
+        #: server runs keep one entry so every code path below is shared.
+        self._targets: list[str] = [target] if target else []
+        self._info_ports: list[int] = [info_port] if target else []
+        self._followers: list[subprocess.Popen] = []
+        self._rows_lock = threading.Lock()
+        self._rows_listed = 0
+        self._fence_probe_stop = threading.Event()
+        self._fence_probes: dict = {"count": 0, "ok": 0, "refused": 0,
+                                    "violations": 0}
+        self._lag_probe_samples: dict[str, list[int]] = {}
+        self._probe_clients: list[EtcdCompatClient] = []
         # ---- chaos mode (docs/faults.md) ----
         self.chaos = spec.faults != "none"
         #: the deterministic fault schedule this run declares (regenerated
@@ -273,11 +293,18 @@ class WorkloadRunner:
                     self._degraded_samples.setdefault(lane, []).append(dt)
         self.stats.record(kind, dt, outcome)
 
-    def _scrape(self) -> slo.PromSnapshot:
+    def _scrape(self, info_port: int | None = None) -> slo.PromSnapshot:
         with urllib.request.urlopen(
-            f"http://{self._info_host}:{self._info_port}/metrics", timeout=15
+            f"http://{self._info_host}:{info_port or self._info_port}/metrics",
+            timeout=15,
         ) as resp:
             return slo.parse_prom(resp.read().decode())
+
+    def _scrape_all(self) -> list:
+        """One snapshot per server, leader first (reconcile sums them via
+        slo.merge_snapshots; per-replica fields read the individual
+        follower snapshots)."""
+        return [self._scrape(port) for port in self._info_ports]
 
     # ------------------------------------------------------------ op bodies
     def _ns_bounds(self, ns: int) -> tuple[bytes, bytes]:
@@ -341,13 +368,27 @@ class WorkloadRunner:
             return None if ok else "conflict"
         return fn
 
+    def _note_rows(self, n: int) -> None:
+        with self._rows_lock:
+            self._rows_listed += n
+
+    @property
+    def _serializable(self) -> bool:
+        """With follower replicas, controller reads are bounded-staleness
+        (serializable) so they terminate ON the replica — the load the
+        read scale-out exists to absorb (docs/replication.md); the fence
+        probes keep the linearizable path honest in parallel."""
+        return bool(self.spec.replicas)
+
     def _do_ctrl_start(self, op):
         def fn(client):
             start, end = self._ns_bounds(op.ns)
             st: dict = {}
             try:
-                _kvs, rev = client.list(start, end, page=self.spec.list_limit,
-                                        stats=st)
+                kvs, rev = client.list(start, end, page=self.spec.list_limit,
+                                       stats=st,
+                                       serializable=self._serializable)
+                self._note_rows(len(kvs))
             finally:
                 # the server's rpc_server_count includes shed/errored RPCs,
                 # so the client must count attempts, not successes
@@ -362,8 +403,10 @@ class WorkloadRunner:
             start, end = self._ns_bounds(op.ns)
             st: dict = {}
             try:
-                client.list(start, end, limit=self.spec.list_limit,
-                            page=self.spec.list_limit, stats=st)
+                kvs, _rev = client.list(start, end, limit=self.spec.list_limit,
+                                        page=self.spec.list_limit, stats=st,
+                                        serializable=self._serializable)
+                self._note_rows(len(kvs))
             finally:
                 self._count_rpc("range", st.get("rpcs", 0))
         return fn
@@ -372,15 +415,19 @@ class WorkloadRunner:
         def fn(client):
             start, end = self._ns_bounds(op.ns)
             self._count_rpc("range")
-            client.list_unpaged(start, end)
+            kvs, _rev = client.list_unpaged(
+                start, end, serializable=self._serializable)
+            self._note_rows(len(kvs))
         return fn
 
     def _do_lease_list(self, _op):
         def fn(client):
             st: dict = {}
             try:
-                client.list(LEASE_PREFIX, coder.prefix_end(LEASE_PREFIX),
-                            page=1000, stats=st)
+                kvs, _rev = client.list(
+                    LEASE_PREFIX, coder.prefix_end(LEASE_PREFIX),
+                    page=1000, stats=st, serializable=self._serializable)
+                self._note_rows(len(kvs))
             finally:
                 self._count_rpc("range", st.get("rpcs", 0))
         return fn
@@ -416,39 +463,89 @@ class WorkloadRunner:
                               err="keepalive stream dead")
 
     # -------------------------------------------------------------- phases
-    def _spawn_server(self) -> None:
-        client_port = free_port()
-        self._info_port = free_port()
-        args = [sys.executable, "-m", "kubebrain_tpu.cli", "--single-node",
+    @property
+    def _follower_targets(self) -> list[str]:
+        return self._targets[1:]
+
+    def _spawn_one(self, role_args: list[str], chaos_args: list[str],
+                   env, stderr) -> tuple[subprocess.Popen, str, int]:
+        client_port, info_port = free_port(), free_port()
+        args = [sys.executable, "-m", "kubebrain_tpu.cli",
                 "--storage", self.spec.storage, "--host", "127.0.0.1",
                 "--client-port", str(client_port),
                 "--peer-port", str(free_port()),
-                "--info-port", str(self._info_port),
+                "--info-port", str(info_port),
                 # the replay owns compaction cadence; the server's own
                 # compactor would make the op trace's COMPACT accounting lie
                 "--compact-interval", "86400"]
-        if self.chaos:
-            # chaos mode: the server regenerates the SAME deterministic
-            # schedule (preset+seed+horizon); the /faults/arm echo is
-            # asserted against our local sha below
-            args += ["--faults", self.spec.faults,
-                     "--fault-seed", str(self.spec.fault_seed),
-                     "--fault-horizon-s", str(self._fault_horizon_s())]
-            if self.spec.storage == "tpu":
-                # a chaos-scale write count must actually cross the merge
-                # threshold, or the merge-fault windows never meet a merge
-                args += ["--merge-threshold", "32"]
+        args += role_args + chaos_args
         platform = os.environ.get("KB_WORKLOAD_JAX_PLATFORM", "cpu")
         if platform:
             args += ["--jax-platform", platform]
+        proc = subprocess.Popen(args, cwd=REPO_ROOT, stderr=stderr, env=env)
+        return proc, f"127.0.0.1:{client_port}", info_port
+
+    def _spawn_server(self) -> None:
+        spec = self.spec
+        chaos_args: list[str] = []
+        follower_chaos: list[str] = []
+        if self.chaos:
+            # chaos mode: the armed servers regenerate the SAME
+            # deterministic schedule (preset+seed+horizon); the /faults/arm
+            # echo is asserted against our local sha below. The `replica`
+            # preset arms the FOLLOWERS (its kinds act at the follower's
+            # replication/fence boundaries); every other preset arms the
+            # leader, exactly as before.
+            preset_args = ["--faults", spec.faults,
+                           "--fault-seed", str(spec.fault_seed),
+                           "--fault-horizon-s", str(self._fault_horizon_s())]
+            if spec.faults == "replica":
+                follower_chaos = preset_args
+            else:
+                chaos_args = preset_args
+                if spec.storage == "tpu":
+                    # a chaos-scale write count must actually cross the
+                    # merge threshold, or the merge-fault windows never
+                    # meet a merge
+                    chaos_args += ["--merge-threshold", "32"]
+        env = self._mesh_env()
+        stderr = subprocess.DEVNULL
+        if self._server_log:
+            stderr = open(self._server_log, "ab")  # noqa: SIM115
+        mesh_args = self._mesh_args()
+        self._server, self._target, self._info_port = self._spawn_one(
+            ["--single-node"] + mesh_args, chaos_args, env, stderr)
+        self._targets = [self._target]
+        self._info_ports = [self._info_port]
+        if spec.replicas:
+            self._probe()  # followers bootstrap FROM the leader
+            leader_info = f"127.0.0.1:{self._info_port}"
+            for _ in range(spec.replicas):
+                role = ["--role", "follower",
+                        "--leader-address", self._target,
+                        "--leader-info", leader_info,
+                        "--max-staleness-ms", str(spec.max_staleness_ms),
+                        "--max-staleness-rev", str(spec.max_staleness_rev),
+                        ] + mesh_args
+                proc, target, info = self._spawn_one(
+                    role, follower_chaos, env, stderr)
+                self._followers.append(proc)
+                self._targets.append(target)
+                self._info_ports.append(info)
+
+    def _mesh_args(self) -> list[str]:
+        args: list[str] = []
+        if self.spec.mesh_part:
+            args += ["--mesh-part", str(self.spec.mesh_part)]
+        if self.spec.scan_partitions:
+            args += ["--scan-partitions", str(self.spec.scan_partitions)]
+        return args
+
+    def _mesh_env(self):
         env = None
         if self.spec.mesh_part or self.spec.scan_partitions:
             # multichip sharded serving: cluster replay drives a part-
             # sharded server (docs/multichip.md)
-            if self.spec.mesh_part:
-                args += ["--mesh-part", str(self.spec.mesh_part)]
-            if self.spec.scan_partitions:
-                args += ["--scan-partitions", str(self.spec.scan_partitions)]
             if self.spec.mesh_part:
                 want_dev = self.spec.mesh_part
             else:
@@ -458,7 +555,7 @@ class WorkloadRunner:
                 want_dev = next(
                     (k for k in (8, 4, 2)
                      if self.spec.scan_partitions % k == 0), 1)
-            if platform == "cpu":
+            if os.environ.get("KB_WORKLOAD_JAX_PLATFORM", "cpu") == "cpu":
                 # simulate the mesh devices in the child (the same
                 # mechanism tests/conftest.py uses)
                 env = dict(os.environ)
@@ -467,27 +564,28 @@ class WorkloadRunner:
                     env["XLA_FLAGS"] = (
                         flags + f" --xla_force_host_platform_device_count="
                                 f"{want_dev}").strip()
-        stderr = subprocess.DEVNULL
-        if self._server_log:
-            stderr = open(self._server_log, "ab")  # noqa: SIM115
-        self._server = subprocess.Popen(args, cwd=REPO_ROOT, stderr=stderr,
-                                        env=env)
-        self._target = f"127.0.0.1:{client_port}"
+        return env
 
-    def _probe(self, deadline_s: float = 60.0) -> None:
+    def _probe(self, target: str | None = None, proc=None,
+               deadline_s: float = 60.0) -> None:
         # fresh channel per attempt: a channel opened before the server
-        # binds accrues reconnect backoff (the test_kvrpc boot lesson)
+        # binds accrues reconnect backoff (the test_kvrpc boot lesson).
+        # Follower probes (count = a linearizable read) only pass once the
+        # follower has bootstrapped AND its fence reaches the leader — a
+        # passing probe certifies the whole replication pipeline.
+        target = target or self._target
+        proc = proc if proc is not None else self._server
         deadline = time.monotonic() + deadline_s
         while time.monotonic() < deadline:
             # a boot-time flag rejection (e.g. --mesh-part > visible
             # devices) exits the child immediately: fail fast with the
             # exit status instead of probing a dead port for 60s
-            if self._server is not None and self._server.poll() is not None:
+            if proc is not None and proc.poll() is not None:
                 raise RuntimeError(
-                    f"server at {self._target} exited rc="
-                    f"{self._server.returncode} before serving (rerun with "
+                    f"server at {target} exited rc="
+                    f"{proc.returncode} before serving (rerun with "
                     f"server_log= to capture its stderr)")
-            probe = EtcdCompatClient(self._target)
+            probe = EtcdCompatClient(target)
             try:
                 probe.count(b"/workload-probe", b"/workload-probe0")
                 probe.close()
@@ -495,7 +593,12 @@ class WorkloadRunner:
             except grpc.RpcError:
                 probe.close()
                 time.sleep(0.3)
-        raise RuntimeError(f"server at {self._target} never served")
+        raise RuntimeError(f"server at {target} never served")
+
+    def _probe_all(self) -> None:
+        self._probe()
+        for proc, target in zip(self._followers, self._follower_targets):
+            self._probe(target=target, proc=proc)
 
     def _preload(self, preload_ops) -> float:
         t0 = time.monotonic()
@@ -543,23 +646,128 @@ class WorkloadRunner:
         shard.submit(lambda client, k=kind, b=body, wk=wkey, w=is_write:
                      self._execute(k, b, client, key=wk, write=w))
 
+    # ----------------------------------------------------- fence probes
+    FENCE_PROBE_INTERVAL_S = 0.5
+
+    def _start_fence_probes(self) -> None:
+        """A probe thread proving linearizable reads on followers: each
+        tick reads the LEADER's committed revision R, then asks every
+        follower for its current revision through the fenced path — the
+        answer must be >= R (a refusal counts as a refusal, never a
+        violation). Probe lag samples (R - follower watermark estimate)
+        feed the per-replica lag p99 in the report."""
+        leader_cli = EtcdCompatClient(self._target)
+        followers = [(t, EtcdCompatClient(t), self._info_ports[1 + i])
+                     for i, t in enumerate(self._follower_targets)]
+        self._probe_clients = [leader_cli] + [c for _t, c, _p in followers]
+
+        def applied_of(info_port: int) -> int:
+            # the UNFENCED watermark view (/status replica block) — the
+            # fenced read below always answers >= the fence by
+            # construction, so lag must be sampled pre-fence
+            try:
+                with urllib.request.urlopen(
+                        f"http://{self._info_host}:{info_port}/status",
+                        timeout=5) as resp:
+                    payload = json.loads(resp.read().decode())
+                return int(payload.get("replica", {})
+                           .get("applied_revision", 0))
+            except Exception:
+                return -1
+
+        def loop() -> None:
+            while not self._fence_probe_stop.wait(
+                    self.FENCE_PROBE_INTERVAL_S):
+                try:
+                    self._count_rpc("range")
+                    fence = leader_cli.current_revision()
+                except grpc.RpcError:
+                    continue  # leader busy/unreachable: nothing to assert
+                for target, cli, info_port in followers:
+                    applied = applied_of(info_port)
+                    if applied >= 0:
+                        self._lag_probe_samples.setdefault(
+                            target, []).append(max(0, fence - applied))
+                    self._fence_probes["count"] += 1
+                    try:
+                        self._count_rpc("range")
+                        got = cli.current_revision()
+                    except grpc.RpcError:
+                        self._fence_probes["refused"] += 1
+                        continue
+                    if got >= fence:
+                        self._fence_probes["ok"] += 1
+                    else:
+                        self._fence_probes["violations"] += 1
+
+        t = threading.Thread(target=loop, name="kb-wl-fence-probe",
+                             daemon=True)
+        t.start()
+
+    def _await_follower_catchup(self, timeout_s: float = 30.0) -> None:
+        """Bounded wait until every follower's applied watermark covers
+        the highest response revision any client recorded (replication is
+        live post-drain, so this converges; on timeout the reconcile just
+        reports what it sees)."""
+        want = 0
+        for c in self._all_clients():
+            for rev in getattr(c, "max_header_revision", {}).values():
+                want = max(want, rev)
+        if not want:
+            return
+        deadline = time.monotonic() + timeout_s
+        for i in range(1, 1 + len(self._followers)):
+            while time.monotonic() < deadline:
+                try:
+                    with urllib.request.urlopen(
+                            f"http://{self._info_host}:"
+                            f"{self._info_ports[i]}/status",
+                            timeout=5) as resp:
+                        payload = json.loads(resp.read().decode())
+                    if int(payload.get("replica", {})
+                           .get("applied_revision", 0)) >= want:
+                        break
+                except Exception:
+                    pass
+                time.sleep(0.1)
+
     # ------------------------------------------------------------ chaos
-    def _faults_http(self, path: str) -> dict:
+    @property
+    def _armed_indices(self) -> list[int]:
+        """Which spawned servers carry the fault plane: the `replica`
+        preset's kinds act at the follower boundaries, every other preset
+        at the leader's."""
+        if self.spec.faults == "replica" and self.spec.replicas:
+            return list(range(1, 1 + self.spec.replicas))
+        return [0]
+
+    def _faults_http(self, path: str, idx: int = 0) -> dict:
         with urllib.request.urlopen(
-            f"http://{self._info_host}:{self._info_port}{path}", timeout=15
+            f"http://{self._info_host}:{self._info_ports[idx]}{path}",
+            timeout=15,
         ) as resp:
             return json.loads(resp.read().decode())
 
+    def _faults_state_sum(self) -> dict:
+        """Aggregate injected counters over every armed server."""
+        injected: Counter = Counter()
+        for idx in self._armed_indices:
+            state = self._faults_http("/faults/state", idx)
+            for k, v in state.get("injected", {}).items():
+                injected[k] += int(v)
+        return dict(injected)
+
     def _arm_faults(self) -> None:
-        """Start the server's fault-window clock at replay start and
-        assert both sides generated the SAME schedule (sha echo)."""
-        ack = self._faults_http("/faults/arm")
-        self._fault_armed_at = time.monotonic()
+        """Start every armed server's fault-window clock at replay start
+        and assert each side generated the SAME schedule (sha echo)."""
         want = self._fault_sched.sha256()
-        if ack.get("sha256") != want:
-            raise RuntimeError(
-                f"fault schedule divergence: server armed "
-                f"{ack.get('sha256')}, runner declared {want}")
+        for idx in self._armed_indices:
+            ack = self._faults_http("/faults/arm", idx)
+            if ack.get("sha256") != want:
+                raise RuntimeError(
+                    f"fault schedule divergence: server {idx} armed "
+                    f"{ack.get('sha256')}, runner declared {want}")
+        self._fault_armed_at = time.monotonic()
 
     def _consistency_check(self, drained: bool = True) -> dict:
         """The keystone chaos invariant (docs/faults.md): one final
@@ -648,8 +856,7 @@ class WorkloadRunner:
         and the keystone consistency check."""
         if not self.chaos:
             return {"armed": False}
-        state = self._faults_http("/faults/state")
-        injected = {k: int(v) for k, v in state.get("injected", {}).items()}
+        injected = self._faults_state_sum()
         metrics_injected = {}
         for labels, value in final.get("kb_faults_injected_total", ()):
             metrics_injected[labels.get("kind", "?")] = int(value)
@@ -666,10 +873,16 @@ class WorkloadRunner:
         # guarantee a hit, so its reconcile asserts the two counter views
         # agree without requiring an injection
         client_driven = {fault_schedule.COMPACT_FAIL}
+        replica_kinds = set(fault_schedule.REPLICA_KINDS)
         reconcile: dict[str, dict] = {}
         for kind in self._fault_sched.kinds():
-            eligible = (self.spec.storage == "tpu"
-                        if kind in engine_kinds else True)
+            if kind in engine_kinds:
+                eligible = self.spec.storage == "tpu"
+            elif kind in replica_kinds:
+                # follower-boundary kinds need followers to act on
+                eligible = self.spec.replicas > 0
+            else:
+                eligible = True
             n = injected.get(kind, 0)
             reconcile[kind] = {
                 "scheduled": True,
@@ -767,35 +980,63 @@ class WorkloadRunner:
         self._write_shards: list[_Shard] = []
         self._range_shards: list[_Shard] = []
         try:
-            self._probe()
-            baseline = self._scrape()
+            self._probe_all()
+            baseline = self._scrape_all()
             preload_wall = self._preload(schedule.preload)
 
+            followers = self._follower_targets
+            def rotated(eps: list[str], i: int) -> list[str]:
+                k = i % len(eps)
+                return eps[k:] + eps[:k]
+            if followers:
+                # the load-balanced apiserver topology (docs/replication.md):
+                # writes + admin round-robin over EVERY endpoint (follower-
+                # landed writes forward to the leader), while the list+watch
+                # load pins to the followers — the read traffic they exist
+                # to absorb
+                write_target = lambda i: rotated(self._targets, i)  # noqa: E731
+                read_target = lambda i: rotated(followers, i)  # noqa: E731
+                admin_target: object = list(self._targets)
+                watch_target: object = followers
+            else:
+                write_target = lambda i: self._target  # noqa: E731
+                read_target = lambda i: self._target  # noqa: E731
+                admin_target = self._target
+                watch_target = self._target
             self._write_shards = [
-                _Shard(f"kb-wl-write-{i}", self._target, spec.shard_queue, self.stats)
+                _Shard(f"kb-wl-write-{i}", write_target(i), spec.shard_queue,
+                       self.stats)
                 for i in range(spec.write_shards)]
             self._range_shards = [
-                _Shard(f"kb-wl-range-{i}", self._target, spec.shard_queue, self.stats)
+                _Shard(f"kb-wl-range-{i}", read_target(i), spec.shard_queue,
+                       self.stats)
                 for i in range(spec.range_shards)]
             self._admin_shard = _Shard(
-                "kb-wl-admin", self._target, spec.shard_queue, self.stats)
-            self._watch_client = EtcdCompatClient(self._target)
+                "kb-wl-admin", admin_target, spec.shard_queue, self.stats)
+            self._watch_client = (
+                EtcdCompatClient(watch_target) if isinstance(watch_target, str)
+                else EtcdCompatClient(endpoints=watch_target))
             # chaos: watches must survive injected server-side stream
             # resets — resume from last-delivered revision + 1
             self._watchmux = WatchMux(self._watch_client,
                                       streams=spec.watch_streams,
-                                      resume=self.chaos)
-            self._lease_client = EtcdCompatClient(self._target)
+                                      resume=self.chaos or bool(followers))
+            self._lease_client = (
+                EtcdCompatClient(watch_target) if isinstance(watch_target, str)
+                else EtcdCompatClient(endpoints=watch_target))
             self._leasemux = LeaseMux(self._lease_client, streams=spec.lease_streams)
 
             if self.chaos:
                 # arm AFTER preload so the fault windows align with replay
                 self._arm_faults()
+            if followers:
+                self._start_fence_probes()
             replay_ops = schedule.replay
             pacer = ReplayPacer(spec.time_scale)
             for op in replay_ops:
                 pacer.wait_until(op.t_ms)
                 self._route(op)
+            self._fence_probe_stop.set()
             # chaos runs get a larger drain budget: the consistency scan
             # is only sound against a quiescent server (an in-flight write
             # acked after the scan would read as a phantom loss)
@@ -806,11 +1047,20 @@ class WorkloadRunner:
             # Range RPCs land inside the reconcile window
             self._consistency = (self._consistency_check(drained)
                                  if self.chaos else None)
-            final = self._scrape()
+            if followers:
+                # the revision-bound reconcile compares each follower's
+                # FINAL applied watermark against the max response
+                # revision any client saw — a forwarded write near the
+                # end of replay returns the LEADER's revision, which the
+                # follower may legitimately not have applied yet. Wait
+                # out the replication tail before scraping.
+                self._await_follower_catchup()
+            final = self._scrape_all()
             report = self._build_report(
                 schedule, sha, baseline, final, preload_wall, replay_wall,
                 pacer, drained)
         finally:
+            self._fence_probe_stop.set()
             for s in [*self._write_shards, *self._range_shards,
                       *([self._admin_shard] if hasattr(self, "_admin_shard") else [])]:
                 s.close()
@@ -820,19 +1070,29 @@ class WorkloadRunner:
             if hasattr(self, "_leasemux"):
                 self._leasemux.close()
                 self._lease_client.close()
+            for c in self._probe_clients:
+                c.close()
+            # followers first: a follower outliving its leader would just
+            # spin its reconnect loop through the teardown
+            for proc in self._followers:
+                proc.terminate()
             if owns_server and self._server is not None:
                 self._server.terminate()
+            for proc in [*self._followers,
+                         *([self._server] if owns_server and self._server
+                           else [])]:
                 try:
-                    self._server.wait(timeout=10)
+                    proc.wait(timeout=10)
                 except subprocess.TimeoutExpired:
-                    self._server.kill()
+                    proc.kill()
 
         passed, violations = slo.evaluate(report, spec.bounds)
         report["slo"]["pass"] = passed
         report["slo"]["violations"] = violations
         if self._write:
             path = self._out_path or slo.next_report_path(
-                REPO_ROOT, chaos=self.chaos)
+                REPO_ROOT, chaos=self.chaos,
+                replica=self.spec.replicas > 0)
             slo.write_report(report, path)
             print(f"[workload] SLO report: {path} "
                   f"({'PASS' if passed else 'FAIL'})", file=sys.stderr)
@@ -845,6 +1105,12 @@ class WorkloadRunner:
                       replay_wall, pacer, drained) -> dict:
         spec = self.spec
         stats = self.stats
+        # baseline/final arrive as per-server snapshot lists (leader
+        # first); counters and histograms reconcile against the SUM, the
+        # per-replica fields read the individual follower snapshots
+        base_snaps, final_snaps = baseline, final
+        baseline = slo.merge_snapshots(base_snaps)
+        final = slo.merge_snapshots(final_snaps)
 
         op_kinds: dict[str, dict] = {}
         for kind in generator.ALL_KINDS:
@@ -966,6 +1232,9 @@ class WorkloadRunner:
                 kind="full_rebuild")),
         }
 
+        replica = self._build_replica_section(base_snaps, final_snaps,
+                                              replay_wall)
+
         with self._rpc_lock:
             rpc = dict(self._rpc)
         checks: dict[str, dict] = {}
@@ -974,18 +1243,42 @@ class WorkloadRunner:
             checks[name] = {"client": int(client_v), "server": int(server_v),
                             "ok": int(client_v) == int(server_v)}
 
-        chk("txn_rpcs", rpc.get("txn", 0),
-            slo.delta(final, baseline, "rpc_server_count", method=_TXN))
-        chk("range_rpcs", rpc.get("range", 0),
+        # multi-endpoint accounting (docs/replication.md): a safe-only
+        # endpoint failover is one extra server-side RPC the client's op
+        # counter never saw — add them per method. A write landing on a
+        # follower is counted TWICE server-side (once by the follower,
+        # once by the leader it forwards to) — subtract the followers'
+        # forwarded counters so the reconcile stays exact. Reads never
+        # forward.
+        fo = Counter()
+        for c in self._all_clients():
+            fo.update(getattr(c, "failovers_by_method", ()))
+        fwd: Counter = Counter()
+        for i in range(1, len(final_snaps)):
+            for rpc_label in ("txn", "compact", "lease_grant"):
+                fwd[rpc_label] += int(slo.delta(
+                    final_snaps[i], base_snaps[i],
+                    "kb_replica_forwarded_total", rpc=rpc_label))
+        chk("txn_rpcs", rpc.get("txn", 0) + fo.get(_TXN, 0),
+            slo.delta(final, baseline, "rpc_server_count", method=_TXN)
+            - fwd["txn"])
+        chk("range_rpcs", rpc.get("range", 0) + fo.get(_RANGE, 0),
             slo.delta(final, baseline, "rpc_server_count", method=_RANGE))
-        chk("compact_rpcs", rpc.get("compact", 0),
-            slo.delta(final, baseline, "rpc_server_count", method=_COMPACT))
-        chk("lease_grant_rpcs", rpc.get("lease_grant", 0),
-            slo.delta(final, baseline, "rpc_server_count", method=_LEASE_GRANT_RPC))
+        chk("compact_rpcs", rpc.get("compact", 0) + fo.get(_COMPACT, 0),
+            slo.delta(final, baseline, "rpc_server_count", method=_COMPACT)
+            - fwd["compact"])
+        chk("lease_grant_rpcs",
+            rpc.get("lease_grant", 0) + fo.get(_LEASE_GRANT_RPC, 0),
+            slo.delta(final, baseline, "rpc_server_count",
+                      method=_LEASE_GRANT_RPC) - fwd["lease_grant"])
         chk("lease_keepalives", mux.acked - mux.expired_acks,
             slo.delta(final, baseline, "kb_lease_keepalive_total"))
-        chk("watchers", live_watchers,
-            slo.series_count(final, "kb_watch_backlog"))
+        # each follower's replication stream IS one whole-keyspace watcher
+        # on the leader (docs/replication.md) — expected alongside the
+        # client's own watches
+        chk("watchers", live_watchers + spec.replicas,
+            sum(slo.series_count(s, "kb_watch_backlog")
+                for s in final_snaps))
         if spec.bounds.min_write_batched_ops > 0:
             # scenario declares write-group formation mandatory: the
             # kb_sched_write_batch_size histogram COUNT must have moved
@@ -1021,6 +1314,12 @@ class WorkloadRunner:
                 "preload_wall_s": round(preload_wall, 3),
                 "ops_per_sec": round(replay_ops / replay_wall, 1)
                                if replay_wall > 0 else 0.0,
+                # rows actually LISTED per second across the whole
+                # topology — the read-throughput number the replica
+                # scale-out is judged by (docs/replication.md)
+                "rows_listed": self._rows_listed,
+                "rows_per_sec": round(self._rows_listed / replay_wall, 1)
+                                if replay_wall > 0 else 0.0,
                 "max_dispatch_lag_s": round(pacer.max_lag_s, 3),
                 "drained": drained,
             },
@@ -1030,13 +1329,131 @@ class WorkloadRunner:
             "leases": leases,
             "sched": sched,
             "compact": compact,
-            "reconcile": {"ok": reconcile_ok, "checks": checks},
+            "reconcile": {"ok": reconcile_ok, "checks": checks,
+                          # client-side safe-only endpoint failovers
+                          # (kb_client_endpoint_failovers): informational
+                          # next to the hard checks — there is no server
+                          # counter to reconcile them against (a failed-
+                          # over attempt never completed anywhere)
+                          "endpoint_failovers": self._endpoint_failovers()},
+            "replica": replica,
             "slo": {"pass": False, "violations": [],
                     "bounds": asdict(spec.bounds)},
             "errors": list(stats.error_samples),
             "faults": self._build_faults_section(baseline, final),
         }
         return report
+
+    def _all_clients(self) -> list[EtcdCompatClient]:
+        out = [s.client for s in [*self._write_shards, *self._range_shards]]
+        if hasattr(self, "_admin_shard"):
+            out.append(self._admin_shard.client)
+        if hasattr(self, "_watch_client"):
+            out.append(self._watch_client)
+        if hasattr(self, "_lease_client"):
+            out.append(self._lease_client)
+        out.extend(self._probe_clients)
+        return out
+
+    def _endpoint_failovers(self) -> int:
+        return sum(getattr(c, "endpoint_failovers", 0)
+                   for c in self._all_clients())
+
+    def _build_replica_section(self, base_snaps, final_snaps,
+                               replay_wall) -> dict:
+        """The report's ``replica`` section (docs/replication.md):
+        per-replica served/forwarded/refused counts and lag, the fence
+        probes, and the revision-consistency reconcile — no response
+        revision above the serving replica's applied watermark (the
+        watermark is monotone and the final scrape runs after the drain,
+        so client-max <= final-watermark is exact)."""
+        spec = self.spec
+        if not spec.replicas:
+            return {"replicas": 0}
+        # client-side per-endpoint max response revision, across all
+        # multi-endpoint clients
+        max_rev: dict[str, int] = {}
+        for c in self._all_clients():
+            for target, rev in getattr(c, "max_header_revision", {}).items():
+                if rev > max_rev.get(target, 0):
+                    max_rev[target] = rev
+
+        def counter_by_label(snap, name: str, label: str) -> dict:
+            return {labels.get(label, "?"): int(v)
+                    for labels, v in snap.get(name, ())}
+
+        per_replica = []
+        checks: dict[str, dict] = {}
+        for i, target in enumerate(self._follower_targets):
+            snap = final_snaps[1 + i]
+            applied = int(slo.series_sum(snap, "kb_replica_applied_revision"))
+            client_max = max_rev.get(target, 0)
+            ok = client_max <= applied
+            lag_samples = self._lag_probe_samples.get(target, [])
+            per_replica.append({
+                "target": target,
+                "applied_revision": applied,
+                "lag_revisions": int(slo.series_sum(
+                    snap, "kb_replica_lag_revisions")),
+                "lag_probe_p99_revisions": int(slo.percentile(
+                    [float(s) for s in lag_samples], 0.99)),
+                "served": counter_by_label(
+                    snap, "kb_replica_served_total", "rpc"),
+                "forwarded": counter_by_label(
+                    snap, "kb_replica_forwarded_total", "rpc"),
+                "refused": counter_by_label(
+                    snap, "kb_replica_refused_total", "reason"),
+                "fence_wait_p99_s": slo.hist_quantile(
+                    snap, "kb_fence_wait_seconds", 0.99),
+                "max_client_revision": client_max,
+                "revision_bound_ok": ok,
+            })
+            checks[f"revision_bound[{target}]"] = {
+                "client_max": client_max, "applied": applied, "ok": ok}
+        fence = dict(self._fence_probes)
+        rows_per_sec = (round(self._rows_listed / replay_wall, 1)
+                        if replay_wall > 0 else 0.0)
+        # acceptance comparison: KB_REPLICA_BASELINE_ROWS carries the
+        # rows_per_sec of an equal-spec single-server run (REPLICAS=0) so
+        # the report can state the scale-out claim machine-readably. On a
+        # box without a core per process the topology cannot express its
+        # parallelism (leader + followers + clients time-share the same
+        # cores, so the extra processes are pure overhead): the bar is
+        # stamped pending_multicore there, the same machine-visible
+        # discipline as the pending_tpu hardware bars (docs/multichip.md)
+        base_rows = float(
+            os.environ.get("KB_REPLICA_BASELINE_ROWS", 0) or 0)
+        cores = os.cpu_count() or 1
+        enough_cores = cores >= spec.replicas + 2
+        if not base_rows:
+            status = "no_baseline"
+        elif not enough_cores:
+            status = "pending_multicore"
+        elif rows_per_sec > base_rows:
+            status = "pass"
+        else:
+            status = "fail"
+        return {
+            "replicas": spec.replicas,
+            "endpoints": list(self._targets),
+            "per_replica": per_replica,
+            "fence_probes": fence,
+            "endpoint_failovers": self._endpoint_failovers(),
+            "rows_per_sec": rows_per_sec,
+            "acceptance": {
+                "single_server_rows_per_sec": base_rows or None,
+                "aggregate_rows_per_sec": rows_per_sec,
+                "cores": cores,
+                "exceeds_single_server": (rows_per_sec > base_rows)
+                                         if base_rows and enough_cores
+                                         else None,
+                "status": status,
+            },
+            "reconcile": {
+                "ok": all(c["ok"] for c in checks.values()),
+                "checks": checks,
+            },
+        }
 
 
 def run_workload(spec: WorkloadSpec, target: str | None = None,
@@ -1067,6 +1484,20 @@ def main(argv=None) -> int:
     ap.add_argument("--scan-partitions", type=int, default=0,
                     help="mirror partition count for the spawned server "
                          "(--storage=tpu; multiple of --mesh-part)")
+    ap.add_argument("--replicas", type=int, default=0,
+                    help="read scale-out (docs/replication.md): spawn this "
+                         "many follower replicas next to the leader; "
+                         "controller list+watch traffic routes to them "
+                         "(bounded-staleness local serving) and the report "
+                         "gains a schema'd `replica` section "
+                         "(REPLICA_rNN.json)")
+    ap.add_argument("--max-staleness-ms", type=float, default=15000.0,
+                    help="follower bounded-staleness bound forwarded to "
+                         "the spawned followers")
+    ap.add_argument("--max-staleness-rev", type=int, default=0,
+                    help="follower bounded-staleness bound in revisions "
+                         "(0 = unbounded), forwarded to the spawned "
+                         "followers")
     ap.add_argument("--target", default="",
                     help="host:port of a running server (default: spawn one)")
     ap.add_argument("--target-info-port", type=int, default=0,
@@ -1091,7 +1522,10 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     mesh_kw = {"mesh_part": args.mesh_part,
-               "scan_partitions": args.scan_partitions}
+               "scan_partitions": args.scan_partitions,
+               "replicas": args.replicas,
+               "max_staleness_ms": args.max_staleness_ms,
+               "max_staleness_rev": args.max_staleness_rev}
     chaos = args.faults and args.faults != "none"
     scenario = "smoke" if args.smoke else args.scenario
     if chaos:

@@ -50,6 +50,25 @@ def _matches(labels: dict, want: dict) -> bool:
     return all(labels.get(k) == v for k, v in want.items())
 
 
+def merge_snapshots(snaps: list) -> PromSnapshot:
+    """Sum several servers' expositions into one (the multi-replica
+    topology's reconcile view): series with identical (name, labels) add —
+    correct for counters and histogram buckets/sums, which is all the
+    merged view is used for. Per-server gauges (watermarks, backlog
+    series) must be read from the individual snapshots instead."""
+    acc: dict[str, dict[tuple, float]] = {}
+    for snap in snaps:
+        for name, series in snap.items():
+            bucket = acc.setdefault(name, {})
+            for labels, value in series:
+                key = tuple(sorted(labels.items()))
+                bucket[key] = bucket.get(key, 0.0) + value
+    return {
+        name: [(dict(key), value) for key, value in bucket.items()]
+        for name, bucket in acc.items()
+    }
+
+
 def series_sum(snap: PromSnapshot, name: str, **want: str) -> float:
     """Sum of all series under ``name`` whose labels match ``want``.
     Counters are tried under both ``name`` and ``name_total`` (the
@@ -151,6 +170,16 @@ _FAULTS_ARMED_FIELDS = ("schedule", "injected", "reconcile", "consistency",
 _CONSISTENCY_FIELDS = ("ok", "checked_keys", "acked_live", "acked_deleted",
                        "ambiguous", "losses", "ghosts", "rev_mismatches")
 
+#: required inside report["replica"] when the topology ran followers
+#: (docs/replication.md): per-replica served/forwarded/lag accounting,
+#: the fence probes, and the revision-consistency reconcile
+_REPLICA_FIELDS = ("replicas", "endpoints", "per_replica", "fence_probes",
+                   "endpoint_failovers", "rows_per_sec", "reconcile")
+_PER_REPLICA_FIELDS = ("target", "applied_revision", "lag_revisions",
+                       "served", "forwarded", "refused",
+                       "fence_wait_p99_s", "max_client_revision",
+                       "revision_bound_ok")
+
 
 def validate_report(report: dict) -> None:
     """Raise ValueError naming every schema problem at once."""
@@ -176,6 +205,16 @@ def validate_report(report: dict) -> None:
         for sub in _CONSISTENCY_FIELDS:
             if sub not in faults.get("consistency", {}):
                 problems.append(f"missing field 'faults'.'consistency'.{sub!r}")
+    replica = report.get("replica")
+    if replica is not None and replica.get("replicas", 0) > 0:
+        for sub in _REPLICA_FIELDS:
+            if sub not in replica:
+                problems.append(f"missing field 'replica'.{sub!r}")
+        for i, pr in enumerate(replica.get("per_replica", ())):
+            for sub in _PER_REPLICA_FIELDS:
+                if sub not in pr:
+                    problems.append(
+                        f"missing field 'replica'.'per_replica'[{i}].{sub!r}")
     if problems:
         raise ValueError("invalid SLO report: " + "; ".join(problems))
 
@@ -262,6 +301,21 @@ def evaluate(report: dict, bounds) -> tuple[bool, list[str]]:
         bound = getattr(bounds, "degraded_p99_ms", 0.0)
         if deg_p99 is not None and bound and deg_p99 > bound:
             v.append(f"degraded-window p99 {deg_p99:.1f}ms > {bound:.1f}ms")
+    replica = report.get("replica")
+    if replica is not None and replica.get("replicas", 0) > 0:
+        # revision consistency (docs/replication.md): no response revision
+        # may exceed the serving replica's applied watermark, and fenced
+        # reads must come back at or above their fence
+        rec = replica.get("reconcile", {})
+        if not rec.get("ok", False):
+            bad = [c for c, r in rec.get("checks", {}).items()
+                   if not r.get("ok", True)]
+            v.append("replica revision-consistency reconcile failed: "
+                     + ", ".join(bad))
+        fp = replica.get("fence_probes", {})
+        if fp.get("violations", 0):
+            v.append(f"{fp['violations']} fence probe(s) answered BELOW "
+                     "their fence revision (stale linearizable read)")
     return (not v), v
 
 
@@ -269,12 +323,20 @@ def evaluate(report: dict, bounds) -> tuple[bool, list[str]]:
 
 _REPORT_RE = re.compile(r"^WORKLOAD_r(\d+)\.json$")
 _CHAOS_RE = re.compile(r"^CHAOS_r(\d+)\.json$")
+_REPLICA_RE = re.compile(r"^REPLICA_r(\d+)\.json$")
 
 
-def next_report_path(root: str, chaos: bool = False) -> str:
-    """``WORKLOAD_rNN.json`` (or ``CHAOS_rNN.json`` for fault-armed runs)
-    with the next free round number under root."""
-    pat, stem = (_CHAOS_RE, "CHAOS") if chaos else (_REPORT_RE, "WORKLOAD")
+def next_report_path(root: str, chaos: bool = False,
+                     replica: bool = False) -> str:
+    """``WORKLOAD_rNN.json`` (``CHAOS_rNN.json`` for fault-armed runs,
+    ``REPLICA_rNN.json`` for fault-free multi-replica topologies) with the
+    next free round number under root."""
+    if chaos:
+        pat, stem = _CHAOS_RE, "CHAOS"
+    elif replica:
+        pat, stem = _REPLICA_RE, "REPLICA"
+    else:
+        pat, stem = _REPORT_RE, "WORKLOAD"
     rounds = [int(m.group(1)) for f in os.listdir(root)
               if (m := pat.match(f))]
     return os.path.join(

@@ -82,6 +82,17 @@ class WorkloadSpec:
 
     # replay-engine knobs (runtime only; do not affect the generated trace)
     storage: str = "memkv"
+    #: read scale-out (docs/replication.md): spawn this many follower
+    #: replicas next to the leader; controller list+watch traffic then
+    #: routes to the followers (bounded-staleness serializable reads +
+    #: local watch serving) while writes/leases round-robin over every
+    #: endpoint and forward. Runtime only — the generated op trace is
+    #: identical with or without replicas.
+    replicas: int = 0
+    #: follower bounded-staleness bounds forwarded to --max-staleness-*
+    #: (0 rev = unbounded; ms bound keeps refusals honest under chaos)
+    max_staleness_rev: int = 0
+    max_staleness_ms: float = 15000.0
     #: multichip sharded serving (docs/multichip.md): devices on the scan
     #: mesh's `part` axis / mirror partition count, forwarded to the spawned
     #: server as --mesh-part/--scan-partitions. 0 = server defaults. Only
@@ -123,6 +134,9 @@ class WorkloadSpec:
             raise ValueError("shard/stream counts must be >= 1")
         if self.mesh_part < 0 or self.scan_partitions < 0:
             raise ValueError("mesh_part/scan_partitions must be >= 0")
+        if self.replicas < 0 or self.max_staleness_rev < 0 \
+                or self.max_staleness_ms < 0:
+            raise ValueError("replicas/max_staleness_* must be >= 0")
         if (self.mesh_part or self.scan_partitions) and self.storage != "tpu":
             raise ValueError(
                 "mesh_part/scan_partitions require storage='tpu' (the mesh "

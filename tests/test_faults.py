@@ -67,8 +67,15 @@ def test_schedule_windows_inside_horizon():
     for w in s.windows:
         assert 0 <= w.t0_ms < w.t1_ms <= s.horizon_ms
         assert 0.0 < w.rate <= 1.0
-    # every taxonomy kind is scheduled by the full preset
-    assert set(s.kinds()) == set(faults.ALL_KINDS)
+    # every single-server taxonomy kind is scheduled by the full preset;
+    # the follower-boundary kinds ride their own `replica` preset (armed
+    # on follower processes only — docs/replication.md)
+    assert set(s.kinds()) == set(faults.ALL_KINDS) - set(faults.REPLICA_KINDS)
+    r = faults.generate("replica", 3, 9.0)
+    assert set(r.kinds()) == set(faults.REPLICA_KINDS)
+    for w in r.windows:
+        assert 0 <= w.t0_ms < w.t1_ms <= r.horizon_ms
+        assert 0.0 < w.rate <= 1.0
 
 
 def test_schedule_none_is_empty_and_unknown_preset_rejected():

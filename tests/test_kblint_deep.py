@@ -455,6 +455,150 @@ def test_kb115_cross_check_from_live_lockcheck_export(tmp_path):
     assert res.lock_graph["coverage"] == pytest.approx(1.0)
 
 
+# ------------------------------------------------------------------- KB119
+# Leader-only mutation surfaces must be statically unreachable from
+# follower-role serving modules (kubebrain_tpu/replica/): a follower that
+# deals revisions or mutates lease state forks the revision/lease domain
+# the leader owns (docs/replication.md).
+TSO_FIXTURE = (
+    "class TSO:\n"
+    "    def deal(self):\n"
+    "        return 1\n"
+    "    def deal_block(self, n):\n"
+    "        return 1\n"
+    "    def commit(self, rev):\n"
+    "        pass\n"
+    "    def committed(self):\n"
+    "        return 0\n"
+    "    def wait_committed(self, rev, timeout):\n"
+    "        return True\n"
+)
+REPLICA = "kubebrain_tpu/replica/role.py"
+
+
+def test_kb119_direct_deal_from_replica_flagged():
+    src = (
+        "from kubebrain_tpu.backend.tso import TSO\n"
+        "class Role:\n"
+        "    def __init__(self):\n"
+        "        self.tso = TSO()\n"
+        "    def serve(self):\n"
+        "        return self.tso.deal()\n"
+    )
+    res = deep_analyze_sources({
+        "kubebrain_tpu/backend/tso.py": TSO_FIXTURE, REPLICA: src})
+    assert [f.rule_id for f in res.findings] == ["KB119"]
+    (f,) = res.findings
+    assert "TSO.deal" in f.message and f.path == REPLICA
+
+
+def test_kb119_transitive_reach_through_helper_flagged():
+    # replica -> shared helper in another package -> TSO.deal_block: the
+    # multi-hop laundering a path-scoped grep could never see
+    helper = (
+        "from kubebrain_tpu.backend.tso import TSO\n"
+        "def commit_group(tso: TSO, n):\n"
+        "    return tso.deal_block(n)\n"
+    )
+    src = (
+        "from kubebrain_tpu.backend.helper import commit_group\n"
+        "from kubebrain_tpu.backend.tso import TSO\n"
+        "class Role:\n"
+        "    def __init__(self):\n"
+        "        self.tso = TSO()\n"
+        "    def apply(self):\n"
+        "        return commit_group(self.tso, 4)\n"
+    )
+    res = deep_analyze_sources({
+        "kubebrain_tpu/backend/tso.py": TSO_FIXTURE,
+        "kubebrain_tpu/backend/helper.py": helper,
+        REPLICA: src})
+    ids = [f.rule_id for f in res.findings]
+    assert ids == ["KB119"]
+    (f,) = res.findings
+    assert "commit_group" in f.message and "TSO.deal_block" in f.message
+
+
+def test_kb119_committed_floor_adoption_clean():
+    # committed()/wait_committed()/commit() are how a follower FOLLOWS the
+    # leader's floor — not leader-only surfaces
+    src = (
+        "from kubebrain_tpu.backend.tso import TSO\n"
+        "class Role:\n"
+        "    def __init__(self):\n"
+        "        self.tso = TSO()\n"
+        "    def fence(self, rev):\n"
+        "        self.tso.commit(rev)\n"
+        "        return self.tso.wait_committed(rev, timeout=1.0)\n"
+    )
+    res = deep_analyze_sources({
+        "kubebrain_tpu/backend/tso.py": TSO_FIXTURE, REPLICA: src})
+    assert [f.rule_id for f in res.findings] == []
+
+
+def test_kb119_scoped_to_replica_modules():
+    # the identical call from a NON-replica module is some other rule's
+    # business (the leader deals revisions all day)
+    src = (
+        "from kubebrain_tpu.backend.tso import TSO\n"
+        "class B:\n"
+        "    def __init__(self):\n"
+        "        self.tso = TSO()\n"
+        "    def write(self):\n"
+        "        return self.tso.deal()\n"
+    )
+    res = deep_analyze_sources({
+        "kubebrain_tpu/backend/tso.py": TSO_FIXTURE,
+        "kubebrain_tpu/backend/b.py": src})
+    assert [f.rule_id for f in res.findings] == []
+
+
+def test_kb119_lease_mutators_flagged():
+    reg = (
+        "class LeaseRegistry:\n"
+        "    def grant(self, ttl, lease_id=0):\n"
+        "        pass\n"
+        "    def keepalive(self, lease_id):\n"
+        "        return 1\n"
+        "    def time_to_live(self, lease_id):\n"
+        "        return (0, 0, [])\n"
+    )
+    src = (
+        "from kubebrain_tpu.lease.registry import LeaseRegistry\n"
+        "class Role:\n"
+        "    def __init__(self):\n"
+        "        self.reg = LeaseRegistry()\n"
+        "    def keepalive_locally(self, lease_id):\n"
+        "        return self.reg.keepalive(lease_id)\n"
+        "    def read_only(self, lease_id):\n"
+        "        return self.reg.time_to_live(lease_id)\n"
+    )
+    res = deep_analyze_sources({
+        "kubebrain_tpu/lease/registry.py": reg, REPLICA: src})
+    assert [f.rule_id for f in res.findings] == ["KB119"]
+    (f,) = res.findings
+    assert "LeaseRegistry.keepalive" in f.message
+
+
+def test_kb119_suppressible_and_repo_stays_clean():
+    src = (
+        "from kubebrain_tpu.backend.tso import TSO\n"
+        "class Role:\n"
+        "    def __init__(self):\n"
+        "        self.tso = TSO()\n"
+        "    def serve(self):\n"
+        "        # kblint: disable=KB119 -- fixture\n"
+        "        return self.tso.deal()\n"
+    )
+    res = deep_analyze_sources({
+        "kubebrain_tpu/backend/tso.py": TSO_FIXTURE, REPLICA: src})
+    assert [f.rule_id for f in res.findings] == []
+    # and the real tree must be KB119-clean with an EMPTY baseline —
+    # whole-graph, so the forbidden targets actually resolve
+    res = deep_analyze_paths(REPO, roots=["kubebrain_tpu"])
+    assert [f for f in res.findings if f.rule_id == "KB119"] == []
+
+
 # ------------------------------------------------- differential (v2 ⊇ v1)
 #: representative per-rule fixtures from the v1 suite: the deep driver
 #: must report every syntactic finding these produce (running both tiers),

@@ -1,0 +1,555 @@
+"""Read scale-out (kubebrain_tpu/replica, docs/replication.md):
+
+- fence-read correctness: a follower's linearizable read is byte-identical
+  to the leader's under concurrent (group-commit-batched) writers, and a
+  fence across the leader's revision GAPS (failed ops) completes via the
+  ordered watch progress marks;
+- bounded-staleness enforcement: a follower past its staleness bound
+  REFUSES serializable reads (etcdserver-prefixed UNAVAILABLE — the safe
+  class clients fail over on) and degrades to explicit-revision-only
+  serving; a stalled watermark turns linearizable reads into fence-timeout
+  refusals, never stale answers;
+- follower watch resume: a replication-stream reset loses no event and
+  duplicates none for the follower's OWN watchers;
+- bootstrap floor: history below the follower's bootstrap revision
+  refuses as compacted (the honest etcd answer);
+- follower mirror identity (--storage=tpu, jnp + pallas-interpret): the
+  replicated delta blocks seal into the same serving state the leader
+  has, byte-identical at a pinned revision through the real gRPC front;
+- a small-N two-replica end-to-end smoke through the workload harness
+  (spawned processes, real gRPC, schema'd replica report section).
+"""
+
+import threading
+import time
+
+import grpc
+import pytest
+
+from kubebrain_tpu.cli import build_endpoint, build_parser
+from kubebrain_tpu.client import EtcdCompatClient, WatchMux
+
+from test_etcd_server import free_port
+
+
+class Node:
+    """One in-process server (leader or follower) built through the real
+    cli wiring, serving on real ports."""
+
+    def __init__(self, argv):
+        args = build_parser().parse_args(argv)
+        self.endpoint, self.backend, self.store = build_endpoint(args)
+        self.endpoint.run()
+        self.client_port = args.client_port
+        self.info_port = args.info_port
+        self.target = f"127.0.0.1:{args.client_port}"
+        self.role = getattr(self.endpoint.server, "replica", None)
+
+    def close(self):
+        self.endpoint.close()
+        self.backend.close()
+        self.store.close()
+
+
+def spawn_pair(storage="memkv", leader_extra=(), follower_extra=(),
+               preload=0):
+    lc, lp, li = free_port(), free_port(), free_port()
+    leader = Node(["--single-node", "--storage", storage,
+                   "--host", "127.0.0.1",
+                   "--client-port", str(lc), "--peer-port", str(lp),
+                   "--info-port", str(li), "--compact-interval", "86400",
+                   *leader_extra])
+    lcli = EtcdCompatClient(leader.target)
+    for i in range(preload):
+        ok, _ = lcli.create(b"/registry/pods/ns0/pre%03d" % i, b"v0")
+        assert ok
+    fc, fp, fi = free_port(), free_port(), free_port()
+    follower = Node(["--role", "follower",
+                     "--leader-address", leader.target,
+                     "--leader-info", f"127.0.0.1:{li}",
+                     "--storage", storage, "--host", "127.0.0.1",
+                     "--client-port", str(fc), "--peer-port", str(fp),
+                     "--info-port", str(fi), "--compact-interval", "86400",
+                     *follower_extra])
+    fcli = EtcdCompatClient(follower.target)
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        try:
+            fcli.count(b"/probe", b"/probe0")
+            break
+        except grpc.RpcError:
+            time.sleep(0.1)
+    else:
+        raise RuntimeError("follower never served")
+    return leader, lcli, follower, fcli
+
+
+PODS = b"/registry/pods/"
+PODS_END = b"/registry/pods0"
+
+
+def rows(kvs):
+    return [(k.key, k.value, k.mod_revision) for k in kvs]
+
+
+def _divergence_diagnostics(leader, follower, kvs_f, kvs_l, fence, got):
+    """Rich dump for a follower-vs-leader pinned-revision mismatch: the
+    per-key revision records on BOTH stores tell exactly which revisions
+    the follower is missing relative to its claimed watermark."""
+    sf = {(k.key, k.mod_revision) for k in kvs_f}
+    sl = {(k.key, k.mod_revision) for k in kvs_l}
+    lines = [f"DIVERGED at fence={fence} follower_got={got} "
+             f"wm={follower.backend.tso.committed()} "
+             f"leader_committed={leader.backend.tso.committed()}"]
+    lines.append(f"stream={follower.role.status()['stream']}")
+    for label, only in (("follower-only", sf - sl), ("leader-only", sl - sf)):
+        for key, rev in sorted(only)[:6]:
+            lrec = leader.backend._read_rev_record(key)
+            frec = follower.backend._read_rev_record(key)
+            lines.append(f"{label} {key!r}@{rev}: leader_rec={lrec} "
+                         f"follower_rec={frec}")
+    return "\n".join(lines)
+
+
+# ----------------------------------------------------------- fence reads
+def test_fence_read_correctness_under_concurrent_writers():
+    leader, lcli, follower, fcli = spawn_pair()
+    try:
+        stop = threading.Event()
+        errs = []
+
+        def writer(wid):
+            c = EtcdCompatClient(leader.target)
+            try:
+                rev = 0
+                i = 0
+                while not stop.is_set():
+                    key = b"/registry/pods/nsw/%d-%d" % (wid, i)
+                    ok, rev = c.create(key, b"x" * 64)
+                    if ok and i % 3 == 0:
+                        c.update(key, b"y" * 64, rev)
+                    if ok and i % 5 == 0:
+                        c.delete(key, 0)
+                    i += 1
+            except Exception as e:  # pragma: no cover
+                errs.append(e)
+            finally:
+                c.close()
+
+        # several concurrent writers so the scheduler actually forms
+        # commit groups on the leader (docs/writes.md)
+        threads = [threading.Thread(target=writer, args=(w,), daemon=True)
+                   for w in range(4)]
+        for t in threads:
+            t.start()
+        try:
+            probes = 0
+            deadline = time.monotonic() + 60
+            while probes < 10 and time.monotonic() < deadline:
+                # linearizable fence probe: leader revision first, then a
+                # rev-0 non-serializable read on the follower must come
+                # back at or above it. A fence-timeout REFUSAL under box
+                # load is legal (the contract is refusals, never stale
+                # answers) — retry it; a below-fence answer never is.
+                fence = lcli.current_revision()
+                if fence == 0:
+                    # nothing committed yet (writers still starting):
+                    # list(revision=0) would be a HEAD read, not a pinned
+                    # one, and head reads at two different instants
+                    # legitimately differ — the degenerate case behind a
+                    # long-lived "divergence" flake in this test
+                    continue
+                try:
+                    got = fcli.current_revision()
+                except grpc.RpcError as e:
+                    assert "replica refused" in (e.details() or "")
+                    continue
+                assert got >= fence, (got, fence)
+                # explicit pinned revision: byte-identical to the leader
+                kvs_f, _ = fcli.list(PODS, PODS_END, revision=fence)
+                kvs_l, _ = lcli.list(PODS, PODS_END, revision=fence)
+                if rows(kvs_f) != rows(kvs_l):
+                    diag = _divergence_diagnostics(
+                        leader, follower, kvs_f, kvs_l, fence, got)
+                    raise AssertionError(diag)
+                probes += 1
+            assert probes >= 3, "too few successful fence probes"
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(timeout=10)
+        assert not errs
+    finally:
+        fcli.close()
+        lcli.close()
+        follower.close()
+        leader.close()
+
+
+def test_fence_crosses_revision_gaps_via_progress_marks():
+    # a FAILED leader write consumes a revision but streams no event: the
+    # follower can only reach the new committed floor through the ordered
+    # progress marks — a fenced read right after must still complete
+    leader, lcli, follower, fcli = spawn_pair()
+    try:
+        ok, rev1 = lcli.create(b"/registry/pods/ns0/a", b"v")
+        assert ok
+        ok, _rev2 = lcli.update(b"/registry/pods/ns0/a", b"w", rev1)
+        assert ok
+        # update against the STALE revision: the CAS conflict consumes a
+        # dealt revision but streams no event = a revision gap
+        ok3, _ = lcli.update(b"/registry/pods/ns0/a", b"x", rev1)
+        assert not ok3
+        fence = lcli.current_revision()
+        t0 = time.monotonic()
+        got = fcli.current_revision()  # fenced on the follower
+        assert got >= fence
+        assert time.monotonic() - t0 < 3.0  # progress mark, not timeout
+    finally:
+        fcli.close()
+        lcli.close()
+        follower.close()
+        leader.close()
+
+
+def test_fence_leader_revision_never_predates_the_call():
+    """The fence's leader-revision sample must come from a fetch that
+    STARTED after the read arrived: joining an already-in-flight /status
+    fetch could return a revision sampled before a write this read must
+    observe — a real-time linearizability hole (the ticketed-singleflight
+    regression)."""
+    from kubebrain_tpu.replica.role import FollowerConfig, FollowerRole
+
+    cfg = FollowerConfig(leader_address="unused:1", leader_info="unused:2",
+                         fence_timeout_s=5.0)
+    role = FollowerRole(None, cfg)
+    rev_box = [10]
+    first_started = threading.Event()
+    gate = threading.Event()
+
+    def fetch():
+        v = rev_box[0]  # the leader's revision AT FETCH START
+        first_started.set()
+        gate.wait(5)
+        return v
+
+    role._syncer._fetch = fetch
+    out = {}
+    a = threading.Thread(
+        target=lambda: out.__setitem__("a", role.leader_revision()))
+    a.start()
+    assert first_started.wait(5)
+    rev_box[0] = 20  # the leader advanced AFTER fetch #1 began
+    b = threading.Thread(
+        target=lambda: out.__setitem__("b", role.leader_revision()))
+    b.start()
+    time.sleep(0.1)  # b must be parked on generation 2, not flight 1
+    gate.set()
+    a.join(5)
+    b.join(5)
+    assert out["a"] == 10      # a arrived before the advance: 10 is legal
+    assert out["b"] == 20, out  # b arrived after: the stale flight is not
+
+
+def test_fence_survives_a_waiter_timeout():
+    """A waiter timing out while a fetch is in flight must not wedge the
+    generation singleflight: later fences still get fresh fetches (the
+    pre-committed-producer regression)."""
+    from kubebrain_tpu.replica.role import (
+        FollowerConfig, FollowerRole, LeaderUnreachableError)
+
+    cfg = FollowerConfig(leader_address="unused:1", leader_info="unused:2",
+                         fence_timeout_s=5.0)
+    role = FollowerRole(None, cfg)
+    gate = threading.Event()
+    started = threading.Event()
+
+    def fetch():
+        started.set()
+        gate.wait(5)
+        return 7
+
+    role._syncer._fetch = fetch
+    a = threading.Thread(target=role.leader_revision, daemon=True)
+    a.start()
+    assert started.wait(5)
+    # b times out while a's fetch is in flight (needs generation 2,
+    # which nobody ever produces before its deadline)
+    with pytest.raises(LeaderUnreachableError):
+        role.leader_revision(timeout=0.05)
+    gate.set()
+    a.join(5)
+    # the path must still work: c runs generation 2 itself
+    assert role.leader_revision(timeout=5.0) == 7
+
+
+def test_resync_converges_state_and_emits_deletes():
+    """Rung 3 of the degradation ladder: a follower whose resume point
+    fell out of the leader's cache re-lists and diffs — changed keys
+    re-applied, vanished keys tombstoned (watch-visible), state
+    byte-identical after."""
+    leader, lcli, follower, fcli = spawn_pair()
+    try:
+        keys = {}
+        for i in range(8):
+            k = b"/registry/pods/ns0/rs%d" % i
+            ok, rev = lcli.create(k, b"v%d" % i)
+            assert ok
+            keys[k] = rev
+        deadline = time.monotonic() + 10
+        while follower.role.applied_revision() < max(keys.values()):
+            assert time.monotonic() < deadline
+            time.sleep(0.02)
+        # partition: stop the stream, then mutate the leader underneath
+        follower.role._stream.close()
+        time.sleep(0.3)
+        lcli.delete(b"/registry/pods/ns0/rs0", 0)
+        lcli.update(b"/registry/pods/ns0/rs1", b"changed", keys[
+            b"/registry/pods/ns0/rs1"])
+        lcli.create(b"/registry/pods/ns0/rs-new", b"fresh")
+        # follower-local watcher must see the diff as events
+        mux = WatchMux(fcli, streams=1)
+        w = mux.add(PODS, PODS_END,
+                    start_revision=follower.role.applied_revision() + 1)
+        # drive the resync directly (the reconnect loop would take it on
+        # a compacted cancel; forcing leader-cache expiry is impractical
+        # in-test)
+        probe = EtcdCompatClient(leader.target)
+        try:
+            follower.role._stream._resync(probe)
+        finally:
+            probe.close()
+        kvs_f, _ = fcli.list(PODS, PODS_END, serializable=True)
+        kvs_l, _ = lcli.list(PODS, PODS_END)
+        assert rows(kvs_f) == rows(kvs_l)
+        deadline = time.monotonic() + 10
+        while w.events < 3 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert w.events >= 3  # delete + update + create all fanned out
+        mux.close()
+    finally:
+        fcli.close()
+        lcli.close()
+        follower.close()
+        leader.close()
+
+
+# ----------------------------------------------- staleness + degradation
+def test_bounded_staleness_refusal_and_degradation_ladder():
+    leader, lcli, follower, fcli = spawn_pair(
+        follower_extra=["--max-staleness-ms", "300",
+                        "--fence-timeout-ms", "700"])
+    try:
+        ok, rev = lcli.create(b"/registry/pods/ns0/k", b"v")
+        assert ok
+        # serializable reads promise bounded staleness, not read-your-
+        # leader-writes: wait for the watermark to cover the create first
+        deadline = time.monotonic() + 15
+        while follower.role.applied_revision() < rev:
+            assert time.monotonic() < deadline, "replication never caught up"
+            time.sleep(0.05)
+        # healthy: serializable reads serve locally. The 300ms bound can
+        # trip transiently when the 0.2s progress ticker runs late under
+        # full-suite load on a small box — retry through those; with the
+        # stream LIVE a read must succeed within the deadline
+        deadline = time.monotonic() + 10
+        while True:
+            try:
+                kvs, srev = fcli.list(PODS, PODS_END, serializable=True)
+                break
+            except grpc.RpcError as e:
+                assert "stale" in (e.details() or "")
+                assert time.monotonic() < deadline, "never un-stale"
+                time.sleep(0.1)
+        assert len(kvs) == 1 and srev >= rev
+        # stall replication: the stream stops advancing the watermark,
+        # so within the deadline every serializable read must REFUSE
+        follower.role._stream.close()
+        wm = follower.role.applied_revision()
+        time.sleep(0.5)  # past the 300ms bound
+        with pytest.raises(grpc.RpcError) as ei:
+            deadline = time.monotonic() + 10
+            while True:
+                fcli.list(PODS, PODS_END, serializable=True)
+                assert time.monotonic() < deadline, "never refused"
+                time.sleep(0.1)
+        assert ei.value.code() == grpc.StatusCode.UNAVAILABLE
+        assert "etcdserver: replica refused (stale)" in ei.value.details()
+        # degradation ladder: explicit-revision reads <= the watermark
+        # STILL serve, byte-identical
+        kvs_f, _ = fcli.list(PODS, PODS_END, revision=wm)
+        kvs_l, _ = lcli.list(PODS, PODS_END, revision=wm)
+        assert rows(kvs_f) == rows(kvs_l)
+        # a linearizable read with the watermark stalled BELOW the leader
+        # head must refuse (fence timeout), never answer stale
+        ok, _ = lcli.create(b"/registry/pods/ns0/k2", b"v2")
+        assert ok
+        with pytest.raises(grpc.RpcError) as ei:
+            fcli.list(PODS, PODS_END)
+        assert ei.value.code() == grpc.StatusCode.UNAVAILABLE
+        assert "replica refused" in ei.value.details()
+        assert follower.role.refused  # counted
+    finally:
+        fcli.close()
+        lcli.close()
+        follower.close()
+        leader.close()
+
+
+def test_reads_below_bootstrap_floor_refuse_as_compacted():
+    # history below the follower's bootstrap revision is honestly
+    # unservable: the follower refuses it as compacted so clients re-list
+    leader, lcli, follower, fcli = spawn_pair(preload=10)
+    try:
+        assert follower.backend.compact_revision() >= 10
+        with pytest.raises(grpc.RpcError) as ei:
+            fcli.list(PODS, PODS_END, revision=5)
+        assert ei.value.code() == grpc.StatusCode.OUT_OF_RANGE
+        assert "compacted" in ei.value.details()
+        # the same revision still serves on the leader
+        kvs, _ = lcli.list(PODS, PODS_END, revision=5)
+        assert len(kvs) == 5
+    finally:
+        fcli.close()
+        lcli.close()
+        follower.close()
+        leader.close()
+
+
+# ------------------------------------------------------ watch + resume
+def test_follower_watch_survives_replication_reset():
+    leader, lcli, follower, fcli = spawn_pair()
+    try:
+        ok, rev = lcli.create(b"/registry/pods/ns0/w0", b"v")
+        assert ok
+        # wait for the follower to apply, then watch IT from rev+1
+        deadline = time.monotonic() + 10
+        while follower.role.applied_revision() < rev:
+            assert time.monotonic() < deadline
+            time.sleep(0.02)
+        mux = WatchMux(fcli, streams=1, record_revisions=True)
+        w = mux.add(PODS, PODS_END, start_revision=rev + 1)
+        seen_pre = lcli.create(b"/registry/pods/ns0/w1", b"v1")[1]
+        # reset the replication stream; the teardown lands at the next
+        # 0.2s ticker tick, so the writes below straddle it
+        stream = follower.role._stream
+        stream.reset()
+        revs = [seen_pre]
+        for i in range(5):
+            okw, r = lcli.create(b"/registry/pods/ns0/r%d" % i, b"x")
+            assert okw
+            revs.append(r)
+            time.sleep(0.06)
+        deadline = time.monotonic() + 15
+        while (w.events < len(revs) or stream.resets < 1) \
+                and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert stream.resets >= 1  # the reset really happened
+        # exactly once, in revision order — no loss, no duplicates across
+        # the replication reset
+        assert w.revisions == sorted(revs), (w.revisions, revs)
+        mux.close()
+    finally:
+        fcli.close()
+        lcli.close()
+        follower.close()
+        leader.close()
+
+
+# ------------------------------------------------- TPU mirror identity
+@pytest.mark.parametrize("pallas", [False, True],
+                         ids=["jnp", "pallas-interpret"])
+def test_follower_mirror_identity_pinned_revision_tpu(pallas):
+    extra = ["--merge-threshold", "32"]
+    if pallas:
+        extra = extra + ["--use-pallas"]
+    leader, lcli, follower, fcli = spawn_pair(
+        storage="tpu", leader_extra=extra, follower_extra=extra)
+    try:
+        keys = []
+        for i in range(60):
+            key = b"/registry/pods/ns%d/p%03d" % (i % 3, i)
+            ok, rev = lcli.create(key, b"v" * (32 + i % 64))
+            assert ok
+            keys.append((key, rev))
+        for key, rev in keys[::4]:
+            lcli.update(key, b"u" * 48, rev)
+        for key, _ in keys[::9]:
+            lcli.delete(key, 0)
+        pinned = lcli.current_revision()
+        # fenced explicit-revision read: the follower's replicated delta
+        # blocks (sealed into its OWN mirror via the same _DeltaIndex
+        # machinery, past the 32-row merge threshold) must serve the
+        # pinned snapshot byte-identically through the real gRPC front
+        kvs_f, _ = fcli.list(PODS, PODS_END, revision=pinned)
+        kvs_l, _ = lcli.list(PODS, PODS_END, revision=pinned)
+        assert rows(kvs_f) == rows(kvs_l)
+        assert len(kvs_f) > 40
+        # keep writing so another merge cycle lands, then re-compare at a
+        # fresh pinned revision AND at the old one (history intact)
+        for i in range(40):
+            lcli.create(b"/registry/pods/ns9/q%03d" % i, b"z" * 40)
+        pinned2 = lcli.current_revision()
+        kvs_f2, _ = fcli.list(PODS, PODS_END, revision=pinned2)
+        kvs_l2, _ = lcli.list(PODS, PODS_END, revision=pinned2)
+        assert rows(kvs_f2) == rows(kvs_l2)
+        kvs_f3, _ = fcli.list(PODS, PODS_END, revision=pinned)
+        assert rows(kvs_f3) == rows(kvs_f)
+    finally:
+        fcli.close()
+        lcli.close()
+        follower.close()
+        leader.close()
+
+
+# --------------------------------------------------- forwarded surfaces
+def test_forwarding_and_counters():
+    leader, lcli, follower, fcli = spawn_pair()
+    try:
+        ok, rev = fcli.create(b"/registry/pods/ns0/fwd", b"via-follower")
+        assert ok
+        got = lcli.get(b"/registry/pods/ns0/fwd")
+        assert got is not None and got.value == b"via-follower"
+        lease_id, granted = fcli.lease_grant(10)
+        assert granted >= 10
+        ttl, granted2, _keys = fcli.lease_time_to_live(lease_id)
+        assert 0 <= ttl <= granted2
+        fcli.lease_revoke(lease_id)
+        fwd = follower.role.forwarded
+        assert fwd["txn"] >= 1 and fwd["lease_grant"] == 1
+        assert fwd["lease_ttl"] == 1 and fwd["lease_revoke"] == 1
+        base = follower.role.served["range"]
+        fcli.list(PODS, PODS_END, serializable=True)
+        assert follower.role.served["range"] > base
+    finally:
+        fcli.close()
+        lcli.close()
+        follower.close()
+        leader.close()
+
+
+# --------------------------------------------- end-to-end replica smoke
+def test_two_replica_end_to_end_smoke():
+    """Spawned leader + 2 followers through the workload harness: real
+    gRPC front, follower-routed list+watch, fence probes, the schema'd
+    replica report section, and every reconcile check green."""
+    from kubebrain_tpu.workload.runner import run_workload
+    from kubebrain_tpu.workload.spec import WorkloadSpec
+
+    spec = WorkloadSpec.for_smoke(8, replicas=2)
+    report = run_workload(spec, write_report=False)
+    assert report["slo"]["pass"], report["slo"]["violations"]
+    rep = report["replica"]
+    assert rep["replicas"] == 2 and len(rep["per_replica"]) == 2
+    for pr in rep["per_replica"]:
+        assert pr["revision_bound_ok"]
+        assert pr["applied_revision"] > 0
+        assert pr["served"].get("range", 0) > 0
+        assert pr["max_client_revision"] <= pr["applied_revision"]
+    assert rep["fence_probes"]["violations"] == 0
+    assert rep["reconcile"]["ok"]
+    assert report["replay"]["rows_per_sec"] > 0
+    # follower-landed writes forwarded (writes round-robin over all
+    # endpoints, so with 3 endpoints some MUST land on followers)
+    assert sum(pr["forwarded"].get("txn", 0)
+               for pr in rep["per_replica"]) > 0

@@ -208,3 +208,64 @@ def test_fault_plane_watch_reset_drops_live_watchers():
     finally:
         backend.close()
         store.close()
+
+
+# ------------------------------------------------- delivered-order holes
+# Two server-side paths used to open an INVISIBLE gap in a
+# delivered-in-order watch stream — fatal once a later response (event
+# batch or replica progress mark) vouches for the skipped revisions and a
+# resume watermark carries the loss forward (docs/replication.md):
+#   1. _WatchSession._send dropping ONE response when the per-stream out
+#      queue stayed full, while later responses kept flowing;
+#   2. WatcherHub.delete_watcher evicting only the HEAD of a full
+#      subscriber queue to fit the poison pill, delivering the newer
+#      batches after the gap.
+# Both must instead END the stream at the last delivered response.
+
+def test_session_send_overflow_poisons_stream_instead_of_gapping():
+    from kubebrain_tpu.server.etcd.watch import _WatchSession
+
+    store = new_storage("memkv")
+    backend = Backend(store, BackendConfig())
+    try:
+        out: queue.Queue = queue.Queue(maxsize=2)
+        session = _WatchSession(backend, out, context=None)
+        out.put("r1")
+        out.put("r2")  # full: the next _send cannot deliver in order
+        session._send("r3-would-gap")
+        # the session must be POISONED (the stream writer checks the flag
+        # before every yield, so the wire sequence stays a strict prefix
+        # of the enqueued order) — never a silent skip of one response
+        assert session.poisoned
+        with session._lock:
+            assert session._closed
+        # the dropped response never entered the queue
+        assert "r3-would-gap" not in list(out.queue)
+    finally:
+        backend.close()
+        store.close()
+
+
+def test_delete_watcher_flags_before_pill():
+    from kubebrain_tpu.backend.common import WatchEvent
+    from kubebrain_tpu.backend.watcherhub import WatcherHub
+
+    hub = WatcherHub()
+    wid, q = hub.add_watcher(
+        b"", b"", 0, queue_factory=lambda _ms: queue.Queue(maxsize=3))
+    for rev in (1, 2, 3):
+        hub.stream([WatchEvent(revision=rev, key=b"/k%d" % rev)])
+    assert q.full()
+    hub.delete_watcher(wid)
+    # nothing may be delivered past the drop point: delete_watcher sets
+    # kb_dropped BEFORE evicting for the pill, and the pump checks it
+    # before every delivery — a consumer seeing batch 2 or 3 after batch
+    # 1 was evicted would resume past rev 1 (the invisible-gap shape)
+    assert getattr(q, "kb_dropped", False)
+    delivered = []
+    while True:
+        item = q.get_nowait()
+        if item is None or getattr(q, "kb_dropped", False):
+            break
+        delivered.append(item)
+    assert delivered == []

@@ -37,7 +37,15 @@
 #                         dirty-shard-only republish, retry→escalate, and
 #                         a small-N bench-compact smoke asserting store +
 #                         serving byte-identity vs the sequential oracle
-#   9. chaos (FAULTS)   — deterministic fault injection (docs/faults.md):
+#   9. replica          — read scale-out (docs/replication.md): follower
+#                         fence-read correctness (byte-identical to the
+#                         leader under concurrent writers), bounded-
+#                         staleness refusal + the degradation ladder,
+#                         watch resume across a replication reset, the
+#                         TPU-mirror identity at pinned revisions, and a
+#                         small two-replica end-to-end smoke through the
+#                         real gRPC front
+#  10. chaos (FAULTS)   — deterministic fault injection (docs/faults.md):
 #                         schedule sha determinism, FAULTS=none inertness
 #                         byte-identity, the storage error taxonomy through
 #                         a live Backend (definite/uncertain + group-commit
@@ -46,57 +54,61 @@
 #                         duplicated events across server-side resets), and
 #                         a small FAULTS=smoke replay asserting the
 #                         acknowledged-write consistency invariant
-#  10. tier-1 pytest    — the ROADMAP.md verify command
+#  11. tier-1 pytest    — the ROADMAP.md verify command
 # Run from anywhere; operates on the repo this script lives in.
 
 set -uo pipefail
 
 cd "$(dirname "$0")/.."
 
-echo "=== [1/10] make lint (syntactic + deep interprocedural, 60s budget)"
+echo "=== [1/11] make lint (syntactic + deep interprocedural, 60s budget)"
 make lint || exit 1
 env JAX_PLATFORMS=cpu python -m pytest tests/test_kblint.py \
     tests/test_kblint_deep.py -q -m 'not slow' -p no:cacheprovider || exit 1
 
-echo "=== [2/10] make typecheck"
+echo "=== [2/11] make typecheck"
 make typecheck || exit 1
 
-echo "=== [3/10] scheduler semantics + query-batched scan + write group commit + bench-smoke (CPU fallback)"
+echo "=== [3/11] scheduler semantics + query-batched scan + write group commit + bench-smoke (CPU fallback)"
 env JAX_PLATFORMS=cpu python -m pytest tests/test_sched.py \
     tests/test_sched_batch.py tests/test_scan_pallas.py \
     tests/test_write_batch.py -q -m 'not slow' \
     -p no:cacheprovider || exit 1
 make bench-smoke || exit 1
 
-echo "=== [4/10] request tracing: span tests + live-server /debug/traces smoke"
+echo "=== [4/11] request tracing: span tests + live-server /debug/traces smoke"
 env JAX_PLATFORMS=cpu python -m pytest tests/test_trace.py -q -m 'not slow' \
     -p no:cacheprovider || exit 1
 env JAX_PLATFORMS=cpu python tools/smoke_trace.py || exit 1
 
-echo "=== [5/10] lease subsystem: TTL state machine + revision-stamped expiry"
+echo "=== [5/11] lease subsystem: TTL state machine + revision-stamped expiry"
 env JAX_PLATFORMS=cpu python -m pytest tests/test_lease.py -q -m 'not slow' \
     -p no:cacheprovider || exit 1
 
-echo "=== [6/10] workload replay: determinism + SLO schema + small-N gRPC smoke"
+echo "=== [6/11] workload replay: determinism + SLO schema + small-N gRPC smoke"
 env JAX_PLATFORMS=cpu python -m pytest tests/test_workload.py -q -m 'not slow' \
     -p no:cacheprovider || exit 1
 
-echo "=== [7/10] multichip sharded serving + encoded mirror: identity + transfer budget + served dry-run"
+echo "=== [7/11] multichip sharded serving + encoded mirror: identity + transfer budget + served dry-run"
 env JAX_PLATFORMS=cpu python -m pytest tests/test_multichip.py \
     tests/test_encode.py \
     tests/test_graft_entry.py -q -m 'not slow' -p no:cacheprovider || exit 1
 
-echo "=== [8/10] device-side compaction: stored-domain differential + victim-only decode + bench-compact smoke"
+echo "=== [8/11] device-side compaction: stored-domain differential + victim-only decode + bench-compact smoke"
 env JAX_PLATFORMS=cpu python -m pytest tests/test_compact_device.py \
     tests/test_compact_faults.py -q -m 'not slow' \
     -p no:cacheprovider || exit 1
 env JAX_PLATFORMS=cpu KB_BENCH_METRIC=compact KB_BENCH_KEYS=4000 \
     python bench.py || exit 1
 
-echo "=== [9/10] chaos: fault-schedule determinism + inertness + taxonomy + FAULTS=smoke consistency gate"
+echo "=== [9/11] replica: fence reads + bounded staleness + watch resume + two-replica gRPC smoke"
+env JAX_PLATFORMS=cpu python -m pytest tests/test_replica.py -q -m 'not slow' \
+    -p no:cacheprovider || exit 1
+
+echo "=== [10/11] chaos: fault-schedule determinism + inertness + taxonomy + FAULTS=smoke consistency gate"
 env JAX_PLATFORMS=cpu python -m pytest tests/test_faults.py \
     tests/test_watch_robustness.py -q -m 'not slow' \
     -p no:cacheprovider || exit 1
 
-echo "=== [10/10] tier-1 tests (ROADMAP.md verify, one definition: make test-tier1)"
+echo "=== [11/11] tier-1 tests (ROADMAP.md verify, one definition: make test-tier1)"
 exec make test-tier1

@@ -40,6 +40,8 @@ DEEP_RULES = {
     "KB113": "host sync transitively reachable from jit/shard_map-traced code",
     "KB114": "device-array taint escaping to host outside the KB111 allowlist",
     "KB115": "static lock-acquisition-order graph must be acyclic",
+    "KB119": "leader-only mutation surface reachable from follower-role "
+             "(kubebrain_tpu/replica/) serving modules",
 }
 
 #: sync op kinds that are a host sync in ANY traced context, regardless of
@@ -409,6 +411,71 @@ def _kb114(graph: ProjectGraph, taint: _TaintSolver) -> Iterable[Finding]:
                 f"points (_host_pull and friends) may pull device data")
 
 
+# ----------------------------------------------------------- replica (119)
+
+#: leader-only mutation surfaces (Class.method labels): the revision
+#: dealers, the local sequencer's ring path, and the lease-state mutators.
+#: A follower that reaches any of these would mint revisions or mutate
+#: lease state the leader never sees — the split-brain KB119 exists to
+#: make statically impossible (docs/replication.md). Adopting the
+#: leader's committed floor (TSO.commit/init via ingest_replicated) is
+#: deliberately NOT here: that is how a follower follows.
+_KB119_LEADER_ONLY = frozenset({
+    "TSO.deal", "TSO.deal_block",
+    "Backend._notify", "Backend._notify_many", "Backend._drain",
+    "LeaseRegistry.grant", "LeaseRegistry.keepalive",
+    "LeaseReaper.revoke",
+})
+
+_KB119_ROOT = "kubebrain_tpu/replica/"
+
+
+def _kb119(graph: ProjectGraph) -> Iterable[Finding]:
+    """Any function defined under kubebrain_tpu/replica/ whose resolved
+    call graph reaches a leader-only mutation surface. Reverse BFS from
+    the forbidden targets (shortest witness chains), then one pass over
+    replica call sites — same over-approximation-on-resolved-edges-only
+    contract as KB112: unresolved calls are counted in stats, not
+    guessed."""
+    witness: dict[str, list[str]] = {}
+    frontier: list[str] = []
+    for qn in graph.functions:
+        if _fn_label(qn) in _KB119_LEADER_ONLY:
+            witness[qn] = [qn]
+            frontier.append(qn)
+    while frontier:
+        nxt: list[str] = []
+        for qn in frontier:
+            chain = witness[qn]
+            for caller in graph.callers.get(qn, ()):
+                if caller in witness:
+                    continue
+                for cs, targets in graph.calls.get(caller, ()):
+                    if not cs.is_ref and qn in targets:
+                        witness[caller] = [caller] + chain
+                        nxt.append(caller)
+                        break
+        frontier = nxt
+    for qn, fs in graph.functions.items():
+        rp = fs.relpath.replace("\\", "/")
+        if not rp.startswith(_KB119_ROOT):
+            continue
+        for cs, targets in graph.calls.get(qn, ()):
+            if cs.is_ref:
+                continue
+            for tgt in targets:
+                w = witness.get(tgt)
+                if w is None:
+                    continue
+                yield Finding(
+                    fs.relpath, cs.line, cs.col, "KB119",
+                    f"leader-only mutation surface reachable from follower-"
+                    f"role module: {_fn_label(qn)} -> {_chain_str(w)} "
+                    f"(replica/ code must never deal revisions, run the "
+                    f"local sequencer, or mutate lease state)")
+                break  # one finding per call site
+
+
 # -------------------------------------------------------------- lock order
 
 
@@ -568,6 +635,7 @@ def analyze(graph: ProjectGraph,
     findings.extend(_kb114(graph, taint))
     kb115, lock_graph = _kb115(graph, runtime_lock_edges)
     findings.extend(kb115)
+    findings.extend(_kb119(graph))
 
     # suppression pragmas (flagged line or the comment line above it)
     by_rel = {ms.relpath: ms for ms in graph.modules.values()}
