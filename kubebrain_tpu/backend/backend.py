@@ -48,6 +48,7 @@ from .errors import (
     KeyExistsError,
     WatchExpiredError,
 )
+from ..util import fieldcheck
 from .retry import AsyncFifoRetry
 from .ring import Ring
 from .scanner import CompactHistory, Scanner
@@ -71,6 +72,7 @@ class BackendConfig:
     scanner_workers: int = 8
 
 
+@fieldcheck.track
 class Backend:
     def __init__(self, store: KvStorage, config: BackendConfig | None = None):
         self.config = config or BackendConfig()
@@ -100,6 +102,12 @@ class Backend:
         self._compact_rev_cache = -1
         self._compact_cache_time = 0.0
         self._compact_lock = threading.Lock()
+        # guards ONLY the two cache fields above — never held across
+        # engine work. The TTL getter must not take _compact_lock itself:
+        # compact() holds that across its whole GC pass, and every
+        # Range/Count consults the getter (a convoy exactly like the PR 8
+        # _rr_lock pool rebuild)
+        self._compact_cache_lock = threading.Lock()
 
         # revision-indexed event ring (reference backend.go:111; txn.go:291)
         self._ring_cap = self.config.event_ring_capacity
@@ -835,8 +843,9 @@ class Backend:
         GC-free :meth:`set_compact_floor` so the record format and cache
         invalidation can never diverge between the two."""
         self._set_compact_record(target, current)
-        self._compact_rev_cache = target
-        self._compact_cache_time = time.monotonic()
+        with self._compact_cache_lock:
+            self._compact_rev_cache = target
+            self._compact_cache_time = time.monotonic()
 
     def set_compact_floor(self, revision: int) -> int:
         """Persist the compact watermark WITHOUT running GC borders — the
@@ -891,11 +900,24 @@ class Backend:
         return rev
 
     def _compact_revision_cached(self) -> int:
-        now = time.monotonic()
-        if self._compact_rev_cache < 0 or now - self._compact_cache_time > 1.0:
-            self._compact_rev_cache = self._compact_revision_at(None)
-            self._compact_cache_time = now
-        return self._compact_rev_cache
+        # cache fields ride their own tiny lock (kblint KB120: the
+        # lock-free RMW raced _persist_compact_floor_locked's update); the
+        # STORE read happens outside any hold, and the install is
+        # monotonic — a refresh that raced a concurrent compaction can
+        # only raise the floor, never resurrect a pre-compact one (the
+        # watermark itself never decreases; -1 means invalidated)
+        with self._compact_cache_lock:
+            now = time.monotonic()
+            cached = self._compact_rev_cache
+            if cached >= 0 and now - self._compact_cache_time <= 1.0:
+                return cached
+        fetched = self._compact_revision_at(None)
+        with self._compact_cache_lock:
+            if fetched > self._compact_rev_cache:
+                self._compact_rev_cache = fetched
+            if now > self._compact_cache_time:
+                self._compact_cache_time = now
+            return self._compact_rev_cache
 
     def compact_revision(self) -> int:
         return self._compact_revision_at(None)
@@ -1030,8 +1052,12 @@ class Backend:
                 idx = self._next_rev % self._ring_cap
                 if self._ring[idx] is None:
                     self._ring_cond.wait(timeout=0.2)
-            if self._closed:
-                return
+                    # wait() reacquired the condition: the post-wait close
+                    # check rides the SAME hold — the bare re-read outside
+                    # the lock had no guard in common with close()'s
+                    # write (kblint KB120)
+                    if self._closed:
+                        return
             self._drain()
 
     def _flush(self, batch: list[WatchEvent]) -> None:
@@ -1136,7 +1162,8 @@ class Backend:
         self.watcher_hub.close()
         if hasattr(self.scanner, "mark_uncertain"):
             self.scanner.mark_uncertain()
-        self._compact_rev_cache = -1  # re-read the watermark from storage
+        with self._compact_cache_lock:
+            self._compact_rev_cache = -1  # re-read the watermark from storage
 
     def _read_revision_checked(self, revision: int) -> int:
         committed = self.tso.committed()

@@ -23,6 +23,7 @@ import threading
 import time
 from typing import Callable
 
+from ..util import fieldcheck
 from .common import WatchEvent
 
 SUBSCRIBER_BUFFER = 10000
@@ -111,6 +112,7 @@ class _RangeIndex:
         return self._cover[idx]
 
 
+@fieldcheck.track
 class WatcherHub:
     def __init__(self, fanout_matcher: Callable | None = None):
         self._lock = threading.Lock()

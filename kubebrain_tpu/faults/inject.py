@@ -38,7 +38,7 @@ from .plane import FaultInjectedError, FaultPlane
 
 
 class FaultyStorage(KvStorage):
-    def __init__(self, inner: KvStorage, plane: FaultPlane):
+    def __init__(self, inner: KvStorage, plane: FaultPlane) -> None:
         self._inner = inner
         self._plane = plane
         # capability mirroring (the metrics_wrap pattern): hasattr() on this
@@ -178,7 +178,7 @@ class _FaultyBatch(BatchWrite):
     """Records ops on the inner batch; the injection decision happens at
     commit (the atomic boundary — a batch either applies whole or not)."""
 
-    def __init__(self, inner: BatchWrite, owner: FaultyStorage):
+    def __init__(self, inner: BatchWrite, owner: FaultyStorage) -> None:
         self._inner = inner
         self._owner = owner
 

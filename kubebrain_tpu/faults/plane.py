@@ -24,6 +24,7 @@ import random
 import threading
 import time
 from collections import Counter
+from typing import Any
 
 from ..storage.errors import StorageError, UncertainResultError
 from . import schedule as _sched
@@ -39,7 +40,8 @@ class FaultPlane:
     #: cadence of the watch-reset daemon's window polling
     WATCH_TICK_S = 0.25
 
-    def __init__(self, sched: _sched.FaultSchedule, metrics=None):
+    def __init__(self, sched: _sched.FaultSchedule,
+                 metrics: Any = None) -> None:
         self.schedule = sched
         self._metrics = metrics
         self._lock = threading.Lock()
@@ -51,14 +53,15 @@ class FaultPlane:
         self.injected: Counter = Counter()
 
     # ------------------------------------------------------------- lifecycle
-    def bind_hub(self, hub) -> None:
+    def bind_hub(self, hub: Any) -> None:
         """Give the plane the watcher hub so armed ``watch_reset`` windows
         can drop live watch streams server-side."""
         self._hub = hub
 
     @property
     def armed(self) -> bool:
-        return self._t0 is not None
+        with self._lock:
+            return self._t0 is not None
 
     def arm(self) -> None:
         with self._lock:
@@ -80,7 +83,11 @@ class FaultPlane:
 
     # -------------------------------------------------------------- plumbing
     def _elapsed_ms(self) -> int | None:
-        t0 = self._t0
+        # snapshot under the lock: arm() publishes _t0 under it, and this
+        # runs on every injection-point probe across request threads and
+        # the watch-reset daemon (kblint KB120)
+        with self._lock:
+            t0 = self._t0
         if t0 is None:
             return None
         return int((time.monotonic() - t0) * 1000)

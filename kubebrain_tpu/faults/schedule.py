@@ -22,6 +22,7 @@ from __future__ import annotations
 import hashlib
 import random
 from dataclasses import dataclass
+from typing import Iterator
 
 # ------------------------------------------------------------ fault taxonomy
 #: storage-op boundary (create/update/delete/write_batch/get/iter/scan)
@@ -103,7 +104,7 @@ class FaultSchedule:
                 seen.append(w.kind)
         return tuple(seen)
 
-    def active(self, t_ms: int, kind: str):
+    def active(self, t_ms: int, kind: str) -> "Iterator[FaultWindow]":
         for w in self.windows:
             if w.kind == kind and w.active(t_ms):
                 yield w

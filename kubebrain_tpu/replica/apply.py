@@ -24,6 +24,7 @@ advance can never skip an owed event.
 from __future__ import annotations
 
 import threading
+from typing import Any, Sequence
 
 from .. import coder
 from ..backend import creator
@@ -45,7 +46,8 @@ class ReplicaApplier:
         self.applied_batches = 0
 
     # ------------------------------------------------------------ bootstrap
-    def apply_bootstrap(self, kvs, revision: int) -> None:
+    def apply_bootstrap(self, kvs: "Sequence[Any]",
+                        revision: int) -> None:
         """Seed a stateless follower from one leader list pinned at
         ``revision``: every (key, value, mod_revision) becomes its MVCC row
         pair, the compact floor moves to ``revision`` (history below the
@@ -71,7 +73,8 @@ class ReplicaApplier:
             self._role.note_applied(revision, revision)
 
     # --------------------------------------------------------- wire events
-    def apply_wire_events(self, events, header_revision: int) -> None:
+    def apply_wire_events(self, events: "Sequence[Any]",
+                          header_revision: int) -> None:
         """One replicated batch (possibly empty = progress notification)."""
         with self._lock:
             watermark = self.backend.tso.committed()
@@ -98,7 +101,7 @@ class ReplicaApplier:
             self._role.note_applied(
                 self.backend.tso.committed(), header_revision)
 
-    def _apply_one(self, batch, ev) -> WatchEvent:
+    def _apply_one(self, batch: Any, ev: Any) -> WatchEvent:
         key = bytes(ev.kv.key)
         rev = int(ev.kv.mod_revision)
         if ev.type == kv_pb2.Event.DELETE:
@@ -117,7 +120,7 @@ class ReplicaApplier:
             event.prev_value = bytes(ev.prev_kv.value)
         return event
 
-    def _put_rows(self, batch, key: bytes, rev: int, value: bytes,
+    def _put_rows(self, batch: Any, key: bytes, rev: int, value: bytes,
                   deleted: bool) -> None:
         # same TTL policy as the leader's write path: replicated lease
         # expiry arrives as ordinary delete EVENTS (the reaper's revision-

@@ -30,6 +30,7 @@ import threading
 import time
 from collections import Counter
 from dataclasses import dataclass
+from typing import Any
 
 import grpc
 
@@ -93,8 +94,9 @@ class FollowerRole:
     """The follower's routing/consistency brain. Also implements the
     PeerService surface so it can be passed wherever ``peers`` goes."""
 
-    def __init__(self, backend, config: FollowerConfig, metrics=None,
-                 fault_plane=None, identity: str = "follower"):
+    def __init__(self, backend: Any, config: FollowerConfig,
+                 metrics: Any = None, fault_plane: Any = None,
+                 identity: str = "follower") -> None:
         self.backend = backend
         self.config = config
         self.identity = identity
@@ -350,7 +352,7 @@ class FollowerRole:
                 else grpc.insecure_channel(self.config.leader_address))
         return self._channel
 
-    def _stub(self, name: str):
+    def _stub(self, name: str) -> Any:
         with self._lock:
             self._leader_channel_locked()
             stub = self._stubs.get(name)
@@ -375,7 +377,7 @@ class FollowerRole:
             raise LeaderUnreachableError(
                 "leader unreachable (fault injection)")
 
-    def forward_unary(self, name: str, request, context):
+    def forward_unary(self, name: str, request: Any, context: Any) -> Any:
         """Forward one unary RPC to the leader. gRPC failures re-abort with
         the LEADER'S status code + details verbatim: the client's
         safe-vs-ambiguous classification must see exactly what a direct

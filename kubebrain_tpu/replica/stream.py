@@ -36,6 +36,7 @@ from __future__ import annotations
 import sys
 import threading
 import time
+from typing import Any
 
 from ..backend.common import TOMBSTONE, Verb, WatchEvent
 from ..client import EtcdCompatClient, WatchMux
@@ -133,7 +134,7 @@ class ReplicationStream:
                 self._stop.wait(backoff)
                 backoff = min(backoff * 2.0, _RECONNECT_BACKOFF_MAX_S)
 
-    def _tick_loop(self, mux: WatchMux, watch) -> bool:
+    def _tick_loop(self, mux: WatchMux, watch: Any) -> bool:
         """Progress-request ticker + fault gates + compact sync. Returns
         True when the teardown was deliberate (no reconnect backoff)."""
         cfg = self.role.config

@@ -65,6 +65,7 @@ from dataclasses import dataclass, field
 from typing import Any, Callable
 
 from ..trace import TRACER
+from ..util import fieldcheck
 from .lanes import Lane, classify, classify_write
 
 #: wire message kube-apiserver's etcd3 client recognizes and retries on
@@ -242,6 +243,7 @@ class _LaneQueue:
         return None
 
 
+@fieldcheck.track
 class RequestScheduler:
     """Admission + coalescing + bounded-depth pipelined dispatch.
 
@@ -365,7 +367,19 @@ class RequestScheduler:
         with self._cv:
             if self._closed:
                 return
-            self._closed = True
+            # the close latch is read under all three condition variables
+            # (dispatcher under _cv, slot waiters under _slots_cv, workers
+            # under _run_cv): set it while holding each so every reader
+            # shares a guard with this write, and notify inside the same
+            # holds — waiters wake immediately instead of riding out
+            # their 0.2 s poll timeout (kblint KB120). Acquisition order
+            # _cv -> _slots_cv -> _run_cv is new; KB115's static graph
+            # stays acyclic (no path takes them in reverse).
+            with self._slots_cv:
+                with self._run_cv:
+                    self._closed = True
+                    self._run_cv.notify_all()
+                self._slots_cv.notify_all()
             dangling: list[_Request] = []
             for lq in self._queues.values():
                 while True:
@@ -375,10 +389,6 @@ class RequestScheduler:
                     dangling.append(r)
             self._pending.clear()
             self._cv.notify_all()
-        with self._slots_cv:
-            self._slots_cv.notify_all()
-        with self._run_cv:
-            self._run_cv.notify_all()
         for r in dangling:
             r.finish(error=SchedClosedError("scheduler closed"))
         if self._dispatcher is not None:
@@ -572,7 +582,9 @@ class RequestScheduler:
                 # nothing will finish it
                 req.finish(error=SchedClosedError("scheduler closed"))
                 return
-            if self._closed:
+            with self._cv:
+                closed = self._closed
+            if closed:
                 self._release_slot()
                 req.finish(error=SchedClosedError("scheduler closed"))
                 return

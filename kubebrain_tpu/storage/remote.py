@@ -587,7 +587,12 @@ class RemoteKvStorage(KvStorage):
         a tier member — the ONE decoder of the ROLE payload. Every
         observation feeds the (epoch, ts) lineage tracker; pre-epoch
         daemons report epoch 0."""
-        addr = self._addresses[self._primary if idx is None else idx]
+        # snapshot the primary index under the lock: _repoint swaps it from
+        # failover threads, and an unguarded read here has no common guard
+        # with that write (kblint KB120)
+        with self._rr_lock:
+            primary = self._primary
+        addr = self._addresses[primary if idx is None else idx]
         status, payload = self._call_addr(addr, OP_ROLE, b"", timeout=timeout)
         if status != ST_OK:
             raise StorageError(f"ROLE failed (status {status})")
@@ -635,8 +640,10 @@ class RemoteKvStorage(KvStorage):
         UncertainResultError and repair through the retry path as usual.
         """
         last_exc: Exception | None = None
+        with self._rr_lock:
+            primary0 = self._primary
         for idx, addr in enumerate(self._addresses):
-            if idx == self._primary:
+            if idx == primary0:
                 continue
             try:
                 # only promote actual FOLLOWERS: a restarted old primary
@@ -652,7 +659,8 @@ class RemoteKvStorage(KvStorage):
                     # primary carries an older epoch no matter how far its
                     # standalone-acked clock ran ahead.
                     with self._rr_lock:
-                        adoptable = (cand_epoch, cand_ts) >= self._max_seen
+                        observed = self._max_seen
+                    adoptable = (cand_epoch, cand_ts) >= observed
                     if adoptable:
                         # _repoint updates _cur_epoch inside its locked
                         # swap; setting it here-and-early would tag acks
@@ -664,7 +672,7 @@ class RemoteKvStorage(KvStorage):
                     last_exc = StorageError(
                         f"{addr} is a primary of a stale lineage "
                         f"((epoch, ts) ({cand_epoch}, {cand_ts}) < observed "
-                        f"{self._max_seen}); refusing")
+                        f"{observed}); refusing")
                     continue
                 self.promote(idx, force=force)
             except (OSError, EOFError, StorageError) as exc:
@@ -714,9 +722,11 @@ class RemoteKvStorage(KvStorage):
         with self._rr_lock:
             if (epoch, ts) < self._max_seen:
                 stale = self._max_seen
+                already = True  # unused on the raise path
             else:
                 stale = None
-                if idx == self._primary:
+                already = idx == self._primary
+                if already:
                     # already pointed there: just refresh the snapshot.
                     # The repoint case defers to _repoint's locked swap so
                     # a refused/failed swap can't leave _cur_epoch
@@ -726,7 +736,7 @@ class RemoteKvStorage(KvStorage):
             raise StorageError(
                 f"best reachable leader {addr} has lineage ({epoch}, {ts}) "
                 f"< observed {stale}; refusing to adopt")
-        if idx != self._primary:
+        if not already:
             self._repoint(idx, addr, lineage=(epoch, ts))
         return idx
 
@@ -747,9 +757,11 @@ class RemoteKvStorage(KvStorage):
         # (kblint KB112). It also means a failed connect leaves the OLD
         # primary/pool intact instead of a repointed primary with stale
         # connections.
+        with self._rr_lock:
+            pool_size = len(self._pool)
         fresh: list[_PooledConn] = []
         try:
-            for _ in range(len(self._pool)):
+            for _ in range(pool_size):
                 fresh.append(_PooledConn(addr, self._timeout))
         except OSError:
             for c in fresh:

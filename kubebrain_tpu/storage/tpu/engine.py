@@ -38,6 +38,7 @@ from ...ops.compact import victim_mask
 from ...ops.scan import lex_geq, lex_less, visibility_mask, visibility_mask_queries
 from ...parallel.mesh import make_mesh
 from ...trace import TRACER
+from ...util import fieldcheck
 from .. import BatchWrite, CASFailedError, KvStorage, Partition, register_engine
 from ..errors import UncertainResultError
 from .blocks import (
@@ -437,6 +438,7 @@ def _victim_batch_pallas(keys_t, rh31, rl31, tomb8, ttl8, nv, start, end, unb,
     return f(keys_t, rh31, rl31, tomb8, ttl8, nv, start, end, unb, chi, clo, thi, tlo)
 
 
+@fieldcheck.track
 class TpuScanner(Scanner):
     """Scanner contract over the device mirror; host fallback for small
     limit queries (one engine iter beats a kernel launch for a 500-row page).
@@ -835,9 +837,11 @@ class TpuScanner(Scanner):
                         # _ensure_published — the very thing the
                         # degradation machinery exists to avoid
                         self._enter_degraded_locked("quarantined")
-                        n_before = self._mirror is not None
-                    if n_before:
-                        self.full_rebuild_total += 1
+                        # counter bump INSIDE the hold: the unguarded +=
+                        # raced the merge path's locked increment (lost
+                        # updates on the rebuild ledger, kblint KB120)
+                        if self._mirror is not None:
+                            self.full_rebuild_total += 1
                     self._rebuild_offline()
                 except Exception as e:  # keep the thread from dying silently
                     self._merge_bg_last_error = e
@@ -1082,9 +1086,14 @@ class TpuScanner(Scanner):
         """Chunk-major sign-flipped device copies for the Pallas kernel,
         computed once per mirror publish (identity-cached) — per-query work
         is then O(C) bound conversion, not an O(P·N·C) re-layout."""
-        cached = self._pallas_cache
-        if cached is not None and cached[0] is mirror:
-            return cached[1]
+        # identity check + install under _mlock (an RLock): the memo is
+        # cleared under it by every rebuild/merge/compact swap, and the
+        # lock-free install raced those clears (kblint KB120); the
+        # expensive re-layout stays OUTSIDE the hold
+        with self._mlock:
+            cached = self._pallas_cache
+            if cached is not None and cached[0] is mirror:
+                return cached[1]
         from ...ops.scan_pallas import prepare_mirror
 
         kt, rh31, rl31, t8, n = prepare_mirror(
@@ -1096,22 +1105,36 @@ class TpuScanner(Scanner):
             self._shard_put(kt), self._shard_put(rh31),
             self._shard_put(rl31), self._shard_put(t8), n,
         )
-        self._pallas_cache = (mirror, out)
+        with self._mlock:
+            cur = self._pallas_cache
+            if cur is not None and cur[0] is mirror:
+                return cur[1]  # another thread won the install race
+            self._pallas_cache = (mirror, out)
         return out
 
     def _pallas_ttl8(self, mirror: Mirror, npad: int):
         """TTL flag column in the pallas layout, built lazily on first
         compact() use (scan-only workloads never pay the ttl_dev round trip);
         identity-cached per mirror like `_pallas_layout`."""
-        cached = self._pallas_ttl_cache
-        if cached is not None and cached[0] is mirror:
-            return cached[1]
+        # the memo is cleared under _mlock by rebuild/merge/compact swaps
+        # but was read+installed here under _merge_lock only (no common
+        # guard, kblint KB120): take _mlock (an RLock — compact callers
+        # already inside it just re-enter) for the identity check and the
+        # install; the device pull stays OUTSIDE the hold
+        with self._mlock:
+            cached = self._pallas_ttl_cache
+            if cached is not None and cached[0] is mirror:
+                return cached[1]
         ttl_h = np.asarray(jax.device_get(mirror.ttl_dev)).astype(np.int8)
         pad = npad - ttl_h.shape[1]
         if pad:
             ttl_h = np.pad(ttl_h, ((0, 0), (0, pad)))
         ttl8 = self._shard_put(ttl_h)
-        self._pallas_ttl_cache = (mirror, ttl8)
+        with self._mlock:
+            cur = self._pallas_ttl_cache
+            if cur is not None and cur[0] is mirror:
+                return cur[1]  # another thread won the install race
+            self._pallas_ttl_cache = (mirror, ttl8)
         return ttl8
 
     def _dev_mask(self, mirror: Mirror, start: bytes, end: bytes, read_rev: int):
@@ -1456,9 +1479,12 @@ class TpuScanner(Scanner):
         only, raw or encoded per the mirror), identity-cached per mirror
         like `_pallas_layout`: void rows compare as raw bytes, so one
         np.searchsorted resolves every probe of a partition at once."""
-        cached = self._probe_cache
-        if cached is not None and cached[0] is mirror:
-            return cached[1]
+        # same memo discipline as _pallas_layout: check + install under
+        # _mlock, build outside it (kblint KB120)
+        with self._mlock:
+            cached = self._probe_cache
+            if cached is not None and cached[0] is mirror:
+                return cached[1]
         w = mirror.keys_host.shape[2] * 4
         views = []
         for p in range(mirror.partitions):
@@ -1468,7 +1494,11 @@ class TpuScanner(Scanner):
                 continue
             views.append(keyops.u8_void(
                 keyops.chunks_to_u8(mirror.keys_host[p, :nv])))
-        self._probe_cache = (mirror, views)
+        with self._mlock:
+            cur = self._probe_cache
+            if cur is not None and cur[0] is mirror:
+                return cur[1]  # another thread won the install race
+            self._probe_cache = (mirror, views)
         return views
 
     def _host_visible_batch(self, mirror: Mirror, ukeys: list, read_rev: int) -> list:

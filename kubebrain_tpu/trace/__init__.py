@@ -45,6 +45,8 @@ import threading
 import time
 from collections import deque
 
+from ..util import fieldcheck
+
 logger = logging.getLogger("kubebrain.trace")
 
 _SPAN: contextvars.ContextVar["Span | None"] = contextvars.ContextVar(
@@ -132,6 +134,7 @@ class Span:
         }
 
 
+@fieldcheck.track
 class Tracer:
     """Process-wide span recorder: bounded trace ring + slow-request log +
     per-stage EWMAs + the stage-latency histogram."""
@@ -280,29 +283,38 @@ class Tracer:
             m = self.metrics
             if m is not None:
                 m.emit_histogram(STAGE_METRIC, dur, stage=name)
-        prev = self._ewma.get(name)
-        self._ewma[name] = (
-            dur if prev is None else prev + self._ewma_alpha * (dur - prev)
-        )
-        if device:
-            prev = self._rtt.get(name)
-            self._rtt[name] = (
+        # EWMA update is a read-modify-write racing every worker thread
+        # (and reset()'s dict swap, which holds _lock): unguarded, two
+        # concurrent stages lose updates and a racing reset resurrects
+        # pre-reset values (kblint KB120)
+        with self._lock:
+            prev = self._ewma.get(name)
+            self._ewma[name] = (
                 dur if prev is None else prev + self._ewma_alpha * (dur - prev)
             )
+            if device:
+                prev = self._rtt.get(name)
+                self._rtt[name] = (
+                    dur if prev is None
+                    else prev + self._ewma_alpha * (dur - prev)
+                )
 
     # ---------------------------------------------------------------- ewmas
     def ewma(self, stage: str) -> float | None:
-        return self._ewma.get(stage)
+        with self._lock:
+            return self._ewma.get(stage)
 
     def device_ewma(self, stage: str) -> float | None:
         """EWMA over device-marked observations only (auto-depth inputs)."""
-        return self._rtt.get(stage)
+        with self._lock:
+            return self._rtt.get(stage)
 
     def dispatch_rtt(self) -> float | None:
         """EWMA of the device dispatch round trip (dispatch + compute),
         fed exclusively by device-marked stages; None until the device
         engine has been observed (pure host deployments never set it)."""
-        vals = [self._rtt[s] for s in self.RTT_STAGES if s in self._rtt]
+        with self._lock:
+            vals = [self._rtt[s] for s in self.RTT_STAGES if s in self._rtt]
         return sum(vals) if vals else None
 
     # ------------------------------------------------------------- snapshot
@@ -310,10 +322,7 @@ class Tracer:
         with self._lock:
             traces = [s.to_dict() for s in list(self._ring)[-limit:]]
             slow = [s.to_dict() for s in list(self._slow)]
-        # C-level copy first: serving threads insert first-seen stage keys
-        # concurrently, and iterating the live dict would raise
-        # "dictionary changed size during iteration" mid-scrape
-        ewma = dict(self._ewma)
+            ewma = dict(self._ewma)
         rtt = self.dispatch_rtt()
         return {
             "enabled": self.enabled,

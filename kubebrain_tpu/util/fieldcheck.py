@@ -1,0 +1,309 @@
+"""Opt-in runtime field-write sanitizer (``KB_FIELDCHECK=1``).
+
+The static linter's KB120–KB122 prove guard consistency on the call graph
+it can resolve; this shim watches what actually happens. Classes decorated
+with :func:`track` get an instrumented ``__setattr__`` that — while the
+shim is installed — records every attribute write as a
+``(class, field, thread, locks-held)`` tuple, with the held-lock set
+supplied by util/lockcheck.py (construction-site keyed, exactly the
+identities the static cross-check maps onto).
+
+From those observations it maintains, per field:
+
+- the set of **threads** that ever wrote it,
+- every distinct **guard set** (lock sites held at a write), and
+- the **common guard** (intersection over all observed writes) — the lock
+  the runtime says protects the field, or nothing.
+
+A field of ONE instance written from two or more threads whose observed
+guard sets share no common lock is recorded as a ``racy-field-write``
+violation (the runtime twin of static KB120) — per instance, because two
+objects each owned by their own thread are not a race. Violations are recorded, not raised at the
+write site; the pytest conftest drains them after each test and — under
+``KB_FIELDCHECK_STRICT=1`` — fails the test that produced them. The
+default is observe-only: benign deliberate racy writes (monotonic flags
+read lock-free by design) must not flake CI, they must show up in the
+cross-check report where a human triages them.
+
+Usage::
+
+    from kubebrain_tpu.util import fieldcheck
+    fieldcheck.install()           # or KB_FIELDCHECK=1 with tests/conftest.py
+    ...
+    fieldcheck.export_observed("/tmp/fields.json")
+    # then: python -m tools.kblint --deep \
+    #           --field-observed /tmp/fields.json --field-guards
+
+The export feeds kblint's ``--field-guards`` report: static-inferred
+guards vs runtime-observed ones, with ``static_only_fields`` (fields no
+sanitizer run ever wrote — the runtime detector's coverage gap) and
+``mismatches`` (guard disagreements) — the same cross-check contract as
+the KB115 lock-graph / lockcheck edge export.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import traceback
+from typing import Any, Callable, TypeVar
+
+_T = TypeVar("_T", bound=type)
+
+from . import lockcheck
+
+__all__ = [
+    "install",
+    "uninstall",
+    "installed",
+    "reset",
+    "track",
+    "observed",
+    "export_observed",
+    "take_violations",
+    "violations",
+    "Violation",
+    "FieldRaceError",
+]
+
+
+class FieldRaceError(AssertionError):
+    """Raised by the strict test harness when a multi-thread no-common-
+    guard field write was observed during the test that just ran."""
+
+
+@dataclasses.dataclass(frozen=True)
+class Violation:
+    kind: str          # "racy-field-write"
+    detail: str
+    stack: str
+
+    def render(self) -> str:
+        return f"[fieldcheck] {self.kind}: {self.detail}\n{self.stack}"
+
+
+# --------------------------------------------------------------------- state
+
+# an ORIGINAL (unwrapped) lock: the recorder must never appear inside the
+# guard sets it is recording
+_state_lock = lockcheck.raw_lock()
+_installed = False
+_we_installed_lockcheck = False
+# per-thread "inside a tracked constructor" depth: constructor writes
+# happen before the object is published, so they carry no guard and would
+# poison the per-field common-guard intersection — the runtime twin of
+# the static ownership (publish-immutable) exemption. Coarser than the
+# static escape-line analysis: writes AFTER a self-escape inside __init__
+# are suppressed too (documented approximation).
+_tls = threading.local()
+
+
+class _InstRec:
+    """Per-instance write history — races are per OBJECT: two schedulers
+    each written by their own single dispatcher thread are not a race,
+    which a (class, field)-global thread set would claim. Keyed by a
+    stamped per-object token (``_kb_fc_oid``), NOT ``id()``: address
+    reuse after GC would merge two sequentially-created objects'
+    single-writer histories into a phantom race (it did, across tests).
+    ``id(obj)`` remains the fallback for instances whose dict cannot be
+    written (slots/frozen)."""
+
+    __slots__ = ("threads", "guard_sets", "flagged")
+
+    def __init__(self) -> None:
+        self.threads: set[int] = set()
+        self.guard_sets: set[frozenset[str]] = set()
+        self.flagged = False
+
+
+class _FieldRec:
+    __slots__ = ("cls_name", "field", "writes", "guard_sets", "insts")
+
+    def __init__(self, cls_name: str, field: str) -> None:
+        self.cls_name = cls_name
+        self.field = field
+        self.writes = 0
+        # class-level aggregate for the --field-observed export (guard
+        # sets are construction-site keyed, so instances built at the
+        # same line aggregate consistently)
+        self.guard_sets: set[frozenset[str]] = set()
+        self.insts: dict[int, _InstRec] = {}
+
+
+_fields: dict[str, _FieldRec] = {}
+_violations: list[Violation] = []
+_oid_counter = iter(range(1, 1 << 62))
+
+
+def _obj_token(obj: Any) -> int:
+    d = getattr(obj, "__dict__", None)
+    if d is None:
+        return id(obj)
+    tok = d.get("_kb_fc_oid")
+    if tok is None:
+        tok = next(_oid_counter)
+        # object.__setattr__ is the BASE implementation: it bypasses the
+        # tracking wrapper (no recursion) and lands in the instance dict
+        try:
+            object.__setattr__(obj, "_kb_fc_oid", tok)
+        except (AttributeError, TypeError):
+            return id(obj)
+    return tok
+
+
+def _record(cls: type, obj: Any, field: str) -> None:
+    # held sites are read BEFORE taking the state lock, so the recorder's
+    # own lock can never leak into a guard set
+    sites = frozenset(lockcheck.held_sites()) if lockcheck.installed() \
+        else frozenset()
+    key = f"{cls.__module__}::{cls.__qualname__}.{field}"
+    racy = None
+    with _state_lock:
+        rec = _fields.get(key)
+        if rec is None:
+            rec = _fields[key] = _FieldRec(cls.__qualname__, field)
+        rec.writes += 1
+        rec.guard_sets.add(sites)
+        tok = _obj_token(obj)
+        inst = rec.insts.get(tok)
+        if inst is None:
+            inst = rec.insts[tok] = _InstRec()
+        inst.threads.add(threading.get_ident())
+        inst.guard_sets.add(sites)
+        if (not inst.flagged and len(inst.threads) > 1
+                and not frozenset.intersection(*inst.guard_sets)):
+            inst.flagged = True
+            racy = (key, len(inst.threads),
+                    sorted(sorted(g) for g in inst.guard_sets))
+    if racy is not None:
+        stack = "".join(traceback.format_stack(limit=12)[:-2])
+        v = Violation(
+            "racy-field-write",
+            f"{racy[0]} (one instance) written from {racy[1]} threads "
+            f"with no common lock; observed guard sets: {racy[2]}",
+            stack,
+        )
+        with _state_lock:
+            _violations.append(v)
+
+
+# ----------------------------------------------------------------- tracking
+
+def track(cls: _T) -> _T:
+    """Class decorator: instrument ``__setattr__`` to record writes while
+    the shim is installed. When not installed the wrapper is one module-
+    global flag check — cheap enough to leave on serving-path classes
+    permanently."""
+    orig: Callable[[Any, str, Any], None] = cls.__setattr__
+    orig_init: Callable[..., None] = cls.__init__
+
+    def _kb_setattr(self: Any, name: str, value: Any,
+                    _orig: Callable[[Any, str, Any], None] = orig,
+                    _cls: type = cls) -> None:
+        if _installed and not getattr(_tls, "init_depth", 0):
+            _record(_cls, self, name)
+        _orig(self, name, value)
+
+    def _kb_init(self: Any, *args: Any,
+                 _orig: Callable[..., None] = orig_init,
+                 **kwargs: Any) -> None:
+        _tls.init_depth = getattr(_tls, "init_depth", 0) + 1
+        try:
+            _orig(self, *args, **kwargs)
+        finally:
+            _tls.init_depth -= 1
+
+    cls.__setattr__ = _kb_setattr  # type: ignore[method-assign, assignment]
+    cls.__init__ = _kb_init  # type: ignore[misc]
+    cls.__kb_fieldcheck__ = True  # type: ignore[attr-defined]
+    return cls
+
+
+# ----------------------------------------------------------------------- api
+
+def install() -> None:
+    """Start recording. Installs lockcheck too (guard sets are lock
+    construction sites — without the lock shim every write would read as
+    unguarded). Idempotent."""
+    global _installed, _we_installed_lockcheck
+    if _installed:
+        return
+    if not lockcheck.installed():
+        lockcheck.install()
+        _we_installed_lockcheck = True
+    _installed = True
+
+
+def uninstall() -> None:
+    """Stop recording; removes lockcheck only if install() added it."""
+    global _installed, _we_installed_lockcheck
+    if not _installed:
+        return
+    _installed = False
+    if _we_installed_lockcheck:
+        lockcheck.uninstall()
+        _we_installed_lockcheck = False
+
+
+def installed() -> bool:
+    return _installed
+
+
+def reset() -> None:
+    with _state_lock:
+        _fields.clear()
+        _violations.clear()
+
+
+def violations() -> list[Violation]:
+    with _state_lock:
+        return list(_violations)
+
+
+def take_violations() -> list[Violation]:
+    """Return and clear recorded violations (the strict conftest drain)."""
+    with _state_lock:
+        out = list(_violations)
+        _violations.clear()
+    return out
+
+
+def observed() -> list[dict]:
+    """Snapshot of observed fields in the ``--field-observed`` schema:
+    one dict per written field with its thread count, write count, every
+    distinct guard set, and the common guard (intersection)."""
+    out: list[dict] = []
+    with _state_lock:
+        for key in sorted(_fields):
+            rec = _fields[key]
+            common = frozenset.intersection(*rec.guard_sets) \
+                if rec.guard_sets else frozenset()
+            threads = max((len(i.threads) for i in rec.insts.values()),
+                          default=0)
+            out.append({
+                "key": key,
+                "class": rec.cls_name,
+                "field": rec.field,
+                # max threads writing any ONE instance (the per-object
+                # concurrency that matters for races)
+                "threads": threads,
+                "writes": rec.writes,
+                "guards": sorted(common),
+                "guard_sets": sorted(sorted(g) for g in rec.guard_sets),
+            })
+    return out
+
+
+def export_observed(path: str) -> int:
+    """Write the observed field-guard sets as JSON for the static
+    linter's cross-check (``python -m tools.kblint --deep
+    --field-observed <path> --field-guards``). Returns the number of
+    fields written. Set ``KB_FIELDCHECK_EXPORT=<path>`` to have the
+    pytest conftest export automatically at session end."""
+    import json
+    fields = observed()
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump({"format": "kblint-field-observed/v1",
+                   "fields": fields}, f, indent=1)
+        f.write("\n")
+    return len(fields)

@@ -48,6 +48,8 @@ __all__ = [
     "violations",
     "edges",
     "export_edges",
+    "held_sites",
+    "raw_lock",
     "Violation",
     "LockOrderError",
 ]
@@ -326,6 +328,22 @@ def take_violations() -> list[Violation]:
         out = list(_violations)
         _violations.clear()
     return out
+
+
+def held_sites() -> tuple[str, ...]:
+    """Construction sites ('pkg/file.py:NN') of the checked locks the
+    CALLING thread currently holds, innermost last. The fieldcheck write
+    sanitizer (util/fieldcheck.py) tags every tracked attribute write with
+    this so observed guard sets can be cross-checked against kblint's
+    static KB120 inference."""
+    return tuple(site for site, _ in _held())
+
+
+def raw_lock():
+    """An UNWRAPPED lock, usable by detector infrastructure that must not
+    trace itself (fieldcheck's state lock would otherwise show up inside
+    every recorded guard set)."""
+    return _orig_lock()
 
 
 def edges() -> list[tuple[str, str]]:

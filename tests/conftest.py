@@ -61,6 +61,37 @@ if _LOCKCHECK:
 
     _lockcheck.install()
 
+# ---------------------------------------------------------------------------
+# Opt-in field-write sanitizer (see kubebrain_tpu/util/fieldcheck.py and
+# docs/static_analysis.md). KB_FIELDCHECK=1 instruments the @fieldcheck.track
+# serving-path classes to record (class, field, thread, locks-held) on every
+# attribute write; KB_FIELDCHECK_EXPORT=<path> dumps the observed guard sets
+# at session end for kblint's --field-guards cross-check (the KB120 runtime
+# twin). Observe-only by default; KB_FIELDCHECK_STRICT=1 additionally FAILS
+# any test that produced a multi-thread no-common-guard write.
+
+_FIELDCHECK = os.environ.get("KB_FIELDCHECK") == "1"
+_FIELDCHECK_STRICT = os.environ.get("KB_FIELDCHECK_STRICT") == "1"
+if _FIELDCHECK:
+    from kubebrain_tpu.util import fieldcheck as _fieldcheck
+
+    _fieldcheck.install()  # installs lockcheck too (guard observation)
+
+
+@pytest.fixture(autouse=True)
+def _fieldcheck_guard():
+    if not (_FIELDCHECK and _FIELDCHECK_STRICT):
+        yield
+        return
+    _fieldcheck.take_violations()  # stale noise from other tests' threads
+    yield
+    found = _fieldcheck.take_violations()
+    if found:
+        raise _fieldcheck.FieldRaceError(
+            "racy field writes during this test:\n"
+            + "\n".join(v.render() for v in found)
+        )
+
 
 @pytest.fixture(autouse=True)
 def _lockcheck_guard():
@@ -90,6 +121,18 @@ def pytest_sessionfinish(session, exitstatus):
                 f"[lockcheck] exported {n} lock-order edges to {edges_path}\n")
         except OSError as e:
             sys.stderr.write(f"[lockcheck] edge export failed: {e}\n")
+    # KB_FIELDCHECK_EXPORT=<path>: dump observed field guard sets for the
+    # static linter's KB120 cross-check
+    # (python -m tools.kblint --deep --field-observed <path> --field-guards)
+    fields_path = os.environ.get("KB_FIELDCHECK_EXPORT")
+    if _FIELDCHECK and fields_path:
+        try:
+            n = _fieldcheck.export_observed(fields_path)
+            sys.stderr.write(
+                f"[fieldcheck] exported {n} observed fields to "
+                f"{fields_path}\n")
+        except OSError as e:
+            sys.stderr.write(f"[fieldcheck] field export failed: {e}\n")
 
 
 _DEADLINE_DEFAULT = 240.0

@@ -743,6 +743,11 @@ def test_cache_cold_warm_speedup_and_hit_accounting(tmp_path):
     # slower than parsing) — with 3x headroom so host-load noise between
     # two ~100ms runs cannot flake an otherwise-green build
     assert warm_s < cold_s * 3, (warm_s, cold_s)
+    # the deep phase itself (extraction incl. the v3 field summaries +
+    # propagation) must stay comfortably inside the enforced 60s CI
+    # budget, warm AND cold: 3x headroom discipline (60/3)
+    assert cold.stats["elapsed_seconds"] < 20.0, cold.stats
+    assert warm.stats["elapsed_seconds"] < 20.0, warm.stats
     # identical verdicts from cached summaries (JSON round-trip fidelity)
     assert [f.format() for f in warm.findings] == \
         [f.format() for f in cold.findings]

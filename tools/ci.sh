@@ -1,7 +1,7 @@
 #!/usr/bin/env bash
 # The offline CI entry point (mirrored by .github/workflows/check.yml):
 #   1. make lint        — kblint project invariants (syntactic KB101-KB111
-#                         + the --deep interprocedural tier KB112-KB115,
+#                         + the --deep interprocedural tier KB112-KB122,
 #                         zero non-baselined findings, <60s budget
 #                         enforced) + native lint, then the kblint engine
 #                         self-tests (rule fixtures, differential corpus,
@@ -64,7 +64,8 @@ cd "$(dirname "$0")/.."
 echo "=== [1/11] make lint (syntactic + deep interprocedural, 60s budget)"
 make lint || exit 1
 env JAX_PLATFORMS=cpu python -m pytest tests/test_kblint.py \
-    tests/test_kblint_deep.py -q -m 'not slow' -p no:cacheprovider || exit 1
+    tests/test_kblint_deep.py tests/test_kblint_races.py \
+    -q -m 'not slow' -p no:cacheprovider || exit 1
 
 echo "=== [2/11] make typecheck"
 make typecheck || exit 1
@@ -73,6 +74,13 @@ echo "=== [3/11] scheduler semantics + query-batched scan + write group commit +
 env JAX_PLATFORMS=cpu python -m pytest tests/test_sched.py \
     tests/test_sched_batch.py tests/test_scan_pallas.py \
     tests/test_write_batch.py -q -m 'not slow' \
+    -p no:cacheprovider || exit 1
+# runtime field-write sanitizer smoke (docs/static_analysis.md): the
+# concurrency-heavy write-path module under KB_FIELDCHECK=1 — the
+# instrumented __setattr__ path must neither break the suite nor record
+# ungated multi-thread no-common-guard writes on the tracked classes
+env JAX_PLATFORMS=cpu KB_FIELDCHECK=1 KB_FIELDCHECK_STRICT=1 \
+    python -m pytest tests/test_write_batch.py -q -m 'not slow' \
     -p no:cacheprovider || exit 1
 make bench-smoke || exit 1
 

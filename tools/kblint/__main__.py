@@ -5,7 +5,7 @@ Two tiers (docs/static_analysis.md):
 - default: the syntactic per-file rules KB101–KB111 over ``paths``
 - ``--deep``: additionally builds the whole-program call graph over
   ``kubebrain_tpu/ + tools/ + bench.py`` and runs the interprocedural
-  rules KB112–KB115, filtered through tools/kblint/baseline.json and held
+  rules KB112–KB122, filtered through tools/kblint/baseline.json and held
   to a wall-clock budget (CI fails if the analysis outgrows it).
 
 Both tiers share the content-hash cache in ``.kblint_cache/`` (disable
@@ -42,7 +42,7 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--root", default=os.getcwd(),
                         help="repo root for relative paths (default: cwd)")
     parser.add_argument("--deep", action="store_true",
-                        help="run the interprocedural tier (KB112-KB115) "
+                        help="run the interprocedural tier (KB112-KB122) "
                              "over kubebrain_tpu/ + tools/ + bench.py")
     parser.add_argument("--baseline", default=DEFAULT_BASELINE,
                         help="baseline JSON pinning pre-existing deep "
@@ -61,6 +61,15 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--lock-graph", action="store_true",
                         help="print the static lock-order graph and the "
                              "runtime cross-check report")
+    parser.add_argument("--field-observed", default="",
+                        help="JSON file of runtime field-guard observations "
+                             "(util/fieldcheck.py export) to cross-check "
+                             "against the static KB120 guard inference; "
+                             "defaults to $KBLINT_FIELD_OBSERVED on --deep "
+                             "runs")
+    parser.add_argument("--field-guards", action="store_true",
+                        help="print the static field-guard report and the "
+                             "runtime fieldcheck cross-check")
     parser.add_argument("--stats", action="store_true",
                         help="print resolution/propagation statistics")
     parser.add_argument("--no-cache", action="store_true",
@@ -76,16 +85,21 @@ def main(argv: list[str] | None = None) -> int:
         return 0
 
     if not args.deep and (args.lock_edges or args.lock_graph or args.stats
-                          or args.write_baseline):
+                          or args.write_baseline or args.field_observed
+                          or args.field_guards):
         # a typo'd CI line must not pass green while doing none of the work
-        # (only EXPLICIT flags trigger this — the KBLINT_LOCK_EDGES env
-        # fallback is read later, on --deep runs only, so an exported env
-        # var cannot fail an ordinary syntactic run)
-        print("kblint: --lock-edges/--lock-graph/--stats/--write-baseline "
-              "require --deep", file=sys.stderr)
+        # (only EXPLICIT flags trigger this — the KBLINT_LOCK_EDGES /
+        # KBLINT_FIELD_OBSERVED env fallbacks are read later, on --deep
+        # runs only, so an exported env var cannot fail an ordinary
+        # syntactic run)
+        print("kblint: --lock-edges/--lock-graph/--field-observed/"
+              "--field-guards/--stats/--write-baseline require --deep",
+              file=sys.stderr)
         return 2
     if args.deep and not args.lock_edges:
         args.lock_edges = os.environ.get("KBLINT_LOCK_EDGES", "")
+    if args.deep and not args.field_observed:
+        args.field_observed = os.environ.get("KBLINT_FIELD_OBSERVED", "")
 
     t0 = time.monotonic()
     cache = None if args.no_cache else LintCache.from_env(args.root)
@@ -109,8 +123,24 @@ def main(argv: list[str] | None = None) -> int:
                 print(f"kblint: unreadable --lock-edges file: {e}",
                       file=sys.stderr)
                 return 2
+        field_obs = None
+        if args.field_observed:
+            try:
+                with open(args.field_observed, encoding="utf-8") as fh:
+                    data = json.load(fh)
+                if not isinstance(data, dict):
+                    raise ValueError(
+                        "expected the export_observed() object form "
+                        "({'fields': [...]}), got "
+                        + type(data).__name__)
+                field_obs = list(data.get("fields", []))
+            except (OSError, ValueError) as e:
+                print(f"kblint: unreadable --field-observed file: {e}",
+                      file=sys.stderr)
+                return 2
         result = deep_analyze_paths(args.root, DEEP_ROOTS, cache=cache,
-                                    runtime_lock_edges=runtime_edges)
+                                    runtime_lock_edges=runtime_edges,
+                                    runtime_field_obs=field_obs)
         baseline = Baseline.load(args.baseline)
         new, pinned, stale = baseline.split(result.findings)
         if args.write_baseline:
@@ -138,6 +168,8 @@ def main(argv: list[str] | None = None) -> int:
             print(json.dumps(s, indent=1, sort_keys=True))
         if args.lock_graph:
             print(json.dumps(result.lock_graph, indent=1, sort_keys=True))
+        if args.field_guards:
+            print(json.dumps(result.field_guards, indent=1, sort_keys=True))
 
     elapsed = time.monotonic() - t0
     if args.budget and elapsed > args.budget:

@@ -1,4 +1,4 @@
-"""Execution-context propagation + the interprocedural rules KB112–KB115.
+"""Execution-context propagation + the interprocedural rules KB112–KB122.
 
 Contexts propagated along the :class:`~tools.kblint.graph.ProjectGraph`:
 
@@ -32,7 +32,8 @@ from typing import Any, Iterable
 
 from .core import Finding
 from .rules import _BLOCKING_CALLS, _BLOCKING_MODULES, _HOST_TRANSFER_ALLOWED
-from .graph import (CallSite, FunctionSummary, ProjectGraph, _TRACE_WRAPPERS)
+from .graph import (_CALLBACK_SINKS, _LOCK_NAME_RE, _TRACE_WRAPPERS,
+                    AttrAccess, CallSite, FunctionSummary, ProjectGraph)
 
 #: rules implemented on the interprocedural engine
 DEEP_RULES = {
@@ -42,6 +43,12 @@ DEEP_RULES = {
     "KB115": "static lock-acquisition-order graph must be acyclic",
     "KB119": "leader-only mutation surface reachable from follower-role "
              "(kubebrain_tpu/replica/) serving modules",
+    "KB120": "field written under a lock at one site but accessed from a "
+             "thread-escaping context with no common lock at another",
+    "KB121": "field guarded by DIFFERENT locks at different sites (guard "
+             "inconsistency)",
+    "KB122": "lexical check-then-act: guarded read whose dependent write "
+             "re-acquires the lock (released across the decision)",
 }
 
 #: sync op kinds that are a host sync in ANY traced context, regardless of
@@ -63,6 +70,7 @@ class DeepResult:
     findings: list[Finding]
     stats: dict[str, Any]
     lock_graph: dict[str, Any]
+    field_guards: dict[str, Any] = dataclasses.field(default_factory=dict)
 
 
 def _fn_label(qn: str) -> str:
@@ -476,6 +484,480 @@ def _kb119(graph: ProjectGraph) -> Iterable[Finding]:
                 break  # one finding per call site
 
 
+# ------------------------------------------- field races (KB120–KB122)
+
+_WRITE_KINDS = ("write", "augwrite")
+
+
+@dataclasses.dataclass
+class _FieldSite:
+    """One field access with its EFFECTIVE lock context: the lexical stack
+    at the access plus the locks provably held at every resolved call into
+    the enclosing function (the must-hold entry set)."""
+
+    fs: FunctionSummary
+    acc: AttrAccess
+    eff: frozenset[str]
+
+
+def _is_spawn_name(name: str) -> bool:
+    tail = name.split(".")[-1]
+    return tail in _CALLBACK_SINKS or tail.endswith("_rpc_method_handler")
+
+
+def _thread_roots(graph: ProjectGraph) -> dict[str, str]:
+    """fn qualname -> why it runs off the constructing thread: references
+    passed to a spawn/callback sink (Thread/Timer/submit/..., gRPC
+    ``*_rpc_method_handler`` glue) — directly or through a project
+    forwarder that pipes its own parameter into one — plus ``run`` methods
+    of ``threading.Thread`` subclasses."""
+    # forwarders: _unary(fn, ...) -> grpc.unary_unary_rpc_method_handler(fn)
+    fwd: set[str] = set()
+    changed = True
+    while changed:
+        changed = False
+        for qn, fs in graph.functions.items():
+            if qn in fwd:
+                continue
+            resolved = graph.calls.get(qn, ())
+            for cs in fs.calls:
+                if not cs.is_ref or cs.name not in fs.params:
+                    continue
+                hit = _is_spawn_name(cs.ref_of)
+                if not hit:
+                    for cs2, targets in resolved:
+                        if (not cs2.is_ref and cs2.name == cs.ref_of
+                                and set(targets) & fwd):
+                            hit = True
+                            break
+                if hit:
+                    fwd.add(qn)
+                    changed = True
+                    break
+    roots: dict[str, str] = {}
+    for qn, fs in graph.functions.items():
+        resolved = graph.calls.get(qn, ())
+        for cs, targets in resolved:
+            if not cs.is_ref or not cs.ref_of:
+                continue
+            entering = _is_spawn_name(cs.ref_of)
+            if not entering:
+                for cs2, tgts2 in resolved:
+                    if (not cs2.is_ref and cs2.name == cs.ref_of
+                            and set(tgts2) & fwd):
+                        entering = True
+                        break
+            if entering:
+                for tgt in targets:
+                    roots.setdefault(
+                        tgt, f"{cs.ref_of}(...) at {fs.relpath}:{cs.line}")
+    for ms in graph.modules.values():
+        for cname, cinfo in ms.classes.items():
+            if any(b.split(".")[-1] == "Thread" for b in cinfo["bases"]):
+                qn = cinfo["methods"].get("run")
+                if qn and qn in graph.functions:
+                    roots.setdefault(qn, f"threading.Thread subclass {cname}")
+    return roots
+
+
+def _thread_escaped(graph: ProjectGraph,
+                    roots: dict[str, str]) -> dict[str, list[str]]:
+    """fn qualname -> witness chain from a thread-escape root (forward BFS
+    over resolved non-ref edges — same resolved-edges-only contract as
+    KB112: dynamic dispatch the resolver cannot see is counted in
+    ``unresolved_calls``, not guessed)."""
+    escaped: dict[str, list[str]] = {qn: [qn] for qn in roots}
+    frontier = list(roots)
+    while frontier:
+        nxt: list[str] = []
+        for qn in frontier:
+            chain = escaped[qn]
+            for cs, targets in graph.calls.get(qn, ()):
+                if cs.is_ref:
+                    continue
+                for tgt in targets:
+                    if tgt not in escaped:
+                        escaped[tgt] = chain + [tgt]
+                        nxt.append(tgt)
+        frontier = nxt
+    return escaped
+
+
+def _entry_locks(graph: ProjectGraph,
+                 roots: dict[str, str]) -> dict[str, frozenset[str]]:
+    """Must-hold lock set on entry to each function: the intersection over
+    every resolved call site of (caller's entry set + locks lexically held
+    at the site). Thread-escape roots, module bodies, functions whose
+    reference is passed around (invoked later in an unknown context), and
+    functions with no resolved callers all enter with the empty set — a
+    private helper only ever called under ``self._lock`` inherits the
+    guard, a public method does not."""
+    incoming: dict[str, list[tuple[str, frozenset[str]]]] = {}
+    forced: set[str] = set(roots)
+    for qn, fs in graph.functions.items():
+        if fs.name == "<module>":
+            forced.add(qn)
+    for qn in graph.functions:
+        for cs, targets in graph.calls.get(qn, ()):
+            for tgt in targets:
+                if cs.is_ref:
+                    forced.add(tgt)
+                else:
+                    incoming.setdefault(tgt, []).append(
+                        (qn, frozenset(cs.under_locks)))
+    top = object()  # optimistic "not yet constrained"
+    entry: dict[str, Any] = {}
+    for qn in graph.functions:
+        entry[qn] = (frozenset() if qn in forced or qn not in incoming
+                     else top)
+    changed = True
+    while changed:
+        changed = False
+        for qn in graph.functions:
+            if qn in forced or qn not in incoming:
+                continue
+            acc: Any = top
+            for caller, locks in incoming[qn]:
+                ce = entry.get(caller, frozenset())
+                if ce is top:
+                    continue
+                val = ce | locks
+                acc = val if acc is top else (acc & val)
+            if acc is not top and acc != entry[qn]:
+                entry[qn] = acc
+                changed = True
+    return {qn: (e if e is not top else frozenset())
+            for qn, e in entry.items()}
+
+
+def _field_table(graph: ProjectGraph,
+                 entry: dict[str, frozenset[str]]
+                 ) -> dict[str, list[_FieldSite]]:
+    """'module::Class.attr' -> every access site with effective locks."""
+    table: dict[str, list[_FieldSite]] = {}
+    for qn, fs in graph.functions.items():
+        ent = entry.get(qn, frozenset())
+        for a in fs.attr_accesses:
+            key = f"{fs.module}::{a.cls}.{a.attr}"
+            table.setdefault(key, []).append(_FieldSite(
+                fs=fs, acc=a, eff=frozenset(ent | set(a.under_locks))))
+    for sites in table.values():
+        sites.sort(key=lambda s: (s.fs.relpath, s.acc.line, s.acc.col))
+    return table
+
+
+def _publish_lines(graph: ProjectGraph) -> dict[str, float]:
+    """'module::Class' -> first line in __init__ where self escapes (inf
+    when the constructor never publishes self)."""
+    pub: dict[str, float] = {}
+    for qn, fs in graph.functions.items():
+        if fs.name == "__init__" and fs.cls is not None:
+            pub[f"{fs.module}::{fs.cls}"] = (
+                float(min(fs.self_escape_lines))
+                if fs.self_escape_lines else float("inf"))
+    return pub
+
+
+def _is_init_local(site: _FieldSite, pub: dict[str, float]) -> bool:
+    """Constructor accesses before self escapes happen-before every other
+    thread can hold the object — not race sites (the RacerD ownership
+    exemption)."""
+    fs, a = site.fs, site.acc
+    if fs.name != "__init__" or fs.cls != a.cls:
+        return False
+    return a.line < pub.get(f"{fs.module}::{a.cls}", float("inf"))
+
+
+def _immutable_fields(table: dict[str, list[_FieldSite]],
+                      pub: dict[str, float]) -> set[str]:
+    """Fields only ever written in __init__ before self escapes are
+    publish-immutable: reads anywhere are safe without any lock."""
+    out: set[str] = set()
+    for key, sites in table.items():
+        writes = [s for s in sites if s.acc.kind in _WRITE_KINDS]
+        if writes and all(_is_init_local(s, pub) for s in writes):
+            out.add(key)
+    return out
+
+
+def _field_label(key: str) -> str:
+    return key.rsplit("::", 1)[-1]
+
+
+def _site_str(s: _FieldSite) -> str:
+    return f"{s.fs.relpath}:{s.acc.line}"
+
+
+def _guard_str(eff: frozenset[str]) -> str:
+    return "{" + ", ".join(sorted(eff)) + "}" if eff else "no lock"
+
+
+def _field_races(graph: ProjectGraph,
+                 escaped: dict[str, list[str]],
+                 roots: dict[str, str],
+                 table: dict[str, list[_FieldSite]],
+                 pub: dict[str, float],
+                 immutable: set[str]) -> Iterable[Finding]:
+    """KB120 + KB121 over the field table. One finding per field (the
+    first qualifying pair in deterministic order), KB121 suppressed on
+    fields KB120 already flags (the stronger claim subsumes it)."""
+    for key in sorted(table):
+        if key in immutable or _LOCK_NAME_RE.search(key):
+            continue
+        sites = [s for s in table[key] if not _is_init_local(s, pub)]
+        if not sites or not sites[0].fs.relpath.replace(
+                "\\", "/").startswith("kubebrain_tpu/"):
+            continue
+        guarded_writes = [s for s in sites
+                          if s.acc.kind in _WRITE_KINDS and s.eff]
+        # ---- KB120: guarded write vs no-common-lock access, where the
+        # concurrency is real — the access itself runs in a thread-
+        # escaping context, OR it is a WRITE racing a thread-escaping
+        # guarded writer (the post-publication constructor-tail shape)
+        fired_120 = False
+        for s in sites:
+            chain = escaped.get(s.fs.qualname)
+            if chain is None and s.acc.kind in _WRITE_KINDS:
+                for w in guarded_writes:
+                    if w.fs.qualname in escaped and not (w.eff & s.eff) \
+                            and (w.fs.relpath, w.acc.line) != (
+                                s.fs.relpath, s.acc.line):
+                        chain = escaped[w.fs.qualname]
+                        break
+            if chain is None:
+                continue
+            for w in guarded_writes:
+                if (w.fs.relpath, w.acc.line) == (s.fs.relpath, s.acc.line):
+                    continue
+                if w.eff & s.eff:
+                    continue
+                root_why = roots.get(chain[0], "thread entry")
+                via = (_chain_str(chain) if len(chain) > 1
+                       else _fn_label(chain[0]))
+                yield Finding(
+                    s.fs.relpath, s.acc.line, s.acc.col, "KB120",
+                    f"field {_field_label(key)} written under "
+                    f"{_guard_str(w.eff)} at {_site_str(w)} but "
+                    f"{s.acc.kind} here holds {_guard_str(s.eff)} in a "
+                    f"thread-escaping context (enters via {root_why}: "
+                    f"{via})")
+                fired_120 = True
+                break
+            if fired_120:
+                break
+        if fired_120:
+            continue
+        # ---- KB121: a guarded WRITE and another guarded access with NO
+        # lock in common — both sites believe the field is protected, but
+        # by different locks. Pairwise on purpose: a write under the
+        # UNION of several locks shares a guard with a reader under any
+        # one of them (the multi-condition close-latch shape) and is
+        # consistent, which a global-intersection test would miss-flag.
+        guarded = [s for s in sites if s.eff]
+        pair = None
+        for w in guarded_writes:
+            for s in guarded:
+                if (w.fs.relpath, w.acc.line) == (s.fs.relpath, s.acc.line):
+                    continue
+                if not (w.eff & s.eff):
+                    pair = (w, s)
+                    break
+            if pair:
+                break
+        if pair:
+            w, s = pair
+            yield Finding(
+                w.fs.relpath, w.acc.line, w.acc.col, "KB121",
+                f"field {_field_label(key)} is guarded by DIFFERENT locks "
+                f"at different sites: {_guard_str(w.eff)} at {_site_str(w)}"
+                f" vs {_guard_str(s.eff)} at {_site_str(s)} — no common "
+                f"guard, so the two sites do not exclude each other")
+
+
+def _check_then_act(graph: ProjectGraph,
+                    escaped: dict[str, list[str]],
+                    table: dict[str, list[_FieldSite]],
+                    pub: dict[str, float],
+                    immutable: set[str]) -> Iterable[Finding]:
+    """KB122: inside one function, a guarded read of a shared field and a
+    later write to it under a SEPARATE acquisition of the same lock — the
+    lock was released across the decision, so the read's justification is
+    stale by the time the write lands. Shared = some other function also
+    writes the field, or this function itself thread-escapes (two threads
+    run the same check concurrently)."""
+    for key in sorted(table):
+        if key in immutable or _LOCK_NAME_RE.search(key):
+            continue
+        sites = [s for s in table[key] if not _is_init_local(s, pub)]
+        by_fn: dict[str, list[_FieldSite]] = {}
+        writers: set[str] = set()
+        for s in sites:
+            by_fn.setdefault(s.fs.qualname, []).append(s)
+            if s.acc.kind in _WRITE_KINDS:
+                writers.add(s.fs.qualname)
+        for qn, fn_sites in sorted(by_fn.items()):
+            if not fn_sites[0].fs.relpath.replace(
+                    "\\", "/").startswith("kubebrain_tpu/"):
+                continue
+            shared = bool(writers - {qn}) or qn in escaped
+            if not shared:
+                continue
+            reads = [s for s in fn_sites if s.acc.kind == "read"
+                     and s.acc.under_locks]
+            writes = [s for s in fn_sites if s.acc.kind in _WRITE_KINDS
+                      and s.acc.under_locks]
+            done: set[tuple[str, str]] = set()
+            for r in reads:
+                for w in writes:
+                    if w.acc.line <= r.acc.line:
+                        continue
+                    for lock in set(r.acc.under_locks) & set(
+                            w.acc.under_locks):
+                        r_acq = r.acc.acq_lines[
+                            r.acc.under_locks.index(lock)]
+                        # the read's OWN block also writes the field: the
+                        # check acted atomically under that hold (flag
+                        # claim / ownership transfer — `if not busy: busy
+                        # = True`); a later write is a state reset by the
+                        # owner, not a stale-decision act
+                        acted_inline = any(
+                            w0.acc.kind in _WRITE_KINDS
+                            and (lock, r_acq) in zip(w0.acc.under_locks,
+                                                     w0.acc.acq_lines)
+                            for w0 in fn_sites)
+                        if acted_inline:
+                            continue
+                        w_acqs = [w.acc.acq_lines[i]
+                                  for i, l in enumerate(w.acc.under_locks)
+                                  if l == lock]
+                        if r_acq in w_acqs:
+                            continue  # same (or enclosing) acquisition
+                        # a DIFFERENT lock held across both blocks (same
+                        # acquisition) protects the whole decision window
+                        # — the checkpoint-under-_ckpt_lock shape
+                        held_across = False
+                        for i, l2 in enumerate(r.acc.under_locks):
+                            if l2 == lock:
+                                continue
+                            pair = (l2, r.acc.acq_lines[i])
+                            if pair in zip(w.acc.under_locks,
+                                           w.acc.acq_lines):
+                                held_across = True
+                                break
+                        if held_across:
+                            continue
+                        # the write's own block RE-READS the field before
+                        # writing: the double-checked publish pattern
+                        # (snapshot -> expensive work off-lock -> reacquire,
+                        # re-validate, swap) is the sanctioned shape, not a
+                        # stale-decision bug
+                        revalidated = any(
+                            r2.acc.kind == "read"
+                            and r2.acc.line <= w.acc.line
+                            and any(l == lock and a not in (r_acq,)
+                                    and a in w_acqs
+                                    for l, a in zip(r2.acc.under_locks,
+                                                    r2.acc.acq_lines))
+                            for r2 in fn_sites)
+                        if revalidated:
+                            continue
+                        if (qn, lock) in done:
+                            continue
+                        done.add((qn, lock))
+                        yield Finding(
+                            w.fs.relpath, w.acc.line, w.acc.col, "KB122",
+                            f"check-then-act on {_field_label(key)}: read "
+                            f"at line {r.acc.line} under {lock} (acquired "
+                            f"line {r_acq}), but this dependent write "
+                            f"re-acquires it at line {w_acqs[0]} — the "
+                            f"lock was released across the decision")
+
+
+def _runtime_guard_sites(graph: ProjectGraph,
+                         eff: Iterable[str]) -> list[str]:
+    """Map static lock ids to lockcheck/fieldcheck construction-site keys
+    ('pkg/file.py:NN') where the construction site is known."""
+    out = []
+    for lock_id in eff:
+        site = graph.lock_sites.get(lock_id)
+        if site is None:
+            continue
+        rp, line = site
+        parts = rp.replace("\\", "/").split("/")
+        out.append(f"{parts[-2]}/{parts[-1]}:{line}" if len(parts) >= 2
+                   else f"{parts[-1]}:{line}")
+    return sorted(out)
+
+
+def _field_guard_report(graph: ProjectGraph,
+                        table: dict[str, list[_FieldSite]],
+                        pub: dict[str, float],
+                        immutable: set[str],
+                        escaped: dict[str, list[str]],
+                        runtime_fields: list[dict] | None
+                        ) -> dict[str, Any]:
+    """The KB115-style cross-check report: static-inferred guard per
+    written field vs the guard sets util/fieldcheck.py observed at
+    runtime. Static guard = intersection of effective locks over all
+    post-init write sites."""
+    static: dict[str, dict[str, Any]] = {}
+    for key, sites in sorted(table.items()):
+        if key in immutable or _LOCK_NAME_RE.search(key):
+            continue
+        # steady-state writes only: the runtime sanitizer suppresses ALL
+        # constructor writes (it cannot see escape lines), so the static
+        # side of the comparison excludes __init__ entirely — comparing
+        # post-publication guards on both sides
+        writes = [s for s in sites if s.acc.kind in _WRITE_KINDS
+                  and not (s.fs.name == "__init__"
+                           and s.fs.cls == s.acc.cls)]
+        if not writes:
+            continue
+        guard = frozenset.intersection(*[s.eff for s in writes])
+        static[key] = {
+            "write_sites": len(writes),
+            "guards": sorted(guard),
+            "guard_sites": _runtime_guard_sites(graph, guard),
+            "thread_escaping": any(s.fs.qualname in escaped
+                                   for s in sites),
+        }
+    report: dict[str, Any] = {
+        "static_written_fields": len(static),
+        "publish_immutable_fields": len(immutable),
+        "static": static,
+    }
+    if runtime_fields is not None:
+        observed = {f["key"]: f for f in runtime_fields if "key" in f}
+        matched = sorted(set(static) & set(observed))
+        agreements: list[str] = []
+        mismatches: list[dict[str, Any]] = []
+        for key in matched:
+            s_sites = set(static[key]["guard_sites"])
+            r_sites = set(observed[key].get("guards", []))
+            if s_sites == r_sites:
+                agreements.append(key)
+            else:
+                mismatches.append({
+                    "field": key,
+                    "static_guard_sites": sorted(s_sites),
+                    "runtime_guard_sites": sorted(r_sites),
+                    "runtime_threads": observed[key].get("threads", 0),
+                })
+        report.update({
+            "observed_fields": len(observed),
+            "matched_fields": len(matched),
+            "agreements": len(agreements),
+            "mismatches": mismatches,
+            # fields the static tier tracks that no runtime run has ever
+            # written under the sanitizer — the sanitizer's coverage gap,
+            # exactly like KB115's static_edges_unobserved
+            "static_only_fields": sorted(set(static) - set(observed)),
+            "runtime_only_fields": sorted(set(observed) - set(static)),
+            "coverage": (len(matched) / len(static) if static else 1.0),
+        })
+    return report
+
+
 # -------------------------------------------------------------- lock order
 
 
@@ -622,12 +1104,18 @@ def _kb115(graph: ProjectGraph,
 
 
 def analyze(graph: ProjectGraph,
-            runtime_lock_edges: list[tuple[str, str]] | None = None
-            ) -> DeepResult:
-    """Run all context propagations and the KB112–KB115 rules."""
+            runtime_lock_edges: list[tuple[str, str]] | None = None,
+            runtime_field_obs: list[dict] | None = None) -> DeepResult:
+    """Run all context propagations and the KB112–KB122 rules."""
     blocking = _blocking_witness(graph)
     traced = _traced_set(graph)
     taint = _TaintSolver(graph)
+    roots = _thread_roots(graph)
+    escaped = _thread_escaped(graph, roots)
+    entry = _entry_locks(graph, roots)
+    table = _field_table(graph, entry)
+    pub = _publish_lines(graph)
+    immutable = _immutable_fields(table, pub)
 
     findings: list[Finding] = []
     findings.extend(_kb112(graph, blocking))
@@ -636,6 +1124,11 @@ def analyze(graph: ProjectGraph,
     kb115, lock_graph = _kb115(graph, runtime_lock_edges)
     findings.extend(kb115)
     findings.extend(_kb119(graph))
+    findings.extend(_field_races(graph, escaped, roots, table, pub,
+                                 immutable))
+    findings.extend(_check_then_act(graph, escaped, table, pub, immutable))
+    field_guards = _field_guard_report(graph, table, pub, immutable,
+                                      escaped, runtime_field_obs)
 
     # suppression pragmas (flagged line or the comment line above it)
     by_rel = {ms.relpath: ms for ms in graph.modules.values()}
@@ -657,8 +1150,14 @@ def analyze(graph: ProjectGraph,
         "traced_functions": len(traced),
         "async_reachable": len(async_fns),
         "lock_edges": lock_graph["static_edge_count"],
+        "thread_roots": len(roots),
+        "thread_escaped": len(escaped),
+        "tracked_fields": len(table),
+        "publish_immutable_fields": len(immutable),
+        "field_access_sites": sum(len(v) for v in table.values()),
     })
-    return DeepResult(findings=kept, stats=stats, lock_graph=lock_graph)
+    return DeepResult(findings=kept, stats=stats, lock_graph=lock_graph,
+                      field_guards=field_guards)
 
 
 def _async_reachable(graph: ProjectGraph) -> set[str]:

@@ -266,9 +266,10 @@ DEEP_ROOTS = ["kubebrain_tpu", "tools", "bench.py"]
 
 
 def deep_analyze_sources(sources: dict[str, str],
-                         runtime_lock_edges: list | None = None) -> Any:
+                         runtime_lock_edges: list | None = None,
+                         runtime_field_obs: list | None = None) -> Any:
     """Deep tier over in-memory {relpath: source} (the self-test entry):
-    build summaries, stitch the graph, propagate, run KB112–KB115."""
+    build summaries, stitch the graph, propagate, run KB112–KB122."""
     from .contexts import analyze
     from .graph import ProjectGraph, extract_module
     summaries = [extract_module(src, rp) for rp, src in sorted(sources.items())]
@@ -278,12 +279,14 @@ def deep_analyze_sources(sources: dict[str, str],
     # zero-coverage detector as "no data"
     edges = ([tuple(e) for e in runtime_lock_edges]
              if runtime_lock_edges is not None else None)
-    return analyze(graph, runtime_lock_edges=edges)
+    return analyze(graph, runtime_lock_edges=edges,
+                   runtime_field_obs=runtime_field_obs)
 
 
 def deep_analyze_paths(root: str, roots: list[str] | None = None,
                        cache: "Any | None" = None,
-                       runtime_lock_edges: list | None = None) -> Any:
+                       runtime_lock_edges: list | None = None,
+                       runtime_field_obs: list | None = None) -> Any:
     """Deep tier over the repo tree. Per-file extraction rides the same
     content-hash cache as the syntactic tier (entry key "summary")."""
     from .contexts import analyze
@@ -319,7 +322,8 @@ def deep_analyze_paths(root: str, roots: list[str] | None = None,
     graph = ProjectGraph(summaries)
     edges = ([tuple(e) for e in runtime_lock_edges]
              if runtime_lock_edges is not None else None)
-    result = analyze(graph, runtime_lock_edges=edges)
+    result = analyze(graph, runtime_lock_edges=edges,
+                     runtime_field_obs=runtime_field_obs)
     result.stats["files_parsed"] = parsed
     result.stats["files_from_cache"] = from_cache
     result.stats["elapsed_seconds"] = round(time.monotonic() - t0, 3)

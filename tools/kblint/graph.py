@@ -18,7 +18,7 @@ Two phases, split so the first is cacheable per file (.kblint_cache/):
    (``stats.unresolved_calls``) rather than silently dropped — the
    analysis over-reports its own blindness instead of faking closure.
 
-The context propagation and the KB112–KB115 rules live in contexts.py.
+The context propagation and the KB112–KB122 rules live in contexts.py.
 """
 
 from __future__ import annotations
@@ -53,6 +53,17 @@ _HOST_CONV_NAMES = {
 _HOST_CONV_METHODS = {"tolist", "item"}
 
 _LOCK_NAME_RE = re.compile(r"lock$", re.IGNORECASE)
+
+#: calls whose function-reference arguments execute on ANOTHER thread (or a
+#: deferred context): the thread-escape roots for the field-race rules
+#: KB120–KB122. Matching is on the call's terminal name so both
+#: ``threading.Thread(target=f)`` and ``self._pool.submit(f)`` register.
+#: Arguments are walked one level deep, so ``Thread(target=crash_guard(
+#: self._loop))`` still records ``self._loop`` as escaping.
+_CALLBACK_SINKS = {
+    "Thread", "Timer", "submit", "start_new_thread", "run_in_executor",
+    "call_soon_threadsafe", "call_later", "add_done_callback",
+}
 # the suppression-pragma grammar is core.py's (one copy: a syntax change
 # there must not leave the deep tier parsing the old grammar)
 
@@ -118,6 +129,23 @@ class EscapeOp:
 
 
 @dataclasses.dataclass
+class AttrAccess:
+    """One ``self.x`` / ``cls.x`` field access inside a method body (the
+    KB120–KB122 site record). ``under_locks`` is the lexical lock stack at
+    the access; ``acq_lines`` is the parallel list of ``with``-statement
+    lines those locks were taken at (KB122 distinguishes two separate
+    acquisitions of the same lock in one function — the released window)."""
+
+    line: int
+    col: int
+    cls: str                  # enclosing class (fields key by module::cls.attr)
+    attr: str
+    kind: str                 # "read" | "write" | "augwrite"
+    under_locks: list[str]
+    acq_lines: list[int]
+
+
+@dataclasses.dataclass
 class FunctionSummary:
     qualname: str             # "pkg.mod::Class.meth" / "pkg.mod::func"
     name: str
@@ -135,6 +163,11 @@ class FunctionSummary:
     assigns: dict[str, list[str]] = dataclasses.field(default_factory=dict)
     returns: list[str] = dataclasses.field(default_factory=list)
     params: list[str] = dataclasses.field(default_factory=list)
+    attr_accesses: list[AttrAccess] = dataclasses.field(default_factory=list)
+    # lines where `self` escapes this method (passed as an argument,
+    # returned, stored, or a bound method handed out as a reference) — the
+    # publish point the ownership phase keys __init__ immutability on
+    self_escape_lines: list[int] = dataclasses.field(default_factory=list)
 
 
 @dataclasses.dataclass
@@ -177,7 +210,10 @@ class ModuleSummary:
                 sync_ops=[SyncOp(**s) for s in fd["sync_ops"]],
                 escapes=[EscapeOp(**e) for e in fd["escapes"]],
                 assigns=fd["assigns"], returns=fd["returns"],
-                params=fd["params"])
+                params=fd["params"],
+                attr_accesses=[AttrAccess(**a)
+                               for a in fd.get("attr_accesses", [])],
+                self_escape_lines=fd.get("self_escape_lines", []))
             ms.functions[qn] = fs
         return ms
 
@@ -220,21 +256,46 @@ def _is_trace_decorator(dec: ast.expr) -> bool:
     return False
 
 
-def _lock_expr_id(expr: ast.expr, module: str, cls: str | None) -> str | None:
+#: constructors whose instances act as locks in a ``with`` statement
+_LOCK_CTORS = ("threading.Lock", "threading.RLock", "threading.Condition")
+
+
+def _lock_expr_id(expr: ast.expr, module: str, cls: str | None,
+                  cls_info: dict | None = None) -> str | None:
     """Normalized identity for a lock-ish with-context expression, or None
     if the expression is not lock-named. ``self._lock`` in class C ->
     ``module::C._lock``; module-global ``_LK`` -> ``module::_LK``; other
     receivers collapse to ``~attr`` (one global node per attribute name —
-    ambiguous, but deterministic)."""
+    ambiguous, but deterministic). ``cls_info`` (the extractor's per-class
+    record) supplies lock ALIASES — ``self._lock = self._cond`` with
+    ``self._cond = threading.Condition()`` makes both names one lock, and
+    a Condition-named attribute (``_cv``) is lock-ish even though its name
+    fails the regex."""
     name = terminal_name(expr)
-    if not name or not _LOCK_NAME_RE.search(name):
+    if not name:
         return None
     if isinstance(expr, ast.Attribute):
         recv = expr.value
         if isinstance(recv, ast.Name) and recv.id in ("self", "cls") and cls:
-            return f"{module}::{cls}.{name}"
-        return f"~{name}"
-    return f"{module}::{name}"
+            if cls_info is not None:
+                aliases = cls_info.get("lock_aliases", {})
+                seen = set()
+                while name in aliases and name not in seen:
+                    seen.add(name)
+                    name = aliases[name]
+                if (name in cls_info.get("lock_attrs", [])
+                        or _LOCK_NAME_RE.search(name)):
+                    return f"{module}::{cls}.{name}"
+                return None
+            if _LOCK_NAME_RE.search(name):
+                return f"{module}::{cls}.{name}"
+            return None
+        if _LOCK_NAME_RE.search(name):
+            return f"~{name}"
+        return None
+    if _LOCK_NAME_RE.search(name):
+        return f"{module}::{name}"
+    return None
 
 
 class _Extractor(ast.NodeVisitor):
@@ -279,8 +340,10 @@ class _Extractor(ast.NodeVisitor):
             elif isinstance(node, ast.ClassDef) and cls is None and not prefix:
                 bases = [dotted_name(b) for b in node.bases if dotted_name(b)]
                 info: dict[str, Any] = {"bases": bases, "methods": {},
-                                        "attr_types": {}, "line": node.lineno}
+                                        "attr_types": {}, "line": node.lineno,
+                                        "lock_attrs": [], "lock_aliases": {}}
                 self.ms.classes[node.name] = info
+                self._prescan_locks(node, info)
                 for sub in node.body:
                     if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
                         qn = f"{self.ms.module}::{node.name}.{sub.name}"
@@ -290,15 +353,67 @@ class _Extractor(ast.NodeVisitor):
             elif isinstance(node, ast.Assign) and cls is None and not prefix:
                 self._module_assign(node)
 
+    def _prescan_locks(self, cnode: ast.ClassDef, info: dict) -> None:
+        """Class-wide lock identity prescan, BEFORE any method body is
+        walked: attributes constructed as Lock/RLock/Condition are
+        lock-ish regardless of name (``self._cv``), and plain attribute
+        aliases of them (``self._lock = self._cond``) or Condition
+        wrappers (``threading.Condition(self._lock)``) collapse to ONE
+        lock id — without this, code guarding one field through the
+        condition and through its lock looks like two different locks."""
+        for node in ast.walk(cnode):
+            if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+                continue
+            tgt = node.targets[0]
+            if not (isinstance(tgt, ast.Attribute)
+                    and isinstance(tgt.value, ast.Name)
+                    and tgt.value.id == "self"):
+                continue
+            value = node.value
+            if isinstance(value, ast.Call):
+                if dotted_name(value.func) in _LOCK_CTORS:
+                    if (value.args
+                            and isinstance(value.args[0], ast.Attribute)
+                            and isinstance(value.args[0].value, ast.Name)
+                            and value.args[0].value.id == "self"):
+                        # Condition(self._lock): same underlying lock
+                        info["lock_aliases"][tgt.attr] = value.args[0].attr
+                    elif tgt.attr not in info["lock_attrs"]:
+                        info["lock_attrs"].append(tgt.attr)
+            elif (isinstance(value, ast.Attribute)
+                  and isinstance(value.value, ast.Name)
+                  and value.value.id == "self"
+                  and (value.attr in info["lock_attrs"]
+                       or value.attr in info["lock_aliases"]
+                       or _LOCK_NAME_RE.search(value.attr))):
+                # self._lock = self._cond: one lock, two names
+                info["lock_aliases"].setdefault(tgt.attr, value.attr)
+
     def _infer_attr_types(self, cnode: ast.ClassDef, info: dict) -> None:
-        """self.x = ClassName(...) anywhere in the class body -> x: ClassName
-        (a dotted constructor reference, resolved later)."""
+        """self.x = ClassName(...) anywhere in the class body -> x:
+        ClassName (a dotted constructor reference, resolved later); also
+        ``self.x = self._meth()`` where ``_meth`` declares ``->
+        ClassName`` — the factory-method idiom (``self._delta =
+        self._fresh_delta()``) resolves through the return annotation."""
+        ret_types: dict[str, str] = {}
+        for sub in cnode.body:
+            if (isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef))
+                    and sub.returns is not None):
+                rname = dotted_name(sub.returns)
+                if (rname
+                        and rname.split(".")[-1].lstrip("_")[:1].isupper()):
+                    ret_types[sub.name] = rname
         for node in ast.walk(cnode):
             if not (isinstance(node, ast.Assign)
                     and isinstance(node.value, ast.Call)):
                 continue
             ctor = dotted_name(node.value.func)
-            if not ctor or not ctor.split(".")[-1][:1].isupper():
+            if not ctor:
+                continue
+            if ctor.startswith("self.") and ctor.count(".") == 1:
+                ctor = ret_types.get(ctor[len("self."):], "")
+            if (not ctor
+                    or not ctor.split(".")[-1].lstrip("_")[:1].isupper()):
                 continue
             for tgt in node.targets:
                 if (isinstance(tgt, ast.Attribute)
@@ -316,7 +431,7 @@ class _Extractor(ast.NodeVisitor):
         value = node.value
         if isinstance(value, ast.Call):
             fname = dotted_name(value.func)
-            if fname in ("threading.Lock", "threading.RLock"):
+            if fname in _LOCK_CTORS:
                 lock_id = f"{self.ms.module}::{target}"
                 self.ms.lock_sites[lock_id] = [self.ms.relpath, node.lineno]
                 return
@@ -354,8 +469,8 @@ class _Extractor(ast.NodeVisitor):
         # lock-construction sites inside methods (self._lock = Lock())
         for sub in ast.walk(node):
             if (isinstance(sub, ast.Assign) and isinstance(sub.value, ast.Call)
-                    and dotted_name(sub.value.func) in ("threading.Lock",
-                                                        "threading.RLock")):
+                    and dotted_name(sub.value.func) in _LOCK_CTORS
+                    and not sub.value.args):  # Condition(self._x) aliases
                 for tgt in sub.targets:
                     if (isinstance(tgt, ast.Attribute)
                             and isinstance(tgt.value, ast.Name)
@@ -364,11 +479,45 @@ class _Extractor(ast.NodeVisitor):
                         self.ms.lock_sites[lock_id] = [self.ms.relpath,
                                                        sub.lineno]
         self._extract_body(node.body, fs, cls, locks=[])
+        if cls is not None:
+            self._compute_self_escapes(node, fs)
         # nested defs become their own functions, resolvable from the outer
         # scope by name ("outer.<locals>.inner")
         for sub in node.body:
             self._extract_nested(sub, cls, f"{prefix}{node.name}.<locals>."
                                  if not cls else f"{cls}.{node.name}.<locals>.")
+
+    def _compute_self_escapes(self, node: ast.FunctionDef
+                              | ast.AsyncFunctionDef,
+                              fs: FunctionSummary) -> None:
+        """Lines where ``self`` leaves this method: any load of the bare
+        name that is not an attribute receiver (argument positions,
+        returns, container stores, comparisons — deliberately
+        conservative), plus bound-method references handed out
+        (``Thread(target=self._loop)`` publishes ``self`` to the thread)."""
+        recv_ids: set[int] = set()
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Attribute) and isinstance(sub.value,
+                                                             ast.Name):
+                recv_ids.add(id(sub.value))
+        esc: set[int] = set()
+        for sub in ast.walk(node):
+            if (isinstance(sub, ast.Name) and sub.id == "self"
+                    and isinstance(sub.ctx, ast.Load)
+                    and id(sub) not in recv_ids):
+                esc.add(sub.lineno)
+        for cs in fs.calls:
+            # a BOUND METHOD handed to a spawn/callback sink
+            # (Thread(target=self._loop)) publishes self to that thread;
+            # one stored in a plain constructor does not count — the
+            # receiving object cannot run it until something ELSE spawns,
+            # and that spawn is its own escape. self.a.b publishes the
+            # FIELD object a, not self.
+            if (cs.is_ref and cs.name.startswith("self.")
+                    and cs.name.count(".") == 1
+                    and cs.ref_of.split(".")[-1] in _CALLBACK_SINKS):
+                esc.add(cs.line)
+        fs.self_escape_lines = sorted(esc)
 
     def _extract_nested(self, node: ast.stmt, cls: str | None,
                         prefix: str) -> None:
@@ -418,32 +567,51 @@ class _Extractor(ast.NodeVisitor):
         return sorted(out)
 
     def _extract_body(self, body: list[ast.stmt], fs: FunctionSummary,
-                      cls: str | None, locks: list[str]) -> None:
+                      cls: str | None,
+                      locks: list[tuple[str, int]]) -> None:
         """Walk statements in ``fs``'s own execution scope, tracking the
-        lexical lock stack; nested defs/lambdas are boundaries (their code
-        runs later, under different conditions)."""
+        lexical lock stack as (lock id, with-statement line) pairs; nested
+        defs/lambdas are boundaries (their code runs later, under
+        different conditions)."""
         for stmt in body:
             self._extract_stmt(stmt, fs, cls, locks)
 
     def _extract_stmt(self, node: ast.AST, fs: FunctionSummary,
-                      cls: str | None, locks: list[str]) -> None:
+                      cls: str | None,
+                      locks: list[tuple[str, int]]) -> None:
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
                              ast.ClassDef)):
             return  # boundary: handled by _extract_nested / _extract_scope
         if isinstance(node, (ast.With, ast.AsyncWith)):
             new_locks = list(locks)
             for item in node.items:
-                lock_id = _lock_expr_id(item.context_expr, self.ms.module, cls)
+                lock_id = _lock_expr_id(
+                    item.context_expr, self.ms.module, cls,
+                    self.ms.classes.get(cls) if cls else None)
                 # the context expression itself evaluates under the OUTER set
-                self._extract_expr(item.context_expr, fs, locks)
+                self._extract_expr(item.context_expr, fs, locks, cls)
                 if lock_id is not None:
-                    fs.acquires.append(LockAcq(lock_id=lock_id,
-                                               line=node.lineno,
-                                               under_locks=list(new_locks)))
-                    new_locks.append(lock_id)
+                    fs.acquires.append(LockAcq(
+                        lock_id=lock_id, line=node.lineno,
+                        under_locks=[l for l, _ in new_locks]))
+                    new_locks.append((lock_id, node.lineno))
             for sub in node.body:
                 self._extract_stmt(sub, fs, cls, new_locks)
             return
+        if isinstance(node, ast.AugAssign):
+            # self.x += 1 is a read-modify-write in ONE record (the racy
+            # increment shape); the value expression still walks normally
+            tgt = node.target
+            if (isinstance(tgt, ast.Attribute)
+                    and isinstance(tgt.value, ast.Name)
+                    and tgt.value.id in ("self", "cls") and cls):
+                fs.attr_accesses.append(AttrAccess(
+                    line=tgt.lineno, col=tgt.col_offset, cls=cls,
+                    attr=tgt.attr, kind="augwrite",
+                    under_locks=[l for l, _ in locks],
+                    acq_lines=[ln for _, ln in locks]))
+                self._extract_expr(node.value, fs, locks, cls)
+                return
         if isinstance(node, ast.Assign):
             atoms = self._atoms(node.value, fs)
 
@@ -475,12 +643,15 @@ class _Extractor(ast.NodeVisitor):
                                   ast.ClassDef, ast.Lambda)):
                 continue
             if isinstance(child, ast.expr):
-                self._extract_expr(child, fs, locks)
+                self._extract_expr(child, fs, locks, cls)
             else:
                 self._extract_stmt(child, fs, cls, locks)
 
     def _extract_expr(self, expr: ast.expr, fs: FunctionSummary,
-                      locks: list[str]) -> None:
+                      locks: list[tuple[str, int]],
+                      cls: str | None = None) -> None:
+        lock_ids = [l for l, _ in locks]
+        acq_lines = [ln for _, ln in locks]
         # lambda bodies execute later — prune them from this walk
         in_lambda: set[int] = set()
         for node in ast.walk(expr):
@@ -488,6 +659,30 @@ class _Extractor(ast.NodeVisitor):
                 for sub in ast.walk(node):
                     if sub is not node:
                         in_lambda.add(id(sub))
+        # method-call receivers: `self._refresh()` is a CALL record, not a
+        # field read of `_refresh` (the attr_accesses table is fields only)
+        call_funcs: set[int] = set()
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Call) and isinstance(node.func,
+                                                         ast.Attribute):
+                recv = node.func.value
+                if isinstance(recv, ast.Name) and recv.id in ("self", "cls"):
+                    call_funcs.add(id(node.func))
+        if cls:
+            for node in ast.walk(expr):
+                if id(node) in in_lambda or id(node) in call_funcs:
+                    continue
+                if (isinstance(node, ast.Attribute)
+                        and isinstance(node.value, ast.Name)
+                        and node.value.id in ("self", "cls")):
+                    kind = ("write" if isinstance(node.ctx, (ast.Store,
+                                                             ast.Del))
+                            else "read")
+                    fs.attr_accesses.append(AttrAccess(
+                        line=node.lineno, col=node.col_offset, cls=cls,
+                        attr=node.attr, kind=kind,
+                        under_locks=list(lock_ids),
+                        acq_lines=list(acq_lines)))
         for node in ast.walk(expr):
             if id(node) in in_lambda or not isinstance(node, ast.Call):
                 continue
@@ -504,25 +699,54 @@ class _Extractor(ast.NodeVisitor):
                     arg_atoms[str(i)] = atoms
             fs.calls.append(CallSite(
                 line=node.lineno, col=node.col_offset, name=name,
-                under_locks=list(locks), arg_atoms=arg_atoms))
+                under_locks=list(lock_ids), arg_atoms=arg_atoms))
             # bare project-function references passed as arguments (executor
             # thunks, shard_map wrapping, Thread targets): recorded as refs
+            seen_refs: set[tuple[int, str]] = set()
             for a in (*node.args, *(kw.value for kw in node.keywords)):
                 rname = dotted_name(a)
                 if rname and not rname[:1].isupper():
+                    seen_refs.add((getattr(a, "lineno", node.lineno), rname))
                     fs.calls.append(CallSite(
                         line=getattr(a, "lineno", node.lineno),
                         col=getattr(a, "col_offset", 0), name=rname,
-                        under_locks=list(locks), is_ref=True, ref_of=name))
+                        under_locks=list(lock_ids), is_ref=True, ref_of=name))
                 elif (isinstance(a, ast.Call)
                       and dotted_name(a.func) in ("partial",
                                                   "functools.partial")
                       and a.args):
                     pname = dotted_name(a.args[0])
                     if pname:
+                        seen_refs.add((a.lineno, pname))
                         fs.calls.append(CallSite(
                             line=a.lineno, col=a.col_offset, name=pname,
-                            under_locks=list(locks), is_ref=True,
+                            under_locks=list(lock_ids), is_ref=True,
+                            ref_of=name))
+            if terminal_name(node.func) in _CALLBACK_SINKS:
+                # thread-escape sinks get a DEEP argument walk so a target
+                # wrapped one level (`Thread(target=crash_guard(self._loop))`)
+                # still registers as escaping to another thread; call
+                # receivers are skipped (they are calls, not references)
+                sink_func_ids = {id(n.func) for n in ast.walk(node)
+                                 if isinstance(n, ast.Call)}
+                for a in (*node.args, *(kw.value for kw in node.keywords)):
+                    recv_ids = {id(n.value) for n in ast.walk(a)
+                                if isinstance(n, ast.Attribute)}
+                    for sub in ast.walk(a):
+                        if (id(sub) in in_lambda or id(sub) in sink_func_ids
+                                or id(sub) in recv_ids):
+                            continue
+                        if not isinstance(sub, (ast.Name, ast.Attribute)):
+                            continue
+                        rname = dotted_name(sub)
+                        if (not rname or rname[:1].isupper()
+                                or rname in ("self", "cls")
+                                or (sub.lineno, rname) in seen_refs):
+                            continue
+                        seen_refs.add((sub.lineno, rname))
+                        fs.calls.append(CallSite(
+                            line=sub.lineno, col=sub.col_offset, name=rname,
+                            under_locks=list(lock_ids), is_ref=True,
                             ref_of=name))
             # host-sync ops / escapes
             tail = terminal_name(node.func)
@@ -535,11 +759,11 @@ class _Extractor(ast.NodeVisitor):
                 fs.sync_ops.append(SyncOp(line=node.lineno,
                                           op="block_until_ready",
                                           atoms=recv_atoms,
-                                          under_locks=list(locks)))
+                                          under_locks=list(lock_ids)))
             elif name in ("jax.device_get", "device_get"):
                 fs.sync_ops.append(SyncOp(line=node.lineno, op="device_get",
                                           atoms=operand_atoms,
-                                          under_locks=list(locks)))
+                                          under_locks=list(lock_ids)))
                 # device_get's operand is a device array BY CONTRACT —
                 # the escape is definite no matter where the value came from
                 fs.escapes.append(EscapeOp(line=node.lineno,
@@ -550,13 +774,13 @@ class _Extractor(ast.NodeVisitor):
                 recv_atoms = self._atoms(node.func.value, fs)
                 fs.sync_ops.append(SyncOp(line=node.lineno, op=tail,
                                           atoms=recv_atoms,
-                                          under_locks=list(locks)))
+                                          under_locks=list(lock_ids)))
                 fs.escapes.append(EscapeOp(line=node.lineno, conv=f".{tail}",
                                            atoms=recv_atoms))
             elif name in _HOST_CONV_NAMES:
                 fs.sync_ops.append(SyncOp(line=node.lineno, op=name,
                                           atoms=operand_atoms,
-                                          under_locks=list(locks)))
+                                          under_locks=list(lock_ids)))
                 fs.escapes.append(EscapeOp(line=node.lineno, conv=name,
                                            atoms=operand_atoms))
 
@@ -833,7 +1057,25 @@ class ProjectGraph:
             return self._unique_method(name.split(".")[-1])
         return []
 
+    #: ubiquitous builtin-container/primitive method names excluded from
+    #: the unique-method fallback: `self._buf.extend(...)` on a plain list
+    #: must not resolve to the one project class that happens to define
+    #: `extend` — those calls are counted unresolved (honest blindness)
+    #: unless the receiver's type is actually inferred
+    _BUILTIN_METHOD_NAMES = frozenset({
+        "append", "extend", "insert", "remove", "pop", "popleft",
+        "appendleft", "clear", "update", "get", "put", "add", "discard",
+        "items", "keys", "values", "copy", "sort", "reverse", "count",
+        "index", "setdefault", "get_nowait", "put_nowait", "qsize",
+        "empty", "full", "task_done", "join", "split", "strip", "encode",
+        "decode", "format", "read", "write", "readline", "flush", "seek",
+        "close", "set", "is_set", "wait", "acquire", "release", "notify",
+        "notify_all", "locked", "start", "result", "cancel", "done",
+    })
+
     def _unique_method(self, meth: str) -> list[str]:
+        if meth in self._BUILTIN_METHOD_NAMES:
+            return []
         cands = self._methods_by_name.get(meth, [])
         if len(cands) == 1 and cands[0] in self.functions:
             return [cands[0]]

@@ -9,8 +9,9 @@ breakage instead of silently no-opping. Exit 0 = clean under whichever
 checker ran.
 
 The typed set: storage/, ops/, server/service (since PR 1), plus the
-strict-ish per-package ratchets in mypy.ini for sched/, lease/, and
-tools/kblint (disallow_incomplete_defs + no_implicit_optional).
+strict-ish per-package ratchets in mypy.ini for sched/, lease/, replica/,
+faults/, and tools/kblint (disallow_incomplete_defs +
+no_implicit_optional).
 """
 
 from __future__ import annotations
@@ -30,6 +31,8 @@ TYPED_PACKAGES = [
     "kubebrain_tpu/server/service",
     "kubebrain_tpu/sched",
     "kubebrain_tpu/lease",
+    "kubebrain_tpu/replica",
+    "kubebrain_tpu/faults",
     "tools/kblint",
 ]
 
