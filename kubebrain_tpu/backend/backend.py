@@ -225,8 +225,11 @@ class Backend:
             event.err = e
             raise
         finally:
-            txn_log("create", user_key, rev, event.err or sys.exc_info()[1])
+            # ring first: _notify is the side that must survive anything
+            # else in this finally raising (a dealt-but-unnotified revision
+            # stalls the sequencer forever); the log line is best-effort
             self._notify(event)
+            txn_log("create", user_key, rev, event.err or sys.exc_info()[1])
             self.tso.wait_committed(rev, timeout=5.0)
             if revealed:
                 self._await_revealed(revealed)
@@ -242,12 +245,15 @@ class Backend:
         re-attaches the key (0 = detach, etcd put-without-lease)."""
         if lease:
             ttl = self._lease_ttl(lease)  # raises LeaseNotFoundError
+        # resolve the TTL before dealing: ttl_for_key can raise, and no
+        # fallible call belongs between a deal and its notify-protected try
+        ttl_resolved = creator.ttl_for_key(user_key) if ttl is None else ttl
         rev = self.tso.deal()
         event = WatchEvent(
             revision=rev, verb=Verb.PUT, key=user_key, value=value,
             prev_revision=expected_revision, valid=False,
         )
-        ttl = creator.ttl_for_key(user_key) if ttl is None else ttl
+        ttl = ttl_resolved
         revealed = 0
         try:
             if rev <= expected_revision:
@@ -279,8 +285,8 @@ class Backend:
             event.err = e
             raise
         finally:
-            txn_log("update", user_key, rev, event.err or sys.exc_info()[1])
             self._notify(event)
+            txn_log("update", user_key, rev, event.err or sys.exc_info()[1])
             self.tso.wait_committed(rev, timeout=5.0)
             if revealed:
                 self._await_revealed(revealed)
@@ -344,8 +350,8 @@ class Backend:
             event.err = e
             raise
         finally:
-            txn_log("delete", user_key, rev, event.err or sys.exc_info()[1])
             self._notify(event)
+            txn_log("delete", user_key, rev, event.err or sys.exc_info()[1])
             self.tso.wait_committed(rev, timeout=5.0)
             if revealed:
                 self._await_revealed(revealed)
@@ -387,8 +393,8 @@ class Backend:
             event.err = e
             raise
         finally:
-            txn_log("delete", user_key, rev, event.err or sys.exc_info()[1])
             self._notify(event)
+            txn_log("delete", user_key, rev, event.err or sys.exc_info()[1])
             self.tso.wait_committed(rev, timeout=5.0)
             if revealed:
                 self._await_revealed(revealed)
@@ -465,46 +471,46 @@ class Backend:
         base = self.tso.deal_block(len(pending))
         engine_ops: list[tuple] = []
         runnable: list[dict] = []  # pending ops that reach the engine
-        for j, p in enumerate(pending):
-            rev = base + j
-            p["rev"] = rev
-            kind, key = p["kind"], p["key"]
-            if kind == "create":
-                p["event"] = WatchEvent(revision=rev, verb=Verb.CREATE,
-                                        key=key, value=p["value"], valid=False)
-                op_t = ("create", coder.encode_revision_key(key), rev,
-                        coder.encode_rev_value(rev),
-                        coder.encode_object_key(key, rev), p["value"],
-                        LAST_REV_KEY, coder.encode_rev_value(rev), p["ttl"])
-            elif kind == "update":
-                p["event"] = WatchEvent(revision=rev, verb=Verb.PUT, key=key,
-                                        value=p["value"],
-                                        prev_revision=p["expected"], valid=False)
-                if rev <= p["expected"]:
-                    # drift-back anomaly (txn.go:171-175): the dealt revision
-                    # must exceed the record it supersedes; the revision is
-                    # consumed and notified invalid, like the sequential path
-                    p["fail"] = FutureRevisionError(rev, p["expected"])
-                    continue
-                op_t = ("update", coder.encode_revision_key(key),
-                        coder.encode_rev_value(rev),
-                        coder.encode_rev_value(p["expected"]),
-                        coder.encode_object_key(key, rev), p["value"],
-                        LAST_REV_KEY, coder.encode_rev_value(rev), p["ttl"])
-            else:  # delete
-                p["event"] = WatchEvent(revision=rev, verb=Verb.DELETE,
-                                        key=key, valid=False)
-                op_t = ("delete", coder.encode_revision_key(key),
-                        p["expected"], rev,
-                        coder.encode_rev_value(rev, deleted=True), TOMBSTONE,
-                        LAST_REV_KEY, coder.encode_rev_value(rev))
-            engine_ops.append(op_t)
-            runnable.append(p)
-
-        # phase 3 — ONE engine round trip with per-op outcome demux
         revealed_max = 0
         revealed_watermark = False
         try:
+            for j, p in enumerate(pending):
+                rev = base + j
+                p["rev"] = rev
+                kind, key = p["kind"], p["key"]
+                if kind == "create":
+                    p["event"] = WatchEvent(revision=rev, verb=Verb.CREATE,
+                                            key=key, value=p["value"], valid=False)
+                    op_t = ("create", coder.encode_revision_key(key), rev,
+                            coder.encode_rev_value(rev),
+                            coder.encode_object_key(key, rev), p["value"],
+                            LAST_REV_KEY, coder.encode_rev_value(rev), p["ttl"])
+                elif kind == "update":
+                    p["event"] = WatchEvent(revision=rev, verb=Verb.PUT, key=key,
+                                            value=p["value"],
+                                            prev_revision=p["expected"], valid=False)
+                    if rev <= p["expected"]:
+                        # drift-back anomaly (txn.go:171-175): the dealt revision
+                        # must exceed the record it supersedes; the revision is
+                        # consumed and notified invalid, like the sequential path
+                        p["fail"] = FutureRevisionError(rev, p["expected"])
+                        continue
+                    op_t = ("update", coder.encode_revision_key(key),
+                            coder.encode_rev_value(rev),
+                            coder.encode_rev_value(p["expected"]),
+                            coder.encode_object_key(key, rev), p["value"],
+                            LAST_REV_KEY, coder.encode_rev_value(rev), p["ttl"])
+                else:  # delete
+                    p["event"] = WatchEvent(revision=rev, verb=Verb.DELETE,
+                                            key=key, valid=False)
+                    op_t = ("delete", coder.encode_revision_key(key),
+                            p["expected"], rev,
+                            coder.encode_rev_value(rev, deleted=True), TOMBSTONE,
+                            LAST_REV_KEY, coder.encode_rev_value(rev))
+                engine_ops.append(op_t)
+                runnable.append(p)
+
+            # phase 3 — ONE engine round trip with per-op outcome demux
             if engine_ops:
                 try:
                     results = self._engine_write_batch(engine_ops)
@@ -548,7 +554,17 @@ class Backend:
             # phase 5 — one ring pass for the whole block, then the write
             # fence. In a finally like every sequential path's notify: a
             # dealt revision MUST always reach the ring, else the sequencer
-            # can never advance past it and every later write stalls.
+            # can never advance past it and every later write stalls. A
+            # phase-2 encoding failure leaves later ops eventless — they
+            # still consumed their revisions, so they get invalid events
+            # here (dealt and notified must never diverge).
+            verbs = {"create": Verb.CREATE, "update": Verb.PUT,
+                     "delete": Verb.DELETE}
+            for j, p in enumerate(pending):
+                if "event" not in p:
+                    p["event"] = WatchEvent(revision=base + j,
+                                            verb=verbs[p["kind"]],
+                                            key=p["key"], valid=False)
             self._notify_many([p["event"] for p in pending])
             self.tso.wait_committed(base + len(pending) - 1, timeout=5.0)
         if revealed_watermark:

@@ -46,25 +46,29 @@ class LeaseReaper:
         self.reap_interval = reap_interval
         self.checkpoint_interval = checkpoint_interval
         self._stop = threading.Event()
+        self._lifecycle_lock = threading.Lock()
         self._thread: threading.Thread | None = None
         self._was_leader: bool | None = None  # None until the first tick
 
     # ------------------------------------------------------------- lifecycle
     def start(self) -> None:
-        if self._thread is not None:
-            return
         from ..util.env import crash_guard
 
-        self._thread = threading.Thread(
-            target=crash_guard(self._loop), name="kb-lease-reaper", daemon=True
-        )
-        self._thread.start()
+        with self._lifecycle_lock:
+            if self._thread is not None:
+                return
+            self._thread = threading.Thread(
+                target=crash_guard(self._loop), name="kb-lease-reaper",
+                daemon=True
+            )
+            self._thread.start()
 
     def close(self) -> None:
         self._stop.set()
-        if self._thread is not None:
-            self._thread.join(timeout=2.0)
-            self._thread = None
+        with self._lifecycle_lock:
+            thread, self._thread = self._thread, None
+        if thread is not None:
+            thread.join(timeout=2.0)
         # persist remaining TTLs one last time so a restart resumes the
         # countdown instead of granting expired leases a fresh life
         self.registry.close()

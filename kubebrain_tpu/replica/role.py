@@ -484,3 +484,10 @@ class FollowerRole:
                 self._channel.close()
                 self._channel = None
                 self._stubs.clear()
+        if self._metrics is not None:
+            # the lag/watermark gauges registered in __init__ close over
+            # this role: leaving them registered keeps a closed follower
+            # reachable from the metrics registry and scrapes stale lag
+            self._metrics.unregister_gauge_fn("kb.replica.applied.revision")
+            self._metrics.unregister_gauge_fn("kb.replica.lag.revisions")
+            self._metrics.unregister_gauge_fn("kb.replica.lag.seconds")

@@ -404,6 +404,16 @@ class RequestScheduler:
             for m in r.batch_members:  # batch riders must not strand either
                 m.finish(error=SchedClosedError("scheduler closed"))
             r.finish(error=SchedClosedError("scheduler closed"))
+        if self.metrics is not None:
+            # drop the gauge callbacks registered in __init__: they close
+            # over this instance, so a dangling registration keeps a dead
+            # scheduler (and its backend) alive in the metrics registry
+            for lane in Lane:
+                self.metrics.unregister_gauge_fn(
+                    "kb.sched.queue.depth", lane=lane.name.lower())
+            self.metrics.unregister_gauge_fn("kb.sched.inflight")
+            self.metrics.unregister_gauge_fn("kb.sched.depth")
+            self.metrics.unregister_gauge_fn("kb.sched.dispatch.rtt.seconds")
 
     # -------------------------------------------------------------- enqueue
     def submit_async(self, fn: Callable[[], Any],
@@ -582,22 +592,32 @@ class RequestScheduler:
                 # nothing will finish it
                 req.finish(error=SchedClosedError("scheduler closed"))
                 return
-            with self._cv:
-                closed = self._closed
+            try:
+                with self._cv:
+                    closed = self._closed
+                shed = False if closed else self._shed_if_stale(req)
+                if not closed and not shed:
+                    self._form_batch(req)
+                    with self._cv:
+                        for r in (req, *req.batch_members):
+                            if r.key is not None:
+                                self._inflight[r.key] = r
+                            self._inflight_count += 1
+                    self.dispatched += 1 + len(req.batch_members)
+            except BaseException as e:
+                # a dispatch-path failure must not shrink scheduler depth
+                # for the rest of the process (kblint KB124): give the slot
+                # back and fail the request instead of stranding both
+                self._release_slot()
+                req.finish(error=e)
+                raise
             if closed:
                 self._release_slot()
                 req.finish(error=SchedClosedError("scheduler closed"))
                 return
-            if self._shed_if_stale(req):
+            if shed:
                 self._release_slot()
                 continue
-            self._form_batch(req)
-            with self._cv:
-                for r in (req, *req.batch_members):
-                    if r.key is not None:
-                        self._inflight[r.key] = r
-                    self._inflight_count += 1
-            self.dispatched += 1 + len(req.batch_members)
             with self._run_cv:
                 self._runq.append(req)
                 self._run_cv.notify()

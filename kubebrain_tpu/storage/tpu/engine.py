@@ -38,7 +38,7 @@ from ...ops.compact import victim_mask
 from ...ops.scan import lex_geq, lex_less, visibility_mask, visibility_mask_queries
 from ...parallel.mesh import make_mesh
 from ...trace import TRACER
-from ...util import fieldcheck
+from ...util import fieldcheck, lockcheck
 from .. import BatchWrite, CASFailedError, KvStorage, Partition, register_engine
 from ..errors import UncertainResultError
 from .blocks import (
@@ -492,6 +492,7 @@ class TpuScanner(Scanner):
         self._delta = _DeltaIndex(self._kw)
         self._force_rebuild = True
         self._metrics = None
+        self._gauge_regs: list[tuple[str, dict]] = []
         # merge accounting (also exported as kb_mirror_merge_* metrics):
         # steady state must show merge_rows_total accounting every delta row
         # with full_rebuild_total flat (bench write phase asserts this)
@@ -499,7 +500,10 @@ class TpuScanner(Scanner):
         self.merge_rows_total = 0
         self.full_rebuild_total = 0
         # background (write-kicked) merge failures: counted + last error
-        # kept so a deterministic merge defect is never silent
+        # kept so a deterministic merge defect is never silent. Written
+        # from background workers AND the foreground read path, so the
+        # increment needs its own lock (a bare += loses updates).
+        self._merr_lock = threading.Lock()
         self.merge_bg_errors = 0
         self._merge_bg_last_error: Exception | None = None
         # bounded-retry accounting for the background merge (docs/faults.md:
@@ -567,6 +571,7 @@ class TpuScanner(Scanner):
                 functools.partial(self._state_gauge, state),
                 state=state,
             )
+            self._gauge_regs.append(("kb.mirror.state", {"state": state}))
         if self._mesh is None:
             return
         for d in self._mesh.devices.flat:
@@ -580,6 +585,19 @@ class TpuScanner(Scanner):
                 functools.partial(self._mirror_device_bytes, str(d), True),
                 device=str(d),
             )
+            self._gauge_regs.append(("kb.mirror.bytes", {"device": str(d)}))
+            self._gauge_regs.append(
+                ("kb.mirror.raw.bytes", {"device": str(d)}))
+
+    def close(self) -> None:
+        # drop the callback gauges registered by register_metrics: they
+        # close over the live mirror, so a dangling registration keeps a
+        # closed scanner's shards reachable and scrapes garbage
+        if self._metrics is not None:
+            for name, tags in self._gauge_regs:
+                self._metrics.unregister_gauge_fn(name, **tags)
+            self._gauge_regs = []
+        super().close()
 
     def _mirror_device_bytes(self, device: str,
                              raw_equivalent: bool = False) -> float:
@@ -688,10 +706,14 @@ class TpuScanner(Scanner):
         never runs on a reader's thread and never stops the world."""
         if not self._rebuild_kick.acquire(blocking=False):
             return
+        # sanitizer annotation (no-op in production): the kick's ownership
+        # moves to the worker we are about to spawn
+        lockcheck.handoff(self._rebuild_kick)
 
         def run() -> None:
             import random as _random
 
+            lockcheck.adopt(self._rebuild_kick)
             try:
                 backoff = 0.05
                 for _attempt in range(16):
@@ -699,7 +721,8 @@ class TpuScanner(Scanner):
                         if self._rebuild_offline():
                             return
                     except Exception:
-                        self.merge_bg_errors += 1
+                        with self._merr_lock:
+                            self.merge_bg_errors += 1
                         if self._metrics is not None:
                             self._metrics.emit_counter(
                                 "kb.mirror.merge.errors", 1)
@@ -710,8 +733,14 @@ class TpuScanner(Scanner):
             finally:
                 self._rebuild_kick.release()
 
-        threading.Thread(target=run, name="kb-mirror-rebuild",
-                         daemon=True).start()
+        try:
+            threading.Thread(target=run, name="kb-mirror-rebuild",
+                             daemon=True).start()
+        except BaseException:
+            # a failed spawn must give the single-flight token back, or no
+            # rebuild can EVER run again and the mirror stays quarantined
+            self._rebuild_kick.release()
+            raise
 
     def _rebuild_offline(self) -> bool:
         """One rebuild attempt OFF the engine lock: snapshot the store,
@@ -793,10 +822,14 @@ class TpuScanner(Scanner):
         next kick) left a deterministic merge defect unrecovered forever."""
         if not self._merge_kick.acquire(blocking=False):
             return
+        # sanitizer annotation (no-op in production): the kick's ownership
+        # moves to the worker we are about to spawn
+        lockcheck.handoff(self._merge_kick)
 
         def run() -> None:
             import random as _random
 
+            lockcheck.adopt(self._merge_kick)
             try:
                 backoff = 0.05
                 for attempt in range(self._merge_max_retries):
@@ -806,8 +839,9 @@ class TpuScanner(Scanner):
                     except Exception as e:
                         # NOT silent: counted scrape-visibly, last error
                         # kept for the foreground path to surface
-                        self.merge_bg_errors += 1
-                        self._merge_bg_last_error = e
+                        with self._merr_lock:
+                            self.merge_bg_errors += 1
+                            self._merge_bg_last_error = e
                         if self._metrics is not None:
                             self._metrics.emit_counter(
                                 "kb.mirror.merge.errors", 1)
@@ -844,14 +878,21 @@ class TpuScanner(Scanner):
                             self.full_rebuild_total += 1
                     self._rebuild_offline()
                 except Exception as e:  # keep the thread from dying silently
-                    self._merge_bg_last_error = e
+                    with self._merr_lock:
+                        self._merge_bg_last_error = e
                     if self._metrics is not None:
                         self._metrics.emit_counter("kb.mirror.merge.errors", 1)
             finally:
                 self._merge_kick.release()
 
-        threading.Thread(target=run, name="kb-mirror-merge",
-                         daemon=True).start()
+        try:
+            threading.Thread(target=run, name="kb-mirror-merge",
+                             daemon=True).start()
+        except BaseException:
+            # a failed spawn must give the single-flight token back, or no
+            # merge can EVER run again and the delta grows unbounded
+            self._merge_kick.release()
+            raise
 
     def mark_uncertain(self) -> None:
         """A commit with unknowable outcome may or may not have produced
@@ -899,8 +940,9 @@ class TpuScanner(Scanner):
             # read-path merge failure must not fail the READ: mirror +
             # overlay is still exact, only bigger. Counted like the
             # background kick; the retry/escalation machinery recovers.
-            self.merge_bg_errors += 1
-            self._merge_bg_last_error = e
+            with self._merr_lock:
+                self.merge_bg_errors += 1
+                self._merge_bg_last_error = e
             if self._metrics is not None:
                 self._metrics.emit_counter("kb.mirror.merge.errors", 1)
 

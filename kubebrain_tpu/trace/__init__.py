@@ -44,6 +44,7 @@ import re
 import threading
 import time
 from collections import deque
+from typing import Any, Iterator
 
 from ..util import fieldcheck
 
@@ -100,7 +101,7 @@ class Span:
                  "duration", "stages", "error", "hwm")
 
     def __init__(self, name: str, trace_id: str | None = None,
-                 parent_id: str | None = None):
+                 parent_id: str | None = None) -> None:
         self.name = name
         self.trace_id = trace_id or _gen_id(16)
         self.span_id = _gen_id(8)
@@ -144,7 +145,7 @@ class Tracer:
     RTT_STAGES = ("device_dispatch", "device_compute")
 
     def __init__(self, capacity: int = 512, slow_ms: float = 500.0,
-                 metrics=None, slow_capacity: int = 128):
+                 metrics: Any = None, slow_capacity: int = 128) -> None:
         self._lock = threading.Lock()
         self._ring: deque[Span] = deque(maxlen=capacity)
         self._slow: deque[Span] = deque(maxlen=slow_capacity)
@@ -165,7 +166,7 @@ class Tracer:
         self.enabled = os.environ.get("KB_TRACE", "1") != "0"
 
     # ------------------------------------------------------------ configure
-    def configure(self, metrics=None, slow_ms: float | None = None,
+    def configure(self, metrics: Any = None, slow_ms: float | None = None,
                   capacity: int | None = None) -> None:
         if metrics is not None:
             self.metrics = metrics
@@ -188,7 +189,8 @@ class Tracer:
         return _SPAN.get()
 
     @contextlib.contextmanager
-    def span(self, name: str, traceparent: str | bytes | None = None):
+    def span(self, name: str,
+             traceparent: str | bytes | None = None) -> Iterator[Span | None]:
         """Root-span scope. A nested call reuses the active span — service
         terminals stack (front backhaul -> KVService), one RPC = one span."""
         active = _SPAN.get()
@@ -198,18 +200,24 @@ class Tracer:
         parent = parse_traceparent(traceparent)
         sp = Span(name, trace_id=parent[0] if parent else None,
                   parent_id=parent[1] if parent else None)
-        token = _SPAN.set(sp)
+        token = None
         try:
+            token = _SPAN.set(sp)
             yield sp
         except BaseException as e:
             sp.error = f"{type(e).__name__}: {e}"
             raise
         finally:
-            _SPAN.reset(token)
+            # finish FIRST, and unconditionally: the ring append is the
+            # side that must survive any teardown hiccup — a span that
+            # opened but never reaches the ring would under-count exactly
+            # the failed requests
             self.finish(sp)
+            if token is not None:
+                _SPAN.reset(token)
 
     @contextlib.contextmanager
-    def use(self, span: Span | None):
+    def use(self, span: Span | None) -> Iterator[None]:
         """Adopt ``span`` as the ambient span on this thread (scheduler
         workers execute a request captured on the submitting thread)."""
         if span is None:
@@ -247,7 +255,7 @@ class Tracer:
 
     # --------------------------------------------------------------- stages
     @contextlib.contextmanager
-    def stage(self, name: str, device: bool = False):
+    def stage(self, name: str, device: bool = False) -> Iterator[None]:
         t0 = time.monotonic()
         try:
             yield
@@ -334,7 +342,7 @@ class Tracer:
         }
 
 
-def emit_histogram(name: str, value: float, **tags) -> None:
+def emit_histogram(name: str, value: float, **tags: Any) -> None:
     """Forward a histogram observation to the process metrics sink when one
     is configured (used by layers without their own metrics handle, e.g.
     the watch pumps)."""
@@ -343,7 +351,7 @@ def emit_histogram(name: str, value: float, **tags) -> None:
         m.emit_histogram(name, value, **tags)
 
 
-def traceparent_of(context) -> str | bytes | None:
+def traceparent_of(context: Any) -> str | bytes | None:
     """The ``traceparent`` metadata value of a gRPC(-ish) server context,
     if the transport exposes invocation metadata (grpcio does; the native
     front / aio context adapters may not)."""

@@ -1,0 +1,503 @@
+"""Opt-in runtime linear-resource leak sanitizer (``KB_LEAKCHECK=1``).
+
+The static linter's KB123–KB126 prove on the CFG that every dealt
+revision, in-flight slot, watcher registration, and span reaches its
+release on every path the resolver can see; this shim watches what
+actually happens. While installed it wraps the four linear-resource
+protocols the static tier tracks:
+
+- **revision** (KB123's runtime twin): every ``TSO.deal`` /
+  ``TSO.deal_block`` token must reach the event ring via
+  ``Backend._notify`` / ``_notify_many`` (valid, failed, or uncertain —
+  the TSO contract) before ``Backend.close``. ``TSO.init`` re-anchors
+  the domain (boot/rehydration) and clears that TSO's ledger.
+- **slot** (KB124): every successful ``RequestScheduler._acquire_slot``
+  must be matched by ``_release_slot``; a release with no acquire is an
+  ``unbalanced-slot-release``, slots still held after ``close`` (which
+  joins the workers) are ``leaked-slot``.
+- **watcher** (KB125): every ``WatcherHub`` subscription must be removed
+  by ``delete_watcher`` (hub ``close`` drains through it) before the hub
+  goes away.
+- **span** (KB125): every ``Span`` constructed must reach
+  ``Tracer.finish`` by test teardown (the ``Tracer.span`` context
+  manager finishes in its ``finally``; this catches hand-rolled spans).
+
+Releases with no matching acquire are counted (``released_unknown``),
+not flagged: a follower applying leader-dealt revisions notifies
+revisions this process never dealt, by design.
+
+Violations are recorded, not raised at the offending site; the pytest
+conftest drains them after each test and — under ``KB_LEAKCHECK_STRICT=1``
+— fails the test that produced them. The default is observe-only, the
+same contract as lockcheck/fieldcheck.
+
+Usage::
+
+    from kubebrain_tpu.util import leakcheck
+    leakcheck.install()            # or KB_LEAKCHECK=1 with tests/conftest.py
+    ...
+    leakcheck.export_observed("/tmp/leaks.json")
+    # then: python -m tools.kblint --deep \
+    #           --leak-observed /tmp/leaks.json --leak-report
+
+The export feeds kblint's ``--leak-report``: statically tracked
+obligation kinds vs runtime-exercised ones, with ``static_only_kinds``
+(protocols no sanitizer run ever exercised — the runtime detector's
+coverage gap) and ``unbalanced_kinds`` — the same cross-check contract
+as the KB115 lock-graph and KB120 field-guard exports.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import traceback
+from typing import Any, Callable
+
+from . import lockcheck
+
+__all__ = [
+    "install",
+    "uninstall",
+    "installed",
+    "reset",
+    "observed",
+    "export_observed",
+    "check_teardown",
+    "take_violations",
+    "violations",
+    "Violation",
+    "LeakError",
+]
+
+
+class LeakError(AssertionError):
+    """Raised by the strict test harness when a linear-resource leak was
+    observed during the test that just ran."""
+
+
+@dataclasses.dataclass(frozen=True)
+class Violation:
+    kind: str          # "leaked-revision" | "leaked-slot" | ...
+    detail: str
+    stack: str
+
+    def render(self) -> str:
+        return f"[leakcheck] {self.kind}: {self.detail}\n{self.stack}"
+
+
+# --------------------------------------------------------------------- state
+
+# an ORIGINAL (unwrapped) lock: the sanitizer must never contribute edges
+# to the lock-order graph it shares a process with
+_state_lock = lockcheck.raw_lock()
+_installed = False
+_originals: dict[tuple[type, str], Callable] = {}
+
+
+class _KindStats:
+    __slots__ = ("acquired", "released", "released_unknown", "close_checks",
+                 "violations")
+
+    def __init__(self) -> None:
+        self.acquired = 0
+        self.released = 0
+        self.released_unknown = 0
+        self.close_checks = 0
+        self.violations = 0
+
+
+_kinds: dict[str, _KindStats] = {}
+_violations: list[Violation] = []
+
+# outstanding-token ledgers, one per protocol. Objects are keyed by a
+# stamped per-instance token where the instance dict allows it (address
+# reuse after GC would merge two sequentially-created objects' ledgers),
+# id() as the fallback.
+_rev_tokens: dict[int, set[int]] = {}      # tso -> outstanding revisions
+_slot_counts: dict[int, int] = {}          # scheduler -> slots held
+_watch_tokens: dict[int, set[int]] = {}    # hub -> outstanding watcher ids
+_span_tokens: dict[str, str] = {}          # span_id -> span name
+
+_oid_counter = iter(range(1, 1 << 62))
+
+
+def _obj_token(obj: Any) -> int:
+    d = getattr(obj, "__dict__", None)
+    if d is None:
+        return id(obj)
+    tok = d.get("_kb_lk_oid")
+    if tok is None:
+        tok = next(_oid_counter)
+        try:
+            object.__setattr__(obj, "_kb_lk_oid", tok)
+        except (AttributeError, TypeError):
+            return id(obj)
+    return tok
+
+
+def _stats(kind: str) -> _KindStats:
+    st = _kinds.get(kind)
+    if st is None:
+        st = _kinds[kind] = _KindStats()
+    return st
+
+
+def _violate(kind: str, stat_key: str, detail: str) -> None:
+    stack = "".join(traceback.format_stack(limit=12)[:-2])
+    with _state_lock:
+        _stats(stat_key).violations += 1
+        _violations.append(Violation(kind, detail, stack))
+
+
+# ------------------------------------------------------------------ wrappers
+
+def _wrap(cls: type, name: str, make: Callable[[Callable], Callable]) -> None:
+    orig = cls.__dict__[name]
+    _originals[(cls, name)] = orig
+    wrapped = make(orig)
+    wrapped.__name__ = getattr(orig, "__name__", name)
+    wrapped.__doc__ = getattr(orig, "__doc__", None)
+    setattr(cls, name, wrapped)
+
+
+def _patch_tso(tso_cls: type) -> None:
+    def make_deal(orig: Callable) -> Callable:
+        def deal(self: Any) -> int:
+            rev = orig(self)
+            tok = _obj_token(self)
+            with _state_lock:
+                _stats("revision").acquired += 1
+                _rev_tokens.setdefault(tok, set()).add(rev)
+            return rev
+        return deal
+
+    def make_deal_block(orig: Callable) -> Callable:
+        def deal_block(self: Any, n: int) -> int:
+            first = orig(self, n)
+            tok = _obj_token(self)
+            with _state_lock:
+                st = _stats("revision")
+                st.acquired += n
+                _rev_tokens.setdefault(tok, set()).update(
+                    range(first, first + n))
+            return first
+        return deal_block
+
+    def make_init(orig: Callable) -> Callable:
+        def init(self: Any, revision: int) -> None:
+            orig(self, revision)
+            # domain re-anchor (boot / follower rehydration): revisions
+            # dealt under the previous epoch are adopted wholesale by the
+            # new watermark, not individually notified
+            tok = _obj_token(self)
+            with _state_lock:
+                _rev_tokens.pop(tok, None)
+        return init
+
+    _wrap(tso_cls, "deal", make_deal)
+    _wrap(tso_cls, "deal_block", make_deal_block)
+    _wrap(tso_cls, "init", make_init)
+
+
+def _discharge_revisions(tso: Any, revisions: list[int]) -> None:
+    tok = _obj_token(tso)
+    with _state_lock:
+        st = _stats("revision")
+        outstanding = _rev_tokens.get(tok)
+        for rev in revisions:
+            if outstanding is not None and rev in outstanding:
+                outstanding.discard(rev)
+                st.released += 1
+            else:
+                st.released_unknown += 1
+
+
+def _patch_backend(backend_cls: type) -> None:
+    def make_notify(orig: Callable) -> Callable:
+        def _notify(self: Any, event: Any) -> None:
+            # ledger first: _notify raises on ring wrap, but the event
+            # reached the sequencer's domain the moment it was posted —
+            # and a crash here is loud on its own
+            _discharge_revisions(self.tso, [event.revision])
+            orig(self, event)
+        return _notify
+
+    def make_notify_many(orig: Callable) -> Callable:
+        def _notify_many(self: Any, events: list) -> None:
+            _discharge_revisions(self.tso, [e.revision for e in events])
+            orig(self, events)
+        return _notify_many
+
+    def make_close(orig: Callable) -> Callable:
+        def close(self: Any) -> None:
+            orig(self)
+            tok = _obj_token(self.tso)
+            with _state_lock:
+                _stats("revision").close_checks += 1
+                leaked = sorted(_rev_tokens.pop(tok, set()))
+            if leaked:
+                _violate(
+                    "leaked-revision", "revision",
+                    f"Backend.close with {len(leaked)} dealt revision(s) "
+                    f"never notified (valid/failed/uncertain): "
+                    f"{leaked[:10]}{'...' if len(leaked) > 10 else ''} — "
+                    f"the sequencer contract (every dealt revision reaches "
+                    f"the ring) was broken")
+        return close
+
+    _wrap(backend_cls, "_notify", make_notify)
+    _wrap(backend_cls, "_notify_many", make_notify_many)
+    _wrap(backend_cls, "close", make_close)
+
+
+def _patch_scheduler(sched_cls: type) -> None:
+    def make_acquire(orig: Callable) -> Callable:
+        def _acquire_slot(self: Any) -> bool:
+            got = orig(self)
+            if got:
+                tok = _obj_token(self)
+                with _state_lock:
+                    _stats("slot").acquired += 1
+                    _slot_counts[tok] = _slot_counts.get(tok, 0) + 1
+            return got
+        return _acquire_slot
+
+    def make_release(orig: Callable) -> Callable:
+        def _release_slot(self: Any) -> None:
+            tok = _obj_token(self)
+            unbalanced = False
+            with _state_lock:
+                st = _stats("slot")
+                held = _slot_counts.get(tok, 0)
+                if held > 0:
+                    _slot_counts[tok] = held - 1
+                    st.released += 1
+                else:
+                    st.released_unknown += 1
+                    unbalanced = True
+            if unbalanced:
+                _violate(
+                    "unbalanced-slot-release", "slot",
+                    "RequestScheduler._release_slot with no matching "
+                    "successful _acquire_slot — a double release corrupts "
+                    "the in-flight bound")
+            orig(self)
+        return _release_slot
+
+    def make_close(orig: Callable) -> Callable:
+        def close(self: Any) -> None:
+            orig(self)
+            # close joins the dispatcher and workers, so every slot must
+            # have been released by the time it returns
+            tok = _obj_token(self)
+            with _state_lock:
+                _stats("slot").close_checks += 1
+                held = _slot_counts.pop(tok, 0)
+            if held > 0:
+                _violate(
+                    "leaked-slot", "slot",
+                    f"RequestScheduler.close with {held} in-flight slot(s) "
+                    f"still held — an exception path skipped _release_slot")
+        return close
+
+    _wrap(sched_cls, "_acquire_slot", make_acquire)
+    _wrap(sched_cls, "_release_slot", make_release)
+    _wrap(sched_cls, "close", make_close)
+
+
+def _patch_hub(hub_cls: type) -> None:
+    def make_add(orig: Callable) -> Callable:
+        def _add_locked(self: Any, *args: Any, **kwargs: Any):
+            wid, q = orig(self, *args, **kwargs)
+            tok = _obj_token(self)
+            with _state_lock:
+                _stats("watcher").acquired += 1
+                _watch_tokens.setdefault(tok, set()).add(wid)
+            return wid, q
+        return _add_locked
+
+    def make_delete(orig: Callable) -> Callable:
+        def delete_watcher(self: Any, wid: int) -> None:
+            tok = _obj_token(self)
+            with _state_lock:
+                st = _stats("watcher")
+                outstanding = _watch_tokens.get(tok)
+                if outstanding is not None and wid in outstanding:
+                    outstanding.discard(wid)
+                    st.released += 1
+                else:
+                    st.released_unknown += 1
+            orig(self, wid)
+        return delete_watcher
+
+    def make_close(orig: Callable) -> Callable:
+        def close(self: Any) -> None:
+            orig(self)  # drains through delete_watcher per wid
+            tok = _obj_token(self)
+            with _state_lock:
+                _stats("watcher").close_checks += 1
+                leaked = sorted(_watch_tokens.pop(tok, set()))
+            if leaked:
+                _violate(
+                    "leaked-watcher", "watcher",
+                    f"WatcherHub.close left {len(leaked)} watcher(s) "
+                    f"registered: {leaked[:10]}")
+        return close
+
+    _wrap(hub_cls, "_add_locked", make_add)
+    _wrap(hub_cls, "delete_watcher", make_delete)
+    _wrap(hub_cls, "close", make_close)
+
+
+def _patch_trace(span_cls: type, tracer_cls: type) -> None:
+    def make_span_init(orig: Callable) -> Callable:
+        def __init__(self: Any, *args: Any, **kwargs: Any) -> None:
+            orig(self, *args, **kwargs)
+            # Span has __slots__ — its generated span_id IS the token
+            with _state_lock:
+                _stats("span").acquired += 1
+                _span_tokens[self.span_id] = self.name
+        return __init__
+
+    def make_finish(orig: Callable) -> Callable:
+        def finish(self: Any, span: Any) -> None:
+            with _state_lock:
+                st = _stats("span")
+                if _span_tokens.pop(span.span_id, None) is not None:
+                    st.released += 1
+                else:
+                    st.released_unknown += 1
+            orig(self, span)
+        return finish
+
+    _wrap(span_cls, "__init__", make_span_init)
+    _wrap(tracer_cls, "finish", make_finish)
+
+
+# ----------------------------------------------------------------------- api
+
+def install() -> None:
+    """Start recording. Wraps the four linear-resource protocols in place
+    (TSO, Backend, RequestScheduler, WatcherHub, Tracer/Span). Idempotent.
+    Import-light until called — the serving modules are only imported when
+    the sanitizer is actually armed."""
+    global _installed
+    if _installed:
+        return
+    from ..backend import backend as backend_mod
+    from ..backend import tso as tso_mod
+    from ..backend import watcherhub as hub_mod
+    from ..sched import scheduler as sched_mod
+    from .. import trace as trace_mod
+
+    _patch_tso(tso_mod.TSO)
+    _patch_backend(backend_mod.Backend)
+    _patch_scheduler(sched_mod.RequestScheduler)
+    _patch_hub(hub_mod.WatcherHub)
+    _patch_trace(trace_mod.Span, trace_mod.Tracer)
+    _installed = True
+
+
+def uninstall() -> None:
+    """Restore every wrapped method. Outstanding-token ledgers survive
+    (reset() clears them) so an export after uninstall still reports."""
+    global _installed
+    if not _installed:
+        return
+    for (cls, name), orig in _originals.items():
+        setattr(cls, name, orig)
+    _originals.clear()
+    _installed = False
+
+
+def installed() -> bool:
+    return _installed
+
+
+def reset() -> None:
+    with _state_lock:
+        _kinds.clear()
+        _violations.clear()
+        _rev_tokens.clear()
+        _slot_counts.clear()
+        _watch_tokens.clear()
+        _span_tokens.clear()
+
+
+def violations() -> list[Violation]:
+    with _state_lock:
+        return list(_violations)
+
+
+def take_violations() -> list[Violation]:
+    """Return and clear recorded violations (the strict conftest drain)."""
+    with _state_lock:
+        out = list(_violations)
+        _violations.clear()
+    return out
+
+
+def check_teardown() -> list[Violation]:
+    """End-of-test sweep for resources with no close chokepoint: spans
+    constructed but never finished. Records (and returns) the violations
+    so the strict guard's drain sees them; the span ledger is cleared so
+    one leak does not re-fire on every later test."""
+    with _state_lock:
+        leaked = dict(_span_tokens)
+        _span_tokens.clear()
+        if leaked:
+            _stats("span").violations += len(leaked)
+    out: list[Violation] = []
+    if leaked:
+        names = sorted(set(leaked.values()))
+        v = Violation(
+            "leaked-span",
+            f"{len(leaked)} span(s) constructed but never finished "
+            f"(names: {names[:10]}) — hand-rolled span missing the "
+            f"finally-finish the Tracer.span CM guarantees",
+            "")
+        with _state_lock:
+            _violations.append(v)
+        out.append(v)
+    return out
+
+
+def observed() -> list[dict]:
+    """Snapshot in the ``--leak-observed`` schema: one dict per exercised
+    protocol kind with its acquire/release balance."""
+    with _state_lock:
+        outstanding = {
+            "revision": sum(len(s) for s in _rev_tokens.values()),
+            "slot": sum(_slot_counts.values()),
+            "watcher": sum(len(s) for s in _watch_tokens.values()),
+            "span": len(_span_tokens),
+        }
+        out = []
+        for kind in sorted(_kinds):
+            st = _kinds[kind]
+            out.append({
+                "kind": kind,
+                "acquired": st.acquired,
+                "released": st.released,
+                "released_unknown": st.released_unknown,
+                "outstanding": outstanding.get(kind, 0),
+                "close_checks": st.close_checks,
+                "violations": st.violations,
+            })
+    return out
+
+
+def export_observed(path: str) -> int:
+    """Write the observed protocol balances as JSON for the static
+    linter's cross-check (``python -m tools.kblint --deep
+    --leak-observed <path> --leak-report``). Returns the number of kinds
+    written. Set ``KB_LEAKCHECK_EXPORT=<path>`` to have the pytest
+    conftest export automatically at session end."""
+    import json
+    kinds = observed()
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump({"format": "kblint-leak-observed/v1",
+                   "kinds": kinds}, f, indent=1)
+        f.write("\n")
+    return len(kinds)

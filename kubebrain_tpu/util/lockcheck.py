@@ -49,6 +49,8 @@ __all__ = [
     "edges",
     "export_edges",
     "held_sites",
+    "handoff",
+    "adopt",
     "raw_lock",
     "Violation",
     "LockOrderError",
@@ -115,6 +117,13 @@ def _creation_site() -> str | None:
 # sleep-under-lock blame into the next test's freshly-reset state)
 _held_lists: dict[int, list] = {}
 
+# ids of locks used as single-flight LATCHES (handoff()/adopt() was called
+# on them): acquired non-blocking, so they can never participate in a
+# deadlock cycle, and the worker sleeping under one (retry backoff) is the
+# idiom working as designed, not a convoy — exempt from both checks. They
+# STAY in held stacks so fieldcheck still observes them as guards.
+_latch_ids: set[int] = set()
+
 
 def _held() -> list[tuple[str, int]]:
     held = getattr(_tls, "held", None)
@@ -163,6 +172,10 @@ def _note_acquired(site: str, obj_id: int) -> None:
                 # reentry) — a self-edge would flag every such pattern;
                 # cross-site inversions are the deadlock shape we hunt
                 continue
+            if held_id in _latch_ids:
+                # a try-acquired latch can't block, so "acquired X while
+                # holding the latch" is not a deadlock edge
+                continue
             if (held_site, site) not in _edges:
                 new_edges.append((held_site, site))
                 _edges[(held_site, site)] = ""
@@ -189,6 +202,19 @@ def _note_released(site: str, obj_id: int) -> None:
         if held[i] == (site, obj_id):
             del held[i]
             return
+    # cross-thread release: the single-flight kick idiom acquires on the
+    # caller (`kick.acquire(blocking=False)`) and releases in the spawned
+    # worker's finally. The entry must leave the ACQUIRER's stack, or it
+    # sits there stale forever and blames every later sleep on that
+    # thread for holding a lock it long since handed off.
+    with _state_lock:
+        for other in _held_lists.values():
+            if other is held:
+                continue
+            for i in range(len(other) - 1, -1, -1):
+                if other[i] == (site, obj_id):
+                    del other[i]
+                    return
 
 
 class _CheckedLockBase:
@@ -268,9 +294,9 @@ _BLOCKING_THRESHOLD = 0.0005  # sleep(0) yields are not blocking work
 
 def _checked_sleep(seconds: float) -> None:
     if seconds is not None and seconds > _BLOCKING_THRESHOLD:
-        held = _held()
-        if held:
-            sites = ", ".join(site for site, _ in held)
+        blamed = [site for site, oid in _held() if oid not in _latch_ids]
+        if blamed:
+            sites = ", ".join(blamed)
             _record_violation(
                 "blocking-call-under-lock",
                 f"time.sleep({seconds!r}) while holding [{sites}]",
@@ -313,6 +339,7 @@ def reset() -> None:
         _edges.clear()
         _violations.clear()
         _seen_cycles.clear()
+        _latch_ids.clear()
         for held in _held_lists.values():
             held.clear()
 
@@ -328,6 +355,52 @@ def take_violations() -> list[Violation]:
         out = list(_violations)
         _violations.clear()
     return out
+
+
+def handoff(lock) -> None:
+    """Caller-side ownership-transfer annotation for the single-flight
+    kick idiom (``kick.acquire(blocking=False)`` on the caller, release in
+    the spawned worker's ``finally``). Call right after the try-acquire
+    succeeds: the entry leaves THIS thread's held stack immediately, so the
+    caller's later sleeps are not blamed for a lock it gave away, and the
+    lock is marked as a latch (see :func:`adopt`). No-op on unwrapped
+    locks, so production code may call it unconditionally."""
+    site = getattr(lock, "_kb_site", None)
+    if site is None:
+        return
+    key = (site, id(lock))
+    with _state_lock:
+        _latch_ids.add(id(lock))
+    held = _held()
+    for i in range(len(held) - 1, -1, -1):
+        if held[i] == key:
+            del held[i]
+            return
+
+
+def adopt(lock) -> None:
+    """Worker-side counterpart of :func:`handoff`: call first thing in the
+    spawned worker. The entry moves onto THIS thread's held stack (stolen
+    from whichever thread still has it), so fieldcheck observes the latch
+    as the guard serializing the worker's writes — that is what makes
+    successive single-flight workers (different threads, same discipline)
+    provably non-racy instead of "2 threads, no common lock". Latch
+    entries are exempt from sleep-blame (retry backoff under the kick is
+    the idiom working as designed) and from deadlock edges (a try-acquire
+    can't block). No-op on unwrapped locks."""
+    site = getattr(lock, "_kb_site", None)
+    if site is None:
+        return
+    key = (site, id(lock))
+    held = _held()
+    with _state_lock:
+        _latch_ids.add(id(lock))
+        for other in _held_lists.values():
+            for i in range(len(other) - 1, -1, -1):
+                if other[i] == key:
+                    del other[i]
+        if key not in held:
+            held.append(key)
 
 
 def held_sites() -> tuple[str, ...]:

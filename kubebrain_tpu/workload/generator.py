@@ -38,6 +38,7 @@ import hashlib
 import math
 import random
 from dataclasses import dataclass
+from typing import Any
 
 from .clock import EventWheel
 from .spec import WorkloadSpec
@@ -153,7 +154,7 @@ def generate(spec: WorkloadSpec) -> Schedule:
     ops: list[Op] = []
     seq = 0
 
-    def emit(phase: str, t_ms: int, kind: str, **kw) -> None:
+    def emit(phase: str, t_ms: int, kind: str, **kw: Any) -> None:
         nonlocal seq
         ops.append(Op(phase=phase, t_ms=t_ms, seq=seq, kind=kind, **kw))
         seq += 1

@@ -40,6 +40,7 @@ import urllib.request
 import zlib
 from collections import Counter
 from dataclasses import asdict
+from typing import Any
 
 import grpc
 
@@ -84,11 +85,9 @@ _LEASE_GRANT_RPC = "/etcdserverpb.Lease/LeaseGrant"
 
 
 def free_port() -> int:
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
 
 
 class _Stats:
@@ -121,7 +120,8 @@ class _Shard(threading.Thread):
     be a list of endpoints: the client then round-robins with safe-only
     failover (the replica topology's load-balanced apiserver shape)."""
 
-    def __init__(self, name: str, target, qsize: int, stats: _Stats):
+    def __init__(self, name: str, target: str | list[str], qsize: int,
+                 stats: _Stats) -> None:
         super().__init__(name=name, daemon=True)
         self.client = (EtcdCompatClient(target) if isinstance(target, str)
                        else EtcdCompatClient(endpoints=list(target)))
@@ -129,7 +129,7 @@ class _Shard(threading.Thread):
         self._stats = stats
         self.start()
 
-    def submit(self, fn) -> None:
+    def submit(self, fn: Any) -> None:
         self.q.put(fn)  # blocks when full: the bounded part of open-loop
 
     def run(self) -> None:
@@ -153,7 +153,8 @@ class _Shard(threading.Thread):
 class WorkloadRunner:
     def __init__(self, spec: WorkloadSpec, target: str | None = None,
                  info_port: int = 0, out_path: str | None = None,
-                 write_report: bool = True, server_log: str | None = None):
+                 write_report: bool = True,
+                 server_log: str | None = None) -> None:
         if target and not info_port:
             raise ValueError(
                 "--target needs the server's info port too (the /metrics "
@@ -259,8 +260,8 @@ class WorkloadRunner:
         t_ms = int((time.monotonic() - armed) * 1000)
         return any(w.active(t_ms) for w in sched.windows)
 
-    def _execute(self, kind: str, fn, client, key: bytes | None = None,
-                 write: bool = False) -> None:
+    def _execute(self, kind: str, fn: Any, client: Any,
+                 key: bytes | None = None, write: bool = False) -> None:
         t0 = time.monotonic()
         in_window = self._in_fault_window()
         try:
@@ -446,7 +447,7 @@ class WorkloadRunner:
                     self._last_compact = target
         return fn
 
-    def _dispatch_keepalive(self, op) -> None:
+    def _dispatch_keepalive(self, op: Any) -> None:
         with self._lease_lock:
             lid = self._lease_ids.get(op.node)
         if lid is None:
@@ -468,7 +469,8 @@ class WorkloadRunner:
         return self._targets[1:]
 
     def _spawn_one(self, role_args: list[str], chaos_args: list[str],
-                   env, stderr) -> tuple[subprocess.Popen, str, int]:
+                   env: dict[str, str],
+                   stderr: Any) -> tuple[subprocess.Popen, str, int]:
         client_port, info_port = free_port(), free_port()
         args = [sys.executable, "-m", "kubebrain_tpu.cli",
                 "--storage", self.spec.storage, "--host", "127.0.0.1",
@@ -510,28 +512,36 @@ class WorkloadRunner:
                     chaos_args += ["--merge-threshold", "32"]
         env = self._mesh_env()
         stderr = subprocess.DEVNULL
+        log_fh = None
         if self._server_log:
-            stderr = open(self._server_log, "ab")  # noqa: SIM115
-        mesh_args = self._mesh_args()
-        self._server, self._target, self._info_port = self._spawn_one(
-            ["--single-node"] + mesh_args, chaos_args, env, stderr)
-        self._targets = [self._target]
-        self._info_ports = [self._info_port]
-        if spec.replicas:
-            self._probe()  # followers bootstrap FROM the leader
-            leader_info = f"127.0.0.1:{self._info_port}"
-            for _ in range(spec.replicas):
-                role = ["--role", "follower",
-                        "--leader-address", self._target,
-                        "--leader-info", leader_info,
-                        "--max-staleness-ms", str(spec.max_staleness_ms),
-                        "--max-staleness-rev", str(spec.max_staleness_rev),
-                        ] + mesh_args
-                proc, target, info = self._spawn_one(
-                    role, follower_chaos, env, stderr)
-                self._followers.append(proc)
-                self._targets.append(target)
-                self._info_ports.append(info)
+            stderr = log_fh = open(self._server_log, "ab")  # noqa: SIM115
+        try:
+            mesh_args = self._mesh_args()
+            self._server, self._target, self._info_port = self._spawn_one(
+                ["--single-node"] + mesh_args, chaos_args, env, stderr)
+            self._targets = [self._target]
+            self._info_ports = [self._info_port]
+            if spec.replicas:
+                self._probe()  # followers bootstrap FROM the leader
+                leader_info = f"127.0.0.1:{self._info_port}"
+                for _ in range(spec.replicas):
+                    role = ["--role", "follower",
+                            "--leader-address", self._target,
+                            "--leader-info", leader_info,
+                            "--max-staleness-ms", str(spec.max_staleness_ms),
+                            "--max-staleness-rev", str(spec.max_staleness_rev),
+                            ] + mesh_args
+                    proc, target, info = self._spawn_one(
+                        role, follower_chaos, env, stderr)
+                    self._followers.append(proc)
+                    self._targets.append(target)
+                    self._info_ports.append(info)
+        finally:
+            # every child holds its own dup of the log fd after spawn; the
+            # parent's handle must not outlive this scope — and must close
+            # when a spawn fails partway
+            if log_fh is not None:
+                log_fh.close()
 
     def _mesh_args(self) -> list[str]:
         args: list[str] = []
@@ -566,7 +576,7 @@ class WorkloadRunner:
                                 f"{want_dev}").strip()
         return env
 
-    def _probe(self, target: str | None = None, proc=None,
+    def _probe(self, target: str | None = None, proc: Any = None,
                deadline_s: float = 60.0) -> None:
         # fresh channel per attempt: a channel opened before the server
         # binds accrues reconnect backoff (the test_kvrpc boot lesson).
@@ -600,7 +610,7 @@ class WorkloadRunner:
         for proc, target in zip(self._followers, self._follower_targets):
             self._probe(target=target, proc=proc)
 
-    def _preload(self, preload_ops) -> float:
+    def _preload(self, preload_ops: list[Any]) -> float:
         t0 = time.monotonic()
         client = EtcdCompatClient(self._target)
         try:
@@ -619,7 +629,7 @@ class WorkloadRunner:
                               sample=False)
         return time.monotonic() - t0
 
-    def _route(self, op) -> None:
+    def _route(self, op: Any) -> None:
         kind = op.kind
         if kind == LEASE_KEEPALIVE:
             self._dispatch_keepalive(op)
@@ -849,7 +859,7 @@ class WorkloadRunner:
             "rev_mismatches": rev_mismatches[:20],
         }
 
-    def _build_faults_section(self, baseline, final) -> dict:
+    def _build_faults_section(self, baseline: Any, final: Any) -> dict:
         """The report's ``faults`` section: schedule identity, per-kind
         injected counts (server /metrics + /faults/state), the per-kind
         injected-vs-scheduled reconcile, degraded-window latency stats,
@@ -1101,8 +1111,9 @@ class WorkloadRunner:
         return report
 
     # --------------------------------------------------------------- report
-    def _build_report(self, schedule, sha, baseline, final, preload_wall,
-                      replay_wall, pacer, drained) -> dict:
+    def _build_report(self, schedule: Any, sha: str, baseline: Any,
+                      final: Any, preload_wall: float, replay_wall: float,
+                      pacer: Any, drained: bool) -> dict:
         spec = self.spec
         stats = self.stats
         # baseline/final arrive as per-server snapshot lists (leader
@@ -1359,8 +1370,8 @@ class WorkloadRunner:
         return sum(getattr(c, "endpoint_failovers", 0)
                    for c in self._all_clients())
 
-    def _build_replica_section(self, base_snaps, final_snaps,
-                               replay_wall) -> dict:
+    def _build_replica_section(self, base_snaps: Any, final_snaps: Any,
+                               replay_wall: float) -> dict:
         """The report's ``replica`` section (docs/replication.md):
         per-replica served/forwarded/refused counts and lag, the fence
         probes, and the revision-consistency reconcile — no response
@@ -1378,7 +1389,7 @@ class WorkloadRunner:
                 if rev > max_rev.get(target, 0):
                     max_rev[target] = rev
 
-        def counter_by_label(snap, name: str, label: str) -> dict:
+        def counter_by_label(snap: Any, name: str, label: str) -> dict:
             return {labels.get(label, "?"): int(v)
                     for labels, v in snap.get(name, ())}
 
@@ -1465,7 +1476,7 @@ def run_workload(spec: WorkloadSpec, target: str | None = None,
                           server_log=server_log).run()
 
 
-def main(argv=None) -> int:
+def main(argv: list[str] | None = None) -> int:
     ap = argparse.ArgumentParser(
         prog="kubebrain-workload",
         description="deterministic kube-apiserver workload replay "

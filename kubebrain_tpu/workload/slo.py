@@ -15,6 +15,7 @@ import json
 import math
 import os
 import re
+from typing import Any
 
 SCHEMA_ID = "kubebrain-workload-slo/v1"
 
@@ -229,7 +230,7 @@ def percentile(samples: list[float], q: float) -> float:
     return s[idx]
 
 
-def evaluate(report: dict, bounds) -> tuple[bool, list[str]]:
+def evaluate(report: dict, bounds: Any) -> tuple[bool, list[str]]:
     """Judge a report against declared bounds; returns (passed, violations).
     ``bounds`` is a spec.SLOBounds (or anything with its attributes)."""
     v: list[str] = []

@@ -11,6 +11,7 @@ fully describes how the numbers were produced.
 from __future__ import annotations
 
 from dataclasses import asdict, dataclass, field, replace
+from typing import Any
 
 
 @dataclass(frozen=True)
@@ -156,7 +157,7 @@ class WorkloadSpec:
 
     # ------------------------------------------------------------ factories
     @classmethod
-    def for_cluster(cls, nodes: int, **overrides) -> "WorkloadSpec":
+    def for_cluster(cls, nodes: int, **overrides: Any) -> "WorkloadSpec":
         """The ``make bench-cluster N=...`` shape: namespaces scale with the
         node count, and at >= 100 nodes the relist storms are expected to
         form query batches (kb_sched_batch_size must move)."""
@@ -168,7 +169,7 @@ class WorkloadSpec:
                    **overrides)
 
     @classmethod
-    def for_churn_heavy(cls, nodes: int, **overrides) -> "WorkloadSpec":
+    def for_churn_heavy(cls, nodes: int, **overrides: Any) -> "WorkloadSpec":
         """Write-storm scenario (docs/writes.md): pod churn ~4x the
         cluster shape plus a node-lease keepalive storm (tight cadence,
         every node), with the list/relist load thinned so the traffic
@@ -200,7 +201,7 @@ class WorkloadSpec:
 
     @classmethod
     def for_chaos(cls, nodes: int, preset: str = "smoke",
-                  **overrides) -> "WorkloadSpec":
+                  **overrides: Any) -> "WorkloadSpec":
         """Chaos-mode replay (docs/faults.md): the churn_heavy traffic
         shape under an armed fault schedule. Latency/shed/error bounds are
         deliberately loose — the chaos gate is the KEYSTONE consistency
@@ -236,7 +237,7 @@ class WorkloadSpec:
         return cls(**defaults)
 
     @classmethod
-    def for_smoke(cls, nodes: int = 10, **overrides) -> "WorkloadSpec":
+    def for_smoke(cls, nodes: int = 10, **overrides: Any) -> "WorkloadSpec":
         """Small-N CI smoke: short replay, every traffic shape still
         present (several churn ticks, >= 1 relist storm, >= 1 compaction,
         >= 1 keepalive per node)."""
@@ -252,7 +253,7 @@ class WorkloadSpec:
         defaults.update(overrides)
         return cls(**defaults)
 
-    def with_(self, **overrides) -> "WorkloadSpec":
+    def with_(self, **overrides: Any) -> "WorkloadSpec":
         return replace(self, **overrides)
 
     def to_dict(self) -> dict:

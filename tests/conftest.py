@@ -48,6 +48,15 @@ import sys  # noqa: E402
 import pytest  # noqa: E402
 
 # ---------------------------------------------------------------------------
+# KB_SANITIZE=1: the umbrella switch — arms all three runtime sanitizers
+# (lockcheck + fieldcheck + leakcheck) at once; KB_SANITIZE_STRICT=1 makes
+# every one of them fail the offending test. The chaos suite
+# (tests/test_faults.py) runs under this umbrella in CI.
+
+_SANITIZE = os.environ.get("KB_SANITIZE") == "1"
+_SANITIZE_STRICT = os.environ.get("KB_SANITIZE_STRICT") == "1"
+
+# ---------------------------------------------------------------------------
 # Opt-in lock-order race detector (see kubebrain_tpu/util/lockcheck.py and
 # docs/static_analysis.md). KB_LOCKCHECK=1 wraps every project-created
 # threading.Lock/RLock to build the runtime lock-order graph; a test that
@@ -55,7 +64,7 @@ import pytest  # noqa: E402
 # with the offending stacks. Installed here, before any test module imports
 # kubebrain_tpu, so module-level locks are wrapped too.
 
-_LOCKCHECK = os.environ.get("KB_LOCKCHECK") == "1"
+_LOCKCHECK = os.environ.get("KB_LOCKCHECK") == "1" or _SANITIZE
 if _LOCKCHECK:
     from kubebrain_tpu.util import lockcheck as _lockcheck
 
@@ -70,12 +79,46 @@ if _LOCKCHECK:
 # twin). Observe-only by default; KB_FIELDCHECK_STRICT=1 additionally FAILS
 # any test that produced a multi-thread no-common-guard write.
 
-_FIELDCHECK = os.environ.get("KB_FIELDCHECK") == "1"
-_FIELDCHECK_STRICT = os.environ.get("KB_FIELDCHECK_STRICT") == "1"
+_FIELDCHECK = os.environ.get("KB_FIELDCHECK") == "1" or _SANITIZE
+_FIELDCHECK_STRICT = (os.environ.get("KB_FIELDCHECK_STRICT") == "1"
+                      or _SANITIZE_STRICT)
 if _FIELDCHECK:
     from kubebrain_tpu.util import fieldcheck as _fieldcheck
 
     _fieldcheck.install()  # installs lockcheck too (guard observation)
+
+# ---------------------------------------------------------------------------
+# Opt-in linear-resource leak sanitizer (see kubebrain_tpu/util/leakcheck.py
+# and docs/static_analysis.md). KB_LEAKCHECK=1 wraps the four linear-resource
+# protocols the static KB123–KB126 rules track (dealt revisions, sched
+# slots, watcher registrations, spans) and records acquire/release balance;
+# KB_LEAKCHECK_EXPORT=<path> dumps the balances at session end for kblint's
+# --leak-report cross-check. Observe-only by default; KB_LEAKCHECK_STRICT=1
+# additionally FAILS any test that produced a leak violation.
+
+_LEAKCHECK = os.environ.get("KB_LEAKCHECK") == "1" or _SANITIZE
+_LEAKCHECK_STRICT = (os.environ.get("KB_LEAKCHECK_STRICT") == "1"
+                     or _SANITIZE_STRICT)
+if _LEAKCHECK:
+    from kubebrain_tpu.util import leakcheck as _leakcheck
+
+    _leakcheck.install()
+
+
+@pytest.fixture(autouse=True)
+def _leakcheck_guard():
+    if not _LEAKCHECK:
+        yield
+        return
+    _leakcheck.take_violations()  # stale noise from other tests' threads
+    yield
+    _leakcheck.check_teardown()   # sweep close-less resources (spans)
+    found = _leakcheck.take_violations()
+    if found and _LEAKCHECK_STRICT:
+        raise _leakcheck.LeakError(
+            "linear-resource leaks during this test:\n"
+            + "\n".join(v.render() for v in found)
+        )
 
 
 @pytest.fixture(autouse=True)
@@ -133,6 +176,18 @@ def pytest_sessionfinish(session, exitstatus):
                 f"{fields_path}\n")
         except OSError as e:
             sys.stderr.write(f"[fieldcheck] field export failed: {e}\n")
+    # KB_LEAKCHECK_EXPORT=<path>: dump the session's acquire/release
+    # balances for the static linter's KB123–KB126 cross-check
+    # (python -m tools.kblint --deep --leak-observed <path> --leak-report)
+    leaks_path = os.environ.get("KB_LEAKCHECK_EXPORT")
+    if _LEAKCHECK and leaks_path:
+        try:
+            n = _leakcheck.export_observed(leaks_path)
+            sys.stderr.write(
+                f"[leakcheck] exported {n} protocol kinds to "
+                f"{leaks_path}\n")
+        except OSError as e:
+            sys.stderr.write(f"[leakcheck] export failed: {e}\n")
 
 
 _DEADLINE_DEFAULT = 240.0

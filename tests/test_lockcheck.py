@@ -142,6 +142,56 @@ def test_condition_on_checked_locks_works(lc):
         assert not t.is_alive()
 
 
+def test_cross_thread_kick_release_clears_acquirer_stack(lc):
+    """The single-flight kick idiom (TpuScanner's merge/rebuild kicks):
+    the caller acquires with blocking=False, the spawned worker releases
+    in its finally. The release lands on a different thread than the
+    acquire — the entry must still leave the ACQUIRER's held stack, or
+    every later sleep on that thread is blamed for a lock it handed off
+    (the false positive the chaos-under-sanitizer suite exposed)."""
+    kick = threading.Lock()
+    assert kick.acquire(blocking=False)
+    t = threading.Thread(target=kick.release)
+    t.start()
+    t.join(timeout=2.0)
+    assert not t.is_alive()
+    time.sleep(0.005)  # acquirer thread: must NOT flag sleep-under-lock
+    sleeps = [v for v in lc.violations()
+              if v.kind == "blocking-call-under-lock"]
+    assert sleeps == [], [v.detail for v in sleeps]
+
+
+def test_handoff_adopt_transfers_ownership(lc):
+    """The annotated form of the kick idiom: handoff() on the acquirer
+    means its later sleeps are never blamed (even while the worker still
+    runs), and adopt() in the worker puts the latch on the WORKER's held
+    stack — visible to fieldcheck as the guard serializing its writes —
+    while latch entries stay exempt from sleep-blame (retry backoff under
+    the kick is by design, not a convoy)."""
+    kick = threading.Lock()
+    assert kick.acquire(blocking=False)
+    lc.handoff(kick)
+    time.sleep(0.005)  # acquirer handed the kick off: no blame
+    held_in_worker = []
+
+    def worker():
+        lc.adopt(kick)
+        held_in_worker.append(lc.held_sites())
+        time.sleep(0.002)  # backoff under the adopted latch: no blame
+        kick.release()
+
+    t = threading.Thread(target=worker)
+    t.start()
+    t.join(timeout=2.0)
+    assert not t.is_alive()
+    assert held_in_worker, "worker never ran"
+    assert any("test_lockcheck.py" in s for s in held_in_worker[0]), \
+        held_in_worker
+    sleeps = [v for v in lc.violations()
+              if v.kind == "blocking-call-under-lock"]
+    assert sleeps == [], [v.detail for v in sleeps]
+
+
 def test_uninstall_restores_primitives():
     was_installed = lockcheck.installed()
     lockcheck.install()

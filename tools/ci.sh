@@ -1,12 +1,13 @@
 #!/usr/bin/env bash
 # The offline CI entry point (mirrored by .github/workflows/check.yml):
 #   1. make lint        — kblint project invariants (syntactic KB101-KB111
-#                         + the --deep interprocedural tier KB112-KB122,
+#                         + the --deep interprocedural tier KB112-KB122
+#                         + the CFG/typestate leak tier KB123-KB126,
 #                         zero non-baselined findings, <60s budget
 #                         enforced) + native lint, then the kblint engine
 #                         self-tests (rule fixtures, differential corpus,
-#                         cache cold/warm) — a lint-engine regression
-#                         should fail before anything else runs
+#                         leak-rule corpus, cache cold/warm) — a lint-engine
+#                         regression should fail before anything else runs
 #   2. make typecheck   — mypy (or compileall fallback)
 #   3. scheduler gate   — sched semantics + query-batched scan tests
 #                         (batched == sequential byte-identical, incl. the
@@ -65,6 +66,7 @@ echo "=== [1/11] make lint (syntactic + deep interprocedural, 60s budget)"
 make lint || exit 1
 env JAX_PLATFORMS=cpu python -m pytest tests/test_kblint.py \
     tests/test_kblint_deep.py tests/test_kblint_races.py \
+    tests/test_kblint_leaks.py \
     -q -m 'not slow' -p no:cacheprovider || exit 1
 
 echo "=== [2/11] make typecheck"
@@ -116,6 +118,13 @@ env JAX_PLATFORMS=cpu python -m pytest tests/test_replica.py -q -m 'not slow' \
 echo "=== [10/11] chaos: fault-schedule determinism + inertness + taxonomy + FAULTS=smoke consistency gate"
 env JAX_PLATFORMS=cpu python -m pytest tests/test_faults.py \
     tests/test_watch_robustness.py -q -m 'not slow' \
+    -p no:cacheprovider || exit 1
+# chaos under the full sanitizer umbrella (docs/static_analysis.md): the
+# fault-injection suite with lockcheck + fieldcheck + leakcheck all armed
+# and strict — exception paths under injected faults must not leak dealt
+# revisions, slots, watchers, or spans (the KB123-KB126 runtime twin)
+env JAX_PLATFORMS=cpu KB_SANITIZE=1 KB_SANITIZE_STRICT=1 \
+    python -m pytest tests/test_faults.py -q -m 'not slow' \
     -p no:cacheprovider || exit 1
 
 echo "=== [11/11] tier-1 tests (ROADMAP.md verify, one definition: make test-tier1)"

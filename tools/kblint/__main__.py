@@ -5,8 +5,13 @@ Two tiers (docs/static_analysis.md):
 - default: the syntactic per-file rules KB101–KB111 over ``paths``
 - ``--deep``: additionally builds the whole-program call graph over
   ``kubebrain_tpu/ + tools/ + bench.py`` and runs the interprocedural
-  rules KB112–KB122, filtered through tools/kblint/baseline.json and held
-  to a wall-clock budget (CI fails if the analysis outgrows it).
+  rules KB112–KB122 plus the CFG/typestate leak rules KB123–KB126,
+  filtered through tools/kblint/baseline.json and held to a wall-clock
+  budget (CI fails if the analysis outgrows it).
+
+``--sarif PATH`` additionally writes the run's findings as SARIF 2.1.0
+for GitHub code scanning (baselined findings ride along marked
+``unchanged``).
 
 Both tiers share the content-hash cache in ``.kblint_cache/`` (disable
 with ``KBLINT_CACHE=0``), so incremental runs only re-analyze edited
@@ -70,6 +75,18 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--field-guards", action="store_true",
                         help="print the static field-guard report and the "
                              "runtime fieldcheck cross-check")
+    parser.add_argument("--leak-observed", default="",
+                        help="JSON file of runtime leak observations "
+                             "(util/leakcheck.py export) to cross-check "
+                             "against the static KB123-KB126 obligation "
+                             "sites; defaults to $KBLINT_LEAK_OBSERVED on "
+                             "--deep runs")
+    parser.add_argument("--leak-report", action="store_true",
+                        help="print the static obligation-site report and "
+                             "the runtime leakcheck cross-check")
+    parser.add_argument("--sarif", default="",
+                        help="write findings as SARIF 2.1.0 to this path "
+                             "(for GitHub code-scanning upload)")
     parser.add_argument("--stats", action="store_true",
                         help="print resolution/propagation statistics")
     parser.add_argument("--no-cache", action="store_true",
@@ -86,20 +103,24 @@ def main(argv: list[str] | None = None) -> int:
 
     if not args.deep and (args.lock_edges or args.lock_graph or args.stats
                           or args.write_baseline or args.field_observed
-                          or args.field_guards):
+                          or args.field_guards or args.leak_observed
+                          or args.leak_report):
         # a typo'd CI line must not pass green while doing none of the work
         # (only EXPLICIT flags trigger this — the KBLINT_LOCK_EDGES /
-        # KBLINT_FIELD_OBSERVED env fallbacks are read later, on --deep
-        # runs only, so an exported env var cannot fail an ordinary
-        # syntactic run)
+        # KBLINT_FIELD_OBSERVED / KBLINT_LEAK_OBSERVED env fallbacks are
+        # read later, on --deep runs only, so an exported env var cannot
+        # fail an ordinary syntactic run). --sarif is fine without --deep:
+        # a syntactic-only SARIF is still a complete scan of its tier.
         print("kblint: --lock-edges/--lock-graph/--field-observed/"
-              "--field-guards/--stats/--write-baseline require --deep",
-              file=sys.stderr)
+              "--field-guards/--leak-observed/--leak-report/--stats/"
+              "--write-baseline require --deep", file=sys.stderr)
         return 2
     if args.deep and not args.lock_edges:
         args.lock_edges = os.environ.get("KBLINT_LOCK_EDGES", "")
     if args.deep and not args.field_observed:
         args.field_observed = os.environ.get("KBLINT_FIELD_OBSERVED", "")
+    if args.deep and not args.leak_observed:
+        args.leak_observed = os.environ.get("KBLINT_LEAK_OBSERVED", "")
 
     t0 = time.monotonic()
     cache = None if args.no_cache else LintCache.from_env(args.root)
@@ -111,6 +132,8 @@ def main(argv: list[str] | None = None) -> int:
     if findings:
         print(f"kblint: {len(findings)} finding(s)", file=sys.stderr)
         failed = True
+    sarif_new = list(findings)
+    sarif_pinned: list = []
 
     if args.deep:
         runtime_edges = None
@@ -138,9 +161,24 @@ def main(argv: list[str] | None = None) -> int:
                 print(f"kblint: unreadable --field-observed file: {e}",
                       file=sys.stderr)
                 return 2
+        leak_obs = None
+        if args.leak_observed:
+            try:
+                with open(args.leak_observed, encoding="utf-8") as fh:
+                    data = json.load(fh)
+                if not isinstance(data, dict):
+                    raise ValueError(
+                        "expected the export_observed() object form "
+                        "({'kinds': [...]}), got " + type(data).__name__)
+                leak_obs = list(data.get("kinds", []))
+            except (OSError, ValueError) as e:
+                print(f"kblint: unreadable --leak-observed file: {e}",
+                      file=sys.stderr)
+                return 2
         result = deep_analyze_paths(args.root, DEEP_ROOTS, cache=cache,
                                     runtime_lock_edges=runtime_edges,
-                                    runtime_field_obs=field_obs)
+                                    runtime_field_obs=field_obs,
+                                    runtime_leak_obs=leak_obs)
         baseline = Baseline.load(args.baseline)
         new, pinned, stale = baseline.split(result.findings)
         if args.write_baseline:
@@ -163,6 +201,7 @@ def main(argv: list[str] | None = None) -> int:
               f"functions, {s['resolved_calls']} calls resolved / "
               f"{s['unresolved_calls']} unresolved / {s['fn_refs']} fn-refs,"
               f" {len(pinned)} baselined, {s['lock_edges']} lock edges, "
+              f"{s.get('leak_acquires', 0)} leak obligations, "
               f"{s['elapsed_seconds']}s")
         if args.stats:
             print(json.dumps(s, indent=1, sort_keys=True))
@@ -170,6 +209,17 @@ def main(argv: list[str] | None = None) -> int:
             print(json.dumps(result.lock_graph, indent=1, sort_keys=True))
         if args.field_guards:
             print(json.dumps(result.field_guards, indent=1, sort_keys=True))
+        if args.leak_report:
+            print(json.dumps(result.leaks, indent=1, sort_keys=True))
+        sarif_new.extend(new)
+        sarif_pinned = list(pinned)
+
+    if args.sarif:
+        from .sarif import write_sarif
+        write_sarif(args.sarif, sarif_new, sarif_pinned)
+        print(f"kblint: wrote SARIF ({len(sarif_new)} result(s), "
+              f"{len(sarif_pinned)} baselined) to {args.sarif}",
+              file=sys.stderr)
 
     elapsed = time.monotonic() - t0
     if args.budget and elapsed > args.budget:

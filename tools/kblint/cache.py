@@ -22,7 +22,7 @@ import json
 import os
 
 _ENGINE_SOURCES = ("core.py", "rules.py", "graph.py", "contexts.py",
-                   "cache.py")
+                   "cfg.py", "sarif.py", "cache.py")
 
 
 def engine_key() -> str:

@@ -1,0 +1,1085 @@
+"""Per-function control-flow graphs with explicit exception edges, and the
+must-reach typestate rules KB123–KB126 (linear-resource leak detection).
+
+kblint's first three tiers check *where* code runs (locks, threads,
+tracing); this tier checks *whether an acquired resource is released on
+every path the runtime can actually take* — including the paths PR 11's
+fault plane made routine, where any storage call raises mid-flight.
+
+Three layers:
+
+1. **CFG construction** (:func:`build_cfg`) — per-function graphs lowered
+   straight from the AST with the edges that matter for leaks made
+   explicit: every statement containing a call gets an exception edge to
+   the innermost handler (or the function's exceptional exit), ``finally``
+   bodies are duplicated per outgoing edge kind (normal / exception /
+   return / break / continue) so a release in a ``finally`` covers all of
+   them, ``return``/``break``/``continue`` route through enclosing
+   ``finally`` blocks, and ``while True`` heads get no phantom fall-
+   through edge (the dispatcher-loop shape must not fabricate an exit).
+
+2. **Obligations** — acquire sites per rule, with a flow-insensitive
+   alias closure (containers absorb: ``p["rev"] = rev`` makes ``p`` —
+   and, through ``for p in pending``, ``pending`` — carry the dealt
+   revision's obligation) and per-rule discharge/transfer policies
+   (RacerD-ownership style: returning the resource, storing it on
+   ``self``, or passing it to a callee that provably discharges it
+   transfers the obligation; passing it to a call the resolver cannot
+   see is an OPTIMISTIC transfer, counted in
+   ``stats["leak_unresolved_transfers"]`` — the same honest-blindness
+   contract as KB112).
+
+3. **Must-reach dataflow** — BFS from each acquire site over the CFG,
+   stopping at discharge nodes; a reachable exit means a leaking path,
+   and the BFS parent chain is the reported witness (acquire site →
+   escaping edge). KB123/KB126 demand discharge on ALL paths; KB124/KB125
+   flag only paths that traverse an exception edge (a normal-path
+   non-release is the sanctioned handoff protocol — the scheduler
+   dispatcher hands its slot to the worker with the queued request).
+
+The rules:
+
+- **KB123** dealt-revision leak: every ``TSO.deal``/``deal_block`` result
+  must reach ``_notify``/``_notify_many`` (valid, failed or uncertain
+  notify — the sequencer needs ALL of them) on every path, or have its
+  ownership transferred. A dealt revision that never reaches the
+  sequencer wedges the revision stream forever (the etcd revision-gap
+  contract).
+- **KB124** manual lock acquire (``.acquire()`` outside ``with``, or the
+  scheduler's ``_acquire_slot``/``_release_slot`` protocol pair) not
+  released on an exception edge.
+- **KB125** registration leak: watcher-hub registration, trace-span open,
+  callback-gauge registration, fault-plane arming that an exception edge
+  can escape without the matching deregistration.
+- **KB126** stream/channel/handle lifecycle: gRPC channels, sockets and
+  file handles must be closed on all paths or provably transferred.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import re
+from typing import Any, Iterable
+
+from .core import Finding
+from .graph import ProjectGraph, module_name_for
+from .rules import dotted_name, terminal_name
+
+_LOCK_NAME_RE = re.compile(r"lock$", re.IGNORECASE)
+
+#: manual-lock constructors for the KB124 prescan. Semaphores are
+#: deliberately absent: this codebase uses them as wakeup *kicks*
+#: (``_rebuild_kick.acquire(blocking=False)`` consumes a signal token —
+#: releasing it on exit would be a bug, not a fix).
+_MANUAL_LOCK_CTORS = ("threading.Lock", "threading.RLock",
+                      "threading.Condition")
+
+#: project release protocols that behave like locks without being them:
+#: acquire terminal -> (release terminal, self-container handoff allowed).
+#: The scheduler dispatcher hands its slot to the worker by queueing the
+#: request (``self._runq.append(req)``), so a self-container append after
+#: a protocol acquire transfers the obligation.
+_PROTOCOL_PAIRS = {
+    "_acquire_slot": ("_release_slot", True),
+}
+
+#: KB125 registration pairs: (acquire terminals, release terminals,
+#: kind label, receiver-substring requirement or None). Pairs whose
+#: registration returns no handle (gauges) discharge on ANY matching
+#: deregistration call — there is no token to data-link.
+_REG_PAIRS: list[tuple[frozenset, frozenset, str, str | None]] = [
+    (frozenset({"add_watcher", "add_watcher_with_replay"}),
+     frozenset({"delete_watcher"}), "watcher", None),
+    (frozenset({"register_gauge_fn"}),
+     frozenset({"unregister_gauge_fn"}), "gauge", None),
+    (frozenset({"arm"}), frozenset({"close", "disarm"}), "fault-plane",
+     "plane"),
+]
+
+#: KB123 discharge terminals: the sequencer feed. Both valid and invalid
+#: notifies count — the contract is that every dealt revision reaches the
+#: ring, not that it succeeds.
+_NOTIFY_TERMINALS = frozenset({"_notify", "_notify_many"})
+
+#: KB126 acquire call names (dotted) and close terminals
+_HANDLE_CTORS = frozenset({
+    "grpc.insecure_channel", "grpc.secure_channel", "socket.socket",
+    "open",
+})
+_CLOSE_TERMINALS = frozenset({"close", "shutdown"})
+
+
+# ------------------------------------------------------------------- CFG
+
+
+class Node:
+    """One CFG node ≈ one statement occurrence. ``finally`` lowering
+    duplicates statements, so a source statement can own several nodes."""
+
+    __slots__ = ("line", "label", "succ", "stmt", "branch_else")
+
+    def __init__(self, line: int, label: str,
+                 stmt: ast.stmt | None = None) -> None:
+        self.line = line
+        self.label = label
+        self.stmt = stmt
+        self.succ: list[tuple["Node", str]] = []  # (target, "step"|"exc")
+        self.branch_else: "Node | None" = None    # If: the fall-through arm
+
+    def edge(self, other: "Node", kind: str = "step") -> None:
+        self.succ.append((other, kind))
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"<Node :{self.line} {self.label}>"
+
+
+@dataclasses.dataclass
+class _Frame:
+    """Lowering context: where each non-local edge kind goes from here."""
+
+    exc: Node
+    ret: Node
+    brk: Node | None = None
+    cont: Node | None = None
+
+
+class CFG:
+    def __init__(self, fn: ast.FunctionDef | ast.AsyncFunctionDef) -> None:
+        self.fn = fn
+        self.exit = Node(getattr(fn, "end_lineno", fn.lineno) or fn.lineno,
+                         "normal-exit")
+        self.raise_exit = Node(fn.lineno, "raise-exit")
+        self.stmt_nodes: dict[int, list[Node]] = {}  # id(stmt) -> nodes
+        self._builder = _Builder(self)
+        self.entry = self._builder.block(
+            fn.body, self.exit, _Frame(exc=self.raise_exit, ret=self.exit))
+
+    def nodes_for(self, stmt: ast.stmt) -> list[Node]:
+        return self.stmt_nodes.get(id(stmt), [])
+
+
+def _stmt_exprs(st: ast.stmt) -> list[ast.expr]:
+    """The expressions evaluated BY this statement's own node (compound
+    statements only evaluate their header here; bodies are lowered into
+    their own nodes)."""
+    if isinstance(st, (ast.If, ast.While)):
+        return [st.test]
+    if isinstance(st, ast.For):
+        return [st.iter]
+    if isinstance(st, (ast.With, ast.AsyncWith)):
+        return [i.context_expr for i in st.items]
+    if isinstance(st, ast.Try):
+        return []
+    out: list[ast.expr] = []
+    for child in ast.iter_child_nodes(st):
+        if isinstance(child, ast.expr):
+            out.append(child)
+    return out
+
+
+#: calls modeled as non-raising: plain constructors (project record types
+#: are dataclasses — a genuinely raising ``__init__`` is a documented
+#: miss) and the total builtins. Without this every ``event =
+#: WatchEvent(revision=rev, ...)`` between a deal and its notify-finally
+#: fabricates an exception edge no runtime can take.
+_NONRAISING_CALLS = frozenset({
+    "enumerate", "len", "range", "zip", "sorted", "reversed", "min", "max",
+    "sum", "abs", "id", "repr", "str", "int", "float", "bool", "bytes",
+    "tuple", "list", "dict", "set", "frozenset", "isinstance", "hasattr",
+    "getattr", "callable", "type", "format",
+    # sanitizer ownership-transfer annotations (util/lockcheck.py): no-ops
+    # by contract — an annotation that could raise between a try-acquire
+    # and the worker spawn would itself be the leak it exists to describe
+    "handoff", "adopt",
+})
+
+
+def _call_may_raise(call: ast.Call) -> bool:
+    term = terminal_name(call.func)
+    if term in _NONRAISING_CALLS:
+        return False
+    if term[:1].isupper():
+        return False
+    return True
+
+
+def _can_raise(st: ast.stmt) -> bool:
+    """Whether this statement's own evaluation can raise. Calls only
+    (plus ``raise``/``assert``): subscripts and attribute loads can
+    technically raise too, but flagging those paths would drown the
+    signal — chaos injects faults through CALLS. A documented miss."""
+    if isinstance(st, (ast.Raise, ast.Assert)):
+        return True
+    for expr in _stmt_exprs(st):
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Call) and _call_may_raise(node):
+                return True
+            if isinstance(node, (ast.Await, ast.Yield, ast.YieldFrom)):
+                return True
+    return False
+
+
+def _is_const_true(expr: ast.expr) -> bool:
+    return isinstance(expr, ast.Constant) and bool(expr.value) is True
+
+
+def _catches_everything(handlers: list[ast.ExceptHandler]) -> bool:
+    for h in handlers:
+        if h.type is None:
+            return True
+        for name in ([dotted_name(e) for e in h.type.elts]
+                     if isinstance(h.type, ast.Tuple)
+                     else [dotted_name(h.type)]):
+            if name.split(".")[-1] in ("Exception", "BaseException"):
+                return True
+    return False
+
+
+class _Builder:
+    def __init__(self, cfg: CFG) -> None:
+        self.cfg = cfg
+
+    def block(self, stmts: list[ast.stmt], succ: Node, frame: _Frame) -> Node:
+        """Lower ``stmts`` (right-to-left so every statement knows its
+        continuation); returns the entry node."""
+        for st in reversed(stmts):
+            succ = self.stmt(st, succ, frame)
+        return succ
+
+    def _node(self, st: ast.stmt, label: str) -> Node:
+        n = Node(st.lineno, label, st)
+        self.cfg.stmt_nodes.setdefault(id(st), []).append(n)
+        return n
+
+    def stmt(self, st: ast.stmt, succ: Node, frame: _Frame) -> Node:
+        if isinstance(st, ast.Return):
+            n = self._node(st, "return")
+            n.edge(frame.ret)
+            if _can_raise(st):
+                n.edge(frame.exc, "exc")
+            return n
+        if isinstance(st, ast.Raise):
+            n = self._node(st, "raise")
+            n.edge(frame.exc, "exc")
+            return n
+        if isinstance(st, ast.Break):
+            n = self._node(st, "break")
+            n.edge(frame.brk if frame.brk is not None else frame.ret)
+            return n
+        if isinstance(st, ast.Continue):
+            n = self._node(st, "continue")
+            n.edge(frame.cont if frame.cont is not None else frame.ret)
+            return n
+        if isinstance(st, ast.If):
+            n = self._node(st, "if")
+            body = self.block(st.body, succ, frame)
+            orelse = self.block(st.orelse, succ, frame) if st.orelse else succ
+            n.edge(body)
+            n.edge(orelse)
+            n.branch_else = orelse
+            if _can_raise(st):
+                n.edge(frame.exc, "exc")
+            return n
+        if isinstance(st, ast.While):
+            n = self._node(st, "while")
+            inner = dataclasses.replace(frame, brk=succ, cont=n)
+            body = self.block(st.body, n, inner)
+            n.edge(body)
+            if not _is_const_true(st.test):
+                # `while True:` has no fall-through: fabricating one would
+                # invent leak paths that skip the loop body entirely
+                tail = self.block(st.orelse, succ, frame) if st.orelse else succ
+                n.edge(tail)
+            if _can_raise(st):
+                n.edge(frame.exc, "exc")
+            return n
+        if isinstance(st, (ast.For, ast.AsyncFor)):
+            n = self._node(st, "for")
+            inner = dataclasses.replace(frame, brk=succ, cont=n)
+            body = self.block(st.body, n, inner)
+            n.edge(body)
+            tail = self.block(st.orelse, succ, frame) if st.orelse else succ
+            n.edge(tail)
+            if _can_raise(st):
+                n.edge(frame.exc, "exc")
+            return n
+        if isinstance(st, (ast.With, ast.AsyncWith)):
+            # `with` guarantees __exit__ on both the normal and the
+            # exception path — the desugaring that matters for leaks is
+            # only that the body's exceptions still propagate outward
+            n = self._node(st, "with")
+            body = self.block(st.body, succ, frame)
+            n.edge(body)
+            if _can_raise(st):
+                n.edge(frame.exc, "exc")
+            return n
+        if isinstance(st, ast.Try):
+            return self._try(st, succ, frame)
+        if isinstance(st, ast.Match):
+            n = self._node(st, "match")
+            for case in st.cases:
+                n.edge(self.block(case.body, succ, frame))
+            n.edge(succ)  # no case matched
+            if _can_raise(st):
+                n.edge(frame.exc, "exc")
+            return n
+        label = type(st).__name__.lower()
+        if isinstance(st, ast.Expr) and isinstance(st.value, ast.Call):
+            label = f"call {terminal_name(st.value.func) or '?'}"
+        elif isinstance(st, ast.Assign) and st.targets:
+            label = f"assign {terminal_name(st.targets[0]) or '...'}"
+        n = self._node(st, label)
+        n.edge(succ)
+        if _can_raise(st):
+            n.edge(frame.exc, "exc")
+        return n
+
+    def _try(self, st: ast.Try, succ: Node, frame: _Frame) -> Node:
+        # finally copies, one per outgoing edge kind (classic lowering:
+        # a release inside `finally` must cover normal completion AND
+        # exception AND return AND break/continue)
+        if st.finalbody:
+            fin_norm = self.block(st.finalbody, succ, frame)
+            fin_exc = self.block(st.finalbody, frame.exc, frame)
+            fin_ret = self.block(st.finalbody, frame.ret, frame)
+            fin_brk = (self.block(st.finalbody, frame.brk, frame)
+                       if frame.brk is not None else None)
+            fin_cont = (self.block(st.finalbody, frame.cont, frame)
+                        if frame.cont is not None else None)
+        else:
+            fin_norm, fin_exc, fin_ret = succ, frame.exc, frame.ret
+            fin_brk, fin_cont = frame.brk, frame.cont
+        outer = _Frame(exc=fin_exc, ret=fin_ret, brk=fin_brk, cont=fin_cont)
+        # handler bodies: their own exceptions go through finally outward
+        handler_entries: list[Node] = []
+        for h in st.handlers:
+            hn = Node(h.lineno, "except")
+            hn.edge(self.block(h.body, fin_norm, outer))
+            handler_entries.append(hn)
+        if st.handlers:
+            dispatch = Node(st.lineno, "except-dispatch")
+            for hn in handler_entries:
+                dispatch.edge(hn)
+            if not _catches_everything(st.handlers):
+                # an exception no handler matches propagates out (through
+                # finally); with a catch-all this edge would fabricate
+                # leak paths on KeyboardInterrupt only
+                dispatch.edge(fin_exc, "exc")
+            body_exc: Node = dispatch
+        else:
+            body_exc = fin_exc
+        inner = _Frame(exc=body_exc, ret=fin_ret, brk=fin_brk, cont=fin_cont)
+        after_body = (self.block(st.orelse, fin_norm, outer) if st.orelse
+                      else fin_norm)
+        return self.block(st.body, after_body, inner)
+
+
+def build_cfg(fn: ast.FunctionDef | ast.AsyncFunctionDef) -> CFG:
+    return CFG(fn)
+
+
+# ------------------------------------------------------------ obligations
+
+
+@dataclasses.dataclass
+class Obligation:
+    rule: str                 # KB123..KB126
+    kind: str                 # revision | lock | slot | watcher | ...
+    line: int
+    col: int
+    what: str                 # display name of the resource
+    start_nodes: list[Node]   # where the resource provably exists
+    aliases: set[str]         # names carrying the obligation ("" = none)
+    recv: str = ""            # KB124: dotted receiver of .acquire()
+    release_terminals: frozenset = frozenset()
+    handoff_append: bool = False   # KB124 protocol: self-container handoff
+    exception_only: bool = False   # KB124/KB125: flag exc-escapes only
+    linked: bool = True            # discharge must mention an alias
+
+
+def _names_in(expr: ast.expr | None) -> set[str]:
+    if expr is None:
+        return set()
+    return {n.id for n in ast.walk(expr) if isinstance(n, ast.Name)}
+
+
+def _root_name(expr: ast.expr) -> str | None:
+    while isinstance(expr, (ast.Attribute, ast.Subscript)):
+        expr = expr.value
+    return expr.id if isinstance(expr, ast.Name) else None
+
+
+def _alias_closure(fn: ast.AST, seeds: set[str]) -> set[str]:
+    """Flow-insensitive alias/absorption closure over the function body.
+
+    Assignments propagate target <- value; container stores absorb
+    (``p["rev"] = rev`` marks ``p``); ``x.append(v)``-style mutators
+    absorb into the receiver; ``for``-targets link BIDIRECTIONALLY with
+    the iterated container (``for p in pending`` ties ``p`` and
+    ``pending`` — the write-batch event list needs the backward hop).
+    Optimistic by design: over-aliasing means more discharges recognized,
+    i.e. fewer false positives and more (counted) false negatives."""
+    aliases = set(seeds)
+    _ABSORB_METHODS = {"append", "add", "put", "extend", "appendleft",
+                       "put_nowait", "insert", "setdefault"}
+    for _ in range(10):
+        before = len(aliases)
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign):
+                vnames = _names_in(node.value)
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        if vnames & aliases:
+                            aliases.add(tgt.id)
+                    elif isinstance(tgt, (ast.Tuple, ast.List)):
+                        if vnames & aliases:
+                            aliases |= {e.id for e in tgt.elts
+                                        if isinstance(e, ast.Name)}
+                    elif isinstance(tgt, ast.Subscript):
+                        root = _root_name(tgt)
+                        if root and vnames & aliases:
+                            aliases.add(root)
+            elif isinstance(node, (ast.For, ast.AsyncFor)):
+                tnames = _names_in(node.target)
+                inames = _names_in(node.iter)
+                if tnames & aliases:
+                    aliases |= inames
+                if inames & aliases:
+                    aliases |= tnames
+            elif isinstance(node, ast.Call):
+                if (isinstance(node.func, ast.Attribute)
+                        and node.func.attr in _ABSORB_METHODS):
+                    argn: set[str] = set()
+                    for a in node.args:
+                        argn |= _names_in(a)
+                    if argn & aliases:
+                        root = _root_name(node.func.value)
+                        if root:
+                            aliases.add(root)
+        if len(aliases) == before:
+            break
+    return aliases
+
+
+# --------------------------------------------------------- leak analysis
+
+
+class _FileContext:
+    """Per-file helpers shared by every function analysis: lock-ish
+    attribute prescan (KB124) and the call-resolution index from the
+    ProjectGraph (transfer policies)."""
+
+    def __init__(self, relpath: str, tree: ast.Module,
+                 graph: ProjectGraph | None) -> None:
+        self.relpath = relpath
+        self.module = module_name_for(relpath)
+        self.graph = graph
+        self.lockish_attrs: dict[str, set[str]] = {}  # class -> attrs
+        self.lockish_globals: set[str] = set()
+        #: class -> every call terminal in its body, for the class-lifecycle
+        #: transfer: a HANDLE-LESS registration (gauge, fault-plane) can
+        #: only ever be cleaned up by the instance's own teardown, so a
+        #: matching deregistration ANYWHERE in the class transfers the
+        #: obligation to the instance lifecycle. A class that registers but
+        #: never deregisters is the real leak (its instances can never be
+        #: cleanly dropped) — that still fires.
+        self.class_call_terminals: dict[str, set[str]] = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ClassDef):
+                terms: set[str] = set()
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Call):
+                        t = terminal_name(sub.func)
+                        if t:
+                            terms.add(t)
+                self.class_call_terminals[node.name] = terms
+                attrs: set[str] = set()
+                for sub in ast.walk(node):
+                    if (isinstance(sub, ast.Assign)
+                            and isinstance(sub.value, ast.Call)
+                            and dotted_name(sub.value.func)
+                            in _MANUAL_LOCK_CTORS):
+                        for tgt in sub.targets:
+                            if (isinstance(tgt, ast.Attribute)
+                                    and isinstance(tgt.value, ast.Name)
+                                    and tgt.value.id == "self"):
+                                attrs.add(tgt.attr)
+                self.lockish_attrs[node.name] = attrs
+            elif (isinstance(node, ast.Assign)
+                  and isinstance(node.value, ast.Call)
+                  and dotted_name(node.value.func) in _MANUAL_LOCK_CTORS):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        self.lockish_globals.add(tgt.id)
+
+    def is_lockish(self, recv: str, cls: str | None) -> bool:
+        if not recv:
+            return False
+        tail = recv.split(".")[-1]
+        if _LOCK_NAME_RE.search(tail):
+            return True
+        if recv.startswith("self.") and cls:
+            return tail in self.lockish_attrs.get(cls, ())
+        return tail in self.lockish_globals
+
+    def resolution(self, qn: str) -> dict[int, tuple[bool, bool]]:
+        """line -> (any resolved target, any unresolved project call).
+        Drives the per-rule transfer policies; functions the graph does
+        not know (nested defs under a different qualname spelling) read
+        as fully unresolved — optimistic transfer, counted."""
+        out: dict[int, tuple[bool, bool]] = {}
+        if self.graph is None:
+            return out
+        for cs, targets in self.graph.calls.get(qn, ()):
+            if cs.is_ref:
+                continue
+            res, unres = out.get(cs.line, (False, False))
+            if targets:
+                res = True
+            elif self.graph._counts_as_unresolved(cs.name):
+                unres = True
+            out[cs.line] = (res, unres)
+        return out
+
+    def resolved_targets(self, qn: str, line: int) -> list[str]:
+        if self.graph is None:
+            return []
+        hits: list[str] = []
+        for cs, targets in self.graph.calls.get(qn, ()):
+            if not cs.is_ref and cs.line == line:
+                hits.extend(targets)
+        return hits
+
+
+def _notify_reach(graph: ProjectGraph) -> set[str]:
+    """Functions that (transitively, over resolved call edges) feed the
+    sequencer: passing a dealt revision into one of these transfers the
+    KB123 obligation — the callee owns delivery now."""
+    seeds = set()
+    for qn, fs in graph.functions.items():
+        for cs in fs.calls:
+            if not cs.is_ref and cs.name.split(".")[-1] in _NOTIFY_TERMINALS:
+                seeds.add(qn)
+                break
+    out = set(seeds)
+    frontier = list(seeds)
+    while frontier:
+        qn = frontier.pop()
+        for caller in graph.callers.get(qn, ()):
+            if caller not in out:
+                out.add(caller)
+                frontier.append(caller)
+    return out
+
+
+class _FuncLeaks:
+    """Obligations + must-reach for one function."""
+
+    def __init__(self, fn: ast.FunctionDef | ast.AsyncFunctionDef,
+                 qn: str, cls: str | None, ctx: _FileContext,
+                 notify_reach: set[str], stats: dict[str, int]) -> None:
+        self.fn = fn
+        self.qn = qn
+        self.cls = cls
+        self.ctx = ctx
+        self.notify_reach = notify_reach
+        self.stats = stats
+        self.cfg: CFG | None = None
+        self.obligations: list[Obligation] = []
+        self._with_ctx_calls: set[int] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    for sub in ast.walk(item.context_expr):
+                        if isinstance(sub, ast.Call):
+                            self._with_ctx_calls.add(id(sub))
+
+    # -- acquire-site discovery -------------------------------------------
+    def find_obligations(self) -> None:
+        body_stmts = [st for st in ast.walk(self.fn)
+                      if isinstance(st, ast.stmt)]
+        for st in body_stmts:
+            self._scan_stmt(st)
+
+    def _ensure_cfg(self) -> CFG:
+        if self.cfg is None:
+            self.cfg = build_cfg(self.fn)
+        return self.cfg
+
+    def _start_after(self, st: ast.stmt) -> list[Node]:
+        """Normal-completion successors of st's nodes: the obligation
+        exists only once the acquire call returned."""
+        cfg = self._ensure_cfg()
+        out: list[Node] = []
+        for n in cfg.nodes_for(st):
+            out.extend(t for t, kind in n.succ if kind == "step")
+        return out
+
+    def _guard_start(self, st: ast.If, call: ast.Call,
+                     positive_in_body: bool) -> list[Node] | None:
+        """`if not lk.acquire(...): <no-fallthrough>` — the obligation
+        begins at the fall-through arm. Returns None when the guard shape
+        is too complex to place (counted, not guessed)."""
+        cfg = self._ensure_cfg()
+        out: list[Node] = []
+        for n in cfg.nodes_for(st):
+            if positive_in_body:
+                # `if lk.acquire():` — acquired inside the body arm
+                arms = [t for t, kind in n.succ
+                        if kind == "step" and t is not n.branch_else]
+                out.extend(arms)
+            elif n.branch_else is not None:
+                out.append(n.branch_else)
+        return out or None
+
+    def _scan_stmt(self, st: ast.stmt) -> None:
+        for call in self._calls_of(st):
+            name = dotted_name(call.func)
+            term = terminal_name(call.func)
+            if id(call) in self._with_ctx_calls:
+                continue  # `with` discharges by construction
+            if not isinstance(st, ast.Return):
+                # `return self.tso.deal()` / `return open(p)`: the fresh
+                # resource is handed straight to the caller — caller-side
+                # accounting (the return-alias transfer, one level up)
+                # owns it. KB124 still applies: its resource is the
+                # acquire's side effect, not the returned value.
+                self._match_kb123(st, call, name, term)
+                self._match_kb125(st, call, name, term)
+                self._match_kb126(st, call, name, term)
+            self._match_kb124(st, call, name, term)
+
+    def _calls_of(self, st: ast.stmt) -> list[ast.Call]:
+        return [n for e in _stmt_exprs(st) for n in ast.walk(e)
+                if isinstance(n, ast.Call)]
+
+    def _bound_names(self, st: ast.stmt, call: ast.Call) -> set[str]:
+        """Names the call's result lands in, when st is `x = call(...)`
+        or `x, y = call(...)`."""
+        if isinstance(st, ast.Assign) and st.value is call:
+            names: set[str] = set()
+            for tgt in st.targets:
+                if isinstance(tgt, ast.Name):
+                    names.add(tgt.id)
+                elif isinstance(tgt, (ast.Tuple, ast.List)):
+                    names |= {e.id for e in tgt.elts
+                              if isinstance(e, ast.Name)}
+            return names
+        return set()
+
+    def _add(self, ob: Obligation) -> None:
+        self.obligations.append(ob)
+        self.stats["leak_acquires"] = self.stats.get("leak_acquires", 0) + 1
+        key = f"{ob.rule.lower()}_sites"
+        self.stats[key] = self.stats.get(key, 0) + 1
+
+    # -- per-rule acquire matchers ----------------------------------------
+    def _match_kb123(self, st: ast.stmt, call: ast.Call, name: str,
+                     term: str) -> None:
+        if term not in ("deal", "deal_block"):
+            return
+        recv = name[: -len(term) - 1] if name.endswith("." + term) else ""
+        if "tso" not in recv.lower():
+            return
+        bound = self._bound_names(st, call)
+        if not bound:
+            # a bare `self.tso.deal()` discarding the revision is itself a
+            # leak — but the tree never does it; treat as linked-to-nothing
+            bound = set()
+        self._add(Obligation(
+            rule="KB123", kind="revision", line=call.lineno,
+            col=call.col_offset,
+            what=f"dealt revision {'/'.join(sorted(bound)) or '(unbound)'}"
+                 f" ({name}())",
+            start_nodes=self._start_after(st),
+            aliases=_alias_closure(self.fn, bound) if bound else set(),
+            release_terminals=_NOTIFY_TERMINALS, linked=bool(bound)))
+
+    def _match_kb124(self, st: ast.stmt, call: ast.Call, name: str,
+                     term: str) -> None:
+        handoff = False
+        if term == "acquire":
+            recv = name[: -len(term) - 1] if name.endswith(".acquire") else ""
+            if not self.ctx.is_lockish(recv, self.cls):
+                return
+            release = frozenset({"release"})
+        elif term in _PROTOCOL_PAIRS:
+            recv = name[: -len(term) - 1] if "." in name else ""
+            rel, handoff = _PROTOCOL_PAIRS[term]
+            release = frozenset({rel})
+        else:
+            return
+        start = self._conditional_start(st, call)
+        if start is None:
+            self.stats["leak_skipped_conditional"] = self.stats.get(
+                "leak_skipped_conditional", 0) + 1
+            return
+        self._add(Obligation(
+            rule="KB124", kind="lock" if term == "acquire" else "slot",
+            line=call.lineno, col=call.col_offset,
+            what=f"{name}()", start_nodes=start, aliases=set(), recv=recv,
+            release_terminals=release, handoff_append=handoff,
+            exception_only=True, linked=False))
+
+    def _conditional_start(self, st: ast.stmt,
+                           call: ast.Call) -> list[Node] | None:
+        """Where a maybe-failing acquire's obligation begins. Handles the
+        guard idioms; anything gnarlier is skipped and counted."""
+        if isinstance(st, ast.If):
+            test = st.test
+            if (isinstance(test, ast.UnaryOp)
+                    and isinstance(test.op, ast.Not) and test.operand is call):
+                return self._guard_start(st, call, positive_in_body=False)
+            if test is call:
+                return self._guard_start(st, call, positive_in_body=True)
+            return None  # acquire buried in a compound condition
+        if isinstance(st, (ast.While,)):
+            return None
+        return self._start_after(st)
+
+    def _match_kb125(self, st: ast.stmt, call: ast.Call, name: str,
+                     term: str) -> None:
+        for acq_terms, rel_terms, kind, recv_req in _REG_PAIRS:
+            if term not in acq_terms:
+                continue
+            recv = name[: -len(term) - 1] if "." in name else ""
+            if recv_req is not None and recv_req not in recv.lower():
+                return
+            bound = self._bound_names(st, call)
+            if not bound and self.cls is not None and (
+                    rel_terms
+                    & self.ctx.class_call_terminals.get(self.cls, set())):
+                # handle-less registration in a class that owns a matching
+                # deregistration path: instance-lifecycle transfer
+                self.stats["kb125_class_transfers"] = self.stats.get(
+                    "kb125_class_transfers", 0) + 1
+                return
+            self._add(Obligation(
+                rule="KB125", kind=kind, line=call.lineno,
+                col=call.col_offset, what=f"{name}()",
+                start_nodes=self._start_after(st),
+                aliases=_alias_closure(self.fn, bound) if bound else set(),
+                release_terminals=rel_terms, exception_only=True,
+                linked=bool(bound)))
+            return
+        # trace spans constructed directly (the Tracer.span CM is the
+        # sanctioned shape and discharges in its finally)
+        if term == "Span" and not name[:1].islower():
+            bound = self._bound_names(st, call)
+            if not bound:
+                return
+            self._add(Obligation(
+                rule="KB125", kind="span", line=call.lineno,
+                col=call.col_offset, what=f"span {'/'.join(sorted(bound))}",
+                start_nodes=self._start_after(st),
+                aliases=_alias_closure(self.fn, bound),
+                release_terminals=frozenset({"finish"}),
+                exception_only=True, linked=True))
+
+    def _match_kb126(self, st: ast.stmt, call: ast.Call, name: str,
+                     term: str) -> None:
+        if name not in _HANDLE_CTORS:
+            return
+        bound = self._bound_names(st, call)
+        if not bound:
+            # direct self-store (`self._ch = grpc.insecure_channel(t)`) is
+            # an ownership transfer to the instance; chained immediate use
+            # without binding is not trackable — skip, don't guess
+            return
+        self._add(Obligation(
+            rule="KB126", kind="handle", line=call.lineno,
+            col=call.col_offset,
+            what=f"{name}() handle {'/'.join(sorted(bound))}",
+            start_nodes=self._start_after(st),
+            aliases=_alias_closure(self.fn, bound),
+            release_terminals=_CLOSE_TERMINALS, linked=True))
+
+    # -- discharge classification -----------------------------------------
+    def _discharges(self, ob: Obligation, node: Node) -> tuple[bool, bool]:
+        """(discharges, used_unresolved_transfer) for one CFG node."""
+        st = node.stmt
+        if st is None:
+            return False, False
+        for expr in _stmt_exprs(st):
+            for sub in ast.walk(expr):
+                if not isinstance(sub, ast.Call):
+                    continue
+                got = self._call_discharges(ob, st, sub)
+                if got[0]:
+                    return got
+        # guard-correlated release: `if fh is not None: fh.close()` — the
+        # test re-checks exactly the condition under which the resource was
+        # acquired, so both arms are accounted for (None arm has nothing to
+        # release). Without this, path-insensitivity walks the skip arm
+        # with the obligation still live.
+        if (ob.linked and ob.aliases and isinstance(st, ast.If)
+                and _names_in(st.test) & ob.aliases):
+            for sub in ast.walk(st):
+                if (isinstance(sub, ast.Call)
+                        and terminal_name(sub.func)
+                        in ob.release_terminals):
+                    args: set[str] = set()
+                    for a in (*sub.args, *(kw.value for kw in sub.keywords)):
+                        args |= _names_in(a)
+                    root = (_root_name(sub.func)
+                            if isinstance(sub.func, ast.Attribute) else None)
+                    if root in ob.aliases or args & ob.aliases:
+                        return True, False
+        # return <alias> / self.x = <alias>: ownership transfer
+        if ob.linked and ob.aliases:
+            if (isinstance(st, ast.Return)
+                    and _names_in(st.value) & ob.aliases):
+                return True, False
+            if isinstance(st, ast.Assign):
+                if _names_in(st.value) & ob.aliases:
+                    for tgt in st.targets:
+                        root = (_root_name(tgt)
+                                if isinstance(tgt, (ast.Attribute,
+                                                    ast.Subscript))
+                                else None)
+                        if root in ("self", "cls"):
+                            return True, False
+        return False, False
+
+    def _call_discharges(self, ob: Obligation, st: ast.stmt,
+                         call: ast.Call) -> tuple[bool, bool]:
+        name = dotted_name(call.func)
+        term = terminal_name(call.func)
+        arg_names: set[str] = set()
+        for a in (*call.args, *(kw.value for kw in call.keywords)):
+            arg_names |= _names_in(a)
+        recv = name[: -len(term) - 1] if (name and "." in name) else ""
+        # the designated release call
+        if term in ob.release_terminals:
+            if not ob.linked:
+                # lock/slot/gauge protocols: match by receiver when one is
+                # known ('self._mlock.release()' does not release _cv)
+                if ob.recv and recv and recv != ob.recv:
+                    return False, False
+                return True, False
+            if arg_names & ob.aliases or _root_name(call.func) and (
+                    {_root_name(call.func)} & ob.aliases):
+                return True, False
+            if ob.rule == "KB123" and not ob.aliases:
+                return True, False
+        # KB124 handoff: queueing work into a self-container transfers the
+        # slot to whoever drains the queue
+        if (ob.handoff_append and term == "append"
+                and isinstance(call.func, ast.Attribute)
+                and _root_name(call.func) in ("self", "cls")):
+            return True, False
+        # ownership transfer by argument-passing
+        if ob.linked and ob.aliases and arg_names & ob.aliases:
+            if ob.rule == "KB126":
+                # handles: any consumer owns the close (Popen(stderr=fh),
+                # contextlib.closing(ch), Stub(channel))
+                res, unres = self._line_resolution(call.lineno)
+                if unres and not res:
+                    self.stats["leak_unresolved_transfers"] = (
+                        self.stats.get("leak_unresolved_transfers", 0) + 1)
+                return True, False
+            if ob.rule == "KB123":
+                if term[:1].isupper():
+                    # constructors (WatchEvent(revision=rev, ...)) record
+                    # the revision; they never deliver it to the sequencer
+                    return False, False
+                targets = self.ctx.resolved_targets(self.qn, call.lineno)
+                if targets and any(t in self.notify_reach for t in targets):
+                    self.stats["leak_resolved_transfers"] = (
+                        self.stats.get("leak_resolved_transfers", 0) + 1)
+                    return True, False
+                if not targets:
+                    res, unres = self._line_resolution(call.lineno)
+                    if unres:
+                        # a call the resolver cannot see takes the dealt
+                        # revision: optimistic transfer, counted blindness
+                        self.stats["leak_unresolved_transfers"] = (
+                            self.stats.get("leak_unresolved_transfers", 0)
+                            + 1)
+                        return True, False
+            if ob.rule == "KB125" and ob.kind == "watcher":
+                # wid handed to another component (reply message, registry)
+                return True, False
+        return False, False
+
+    def _line_resolution(self, line: int) -> tuple[bool, bool]:
+        return self.ctx.resolution(self.qn).get(line, (False, False))
+
+    # -- must-reach -------------------------------------------------------
+    def check(self) -> Iterable[Finding]:
+        for ob in self.obligations:
+            leak = self._must_reach(ob)
+            if leak is not None:
+                yield self._render(ob, leak)
+
+    def _must_reach(self, ob: Obligation
+                    ) -> tuple[list[Node], bool] | None:
+        """BFS from the obligation's start nodes, stopping at discharges;
+        returns (witness path, via_exception) for the first escaping path,
+        or None when every path discharges."""
+        cfg = self._ensure_cfg()
+        assert cfg is not None
+        seen: set[tuple[int, bool]] = set()
+        queue: list[tuple[Node, bool, tuple[Node, ...]]] = []
+        for start in ob.start_nodes:
+            queue.append((start, False, (start,)))
+        while queue:
+            node, saw_exc, path = queue.pop(0)
+            key = (id(node), saw_exc)
+            if key in seen:
+                continue
+            seen.add(key)
+            if node is cfg.exit or node is cfg.raise_exit:
+                escaped_exc = saw_exc or node is cfg.raise_exit
+                if ob.exception_only and not escaped_exc:
+                    continue  # normal-path handoff is the protocol
+                return list(path), escaped_exc
+            discharged, _ = self._discharges(ob, node)
+            if discharged:
+                continue
+            for nxt, kind in node.succ:
+                queue.append((nxt, saw_exc or kind == "exc",
+                              path + (nxt,)))
+        return None
+
+    def _render(self, ob: Obligation,
+                leak: tuple[list[Node], bool]) -> Finding:
+        path, via_exc = leak
+        hops: list[str] = []
+        last_line = None
+        for n in path:
+            if n.line != last_line and n.label not in ("except-dispatch",):
+                hops.append(f"{n.label} at line {n.line}")
+                last_line = n.line
+        shown = hops if len(hops) <= 5 else hops[:3] + ["..."] + hops[-1:]
+        how = "an exception edge" if via_exc else "a normal path"
+        rel = "/".join(sorted(ob.release_terminals)) or "release"
+        return Finding(
+            self.ctx.relpath, ob.line, ob.col, ob.rule,
+            f"{ob.what} acquired in {self.qn.rsplit('::', 1)[-1]} can "
+            f"escape via {how} without reaching {rel} (witness: "
+            + " -> ".join(shown) + ")")
+
+
+# ------------------------------------------------------------------ driver
+
+
+def _functions_with_context(tree: ast.Module, module: str
+                            ) -> list[tuple[ast.AST, str, str | None]]:
+    """(fn node, qualname, class) for module-level functions and methods —
+    the same qualname spelling the extractor uses, so graph lookups line
+    up. Nested defs are analyzed under their host's <locals> spelling."""
+    out: list[tuple[ast.AST, str, str | None]] = []
+
+    def visit(body: list[ast.stmt], cls: str | None, prefix: str) -> None:
+        for node in body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qn = (f"{module}::{cls}.{node.name}" if cls
+                      else f"{module}::{prefix}{node.name}")
+                out.append((node, qn, cls))
+                nested_prefix = (f"{cls}.{node.name}.<locals>." if cls
+                                 else f"{prefix}{node.name}.<locals>.")
+                for sub in ast.walk(node):
+                    if (isinstance(sub, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef))
+                            and sub is not node):
+                        out.append((sub,
+                                    f"{module}::{nested_prefix}{sub.name}",
+                                    cls))
+            elif isinstance(node, ast.ClassDef) and cls is None:
+                visit(node.body, node.name, "")
+            elif isinstance(node, (ast.If, ast.Try)):
+                for sub_body in ([node.body]
+                                 + [h.body for h in getattr(node, "handlers",
+                                                            [])]
+                                 + [getattr(node, "orelse", [])]
+                                 + [getattr(node, "finalbody", [])]):
+                    visit(sub_body, cls, prefix)
+
+    visit(tree.body, None, "")
+    return out
+
+
+#: quick textual triggers: files with none of these cannot host an acquire
+_TRIGGERS = (".deal", ".acquire(", "_acquire_slot", "add_watcher",
+             "register_gauge_fn", "insecure_channel", "secure_channel",
+             "socket.socket", "= open(", "Span(", ".arm(")
+
+
+def analyze_leaks(graph: ProjectGraph, sources: dict[str, str]
+                  ) -> tuple[list[Finding], dict[str, int], dict[str, Any]]:
+    """Run KB123–KB126 over ``sources`` ({relpath: src}, the deep-tier
+    file set). Findings are scoped to kubebrain_tpu/ like the other deep
+    rules. Returns (findings, stats, static leak report)."""
+    stats: dict[str, int] = {}
+    findings: list[Finding] = []
+    sites: list[dict[str, Any]] = []
+    reach = _notify_reach(graph)
+    for relpath in sorted(sources):
+        rp = relpath.replace("\\", "/")
+        if not rp.startswith("kubebrain_tpu/"):
+            continue
+        src = sources[relpath]
+        if not any(t in src for t in _TRIGGERS):
+            continue
+        try:
+            tree = ast.parse(src)
+        except SyntaxError:
+            continue
+        ctx = _FileContext(rp, tree, graph)
+        for fn, qn, cls in _functions_with_context(tree, ctx.module):
+            fl = _FuncLeaks(fn, qn, cls, ctx, reach, stats)
+            fl.find_obligations()
+            if not fl.obligations:
+                continue
+            fn_findings = list(fl.check())
+            findings.extend(fn_findings)
+            flagged = {(f.line, f.rule_id) for f in fn_findings}
+            for ob in fl.obligations:
+                sites.append({
+                    "rule": ob.rule, "kind": ob.kind,
+                    "path": rp, "line": ob.line,
+                    "what": ob.what,
+                    "leaks": (ob.line, ob.rule) in flagged,
+                })
+    report: dict[str, Any] = {
+        "sites": sites,
+        "site_count": len(sites),
+        "by_kind": {},
+    }
+    for s in sites:
+        k = report["by_kind"].setdefault(
+            s["kind"], {"sites": 0, "leaking": 0})
+        k["sites"] += 1
+        k["leaking"] += 1 if s["leaks"] else 0
+    return findings, stats, report
+
+
+def leak_report(static_report: dict[str, Any],
+                runtime_obs: list[dict] | None) -> dict[str, Any]:
+    """The static↔runtime coverage cross-check (mirrors the KB115 and
+    fieldcheck reports): which obligation kinds the static tier tracks,
+    which the runtime sanitizer actually exercised, and whether the
+    runtime balance closed."""
+    out = dict(static_report)
+    if runtime_obs is None:
+        return out
+    observed = {o["kind"]: o for o in runtime_obs if "kind" in o}
+    static_kinds = set(out.get("by_kind", {}))
+    runtime_kinds = set(observed)
+    unbalanced = sorted(
+        k for k, o in observed.items()
+        if o.get("outstanding", 0) or o.get("violations", 0))
+    matched = static_kinds & runtime_kinds
+    out.update({
+        "observed_kinds": {k: {kk: vv for kk, vv in o.items()
+                               if kk != "kind"}
+                           for k, o in sorted(observed.items())},
+        "static_only_kinds": sorted(static_kinds - runtime_kinds),
+        "runtime_only_kinds": sorted(runtime_kinds - static_kinds),
+        "unbalanced_kinds": unbalanced,
+        "coverage": (len(matched) / len(static_kinds)
+                     if static_kinds else 1.0),
+    })
+    return out

@@ -49,6 +49,13 @@ DEEP_RULES = {
              "inconsistency)",
     "KB122": "lexical check-then-act: guarded read whose dependent write "
              "re-acquires the lock (released across the decision)",
+    "KB123": "dealt revision can escape without reaching the sequencer "
+             "(_notify/_notify_many) on some path",
+    "KB124": "manually acquired lock/slot not released on an exception edge",
+    "KB125": "registration (watcher/gauge/span/fault-plane) leaked on an "
+             "exception edge without the matching deregistration",
+    "KB126": "stream/channel/handle not closed on all paths and not "
+             "provably ownership-transferred",
 }
 
 #: sync op kinds that are a host sync in ANY traced context, regardless of
@@ -71,6 +78,7 @@ class DeepResult:
     stats: dict[str, Any]
     lock_graph: dict[str, Any]
     field_guards: dict[str, Any] = dataclasses.field(default_factory=dict)
+    leaks: dict[str, Any] = dataclasses.field(default_factory=dict)
 
 
 def _fn_label(qn: str) -> str:
@@ -785,6 +793,19 @@ def _check_then_act(graph: ProjectGraph,
     stale by the time the write lands. Shared = some other function also
     writes the field, or this function itself thread-escapes (two threads
     run the same check concurrently)."""
+    # claim-flag index for the claimed_across exemption: function -> every
+    # (field, lock, acq_line) it writes under a lock. A ticketed
+    # singleflight claims a COMPANION flag inside the read's hold
+    # (`self._fl_inflight = True`) and resets it inside the write's hold —
+    # that bracket makes this function the sole owner of the released
+    # window, so the re-acquiring write cannot act on a stale read.
+    fn_writes: dict[str, set[tuple[str, str, int]]] = {}
+    for key2, sites2 in table.items():
+        for s in sites2:
+            if s.acc.kind in _WRITE_KINDS:
+                for l2, a2 in zip(s.acc.under_locks, s.acc.acq_lines):
+                    fn_writes.setdefault(s.fs.qualname, set()).add(
+                        (key2, l2, a2))
     for key in sorted(table):
         if key in immutable or _LOCK_NAME_RE.search(key):
             continue
@@ -860,6 +881,18 @@ def _check_then_act(graph: ProjectGraph,
                                                     r2.acc.acq_lines))
                             for r2 in fn_sites)
                         if revalidated:
+                            continue
+                        # a companion field written under BOTH the read's
+                        # acquisition and the write's re-acquisition is the
+                        # claim/reset bracket of a ticketed singleflight:
+                        # only the claimant reaches this write, so the
+                        # released window is exclusively owned
+                        wset = fn_writes.get(qn, set())
+                        claimed_across = any(
+                            k2 != key and (k2, lock, r_acq) in wset
+                            and any((k2, lock, wa) in wset for wa in w_acqs)
+                            for (k2, _l, _a) in wset)
+                        if claimed_across:
                             continue
                         if (qn, lock) in done:
                             continue
@@ -1105,8 +1138,12 @@ def _kb115(graph: ProjectGraph,
 
 def analyze(graph: ProjectGraph,
             runtime_lock_edges: list[tuple[str, str]] | None = None,
-            runtime_field_obs: list[dict] | None = None) -> DeepResult:
-    """Run all context propagations and the KB112–KB122 rules."""
+            runtime_field_obs: list[dict] | None = None,
+            sources: dict[str, str] | None = None,
+            runtime_leak_obs: list[dict] | None = None) -> DeepResult:
+    """Run all context propagations and the KB112–KB126 rules. The CFG
+    tier (KB123–KB126) needs raw sources to lower — when ``sources`` is
+    None those rules are skipped (summary-only replay has no ASTs)."""
     blocking = _blocking_witness(graph)
     traced = _traced_set(graph)
     taint = _TaintSolver(graph)
@@ -1129,6 +1166,14 @@ def analyze(graph: ProjectGraph,
     findings.extend(_check_then_act(graph, escaped, table, pub, immutable))
     field_guards = _field_guard_report(graph, table, pub, immutable,
                                       escaped, runtime_field_obs)
+
+    leak_stats: dict[str, int] = {}
+    leaks: dict[str, Any] = {}
+    if sources is not None:
+        from .cfg import analyze_leaks, leak_report
+        kb_leaks, leak_stats, static_leaks = analyze_leaks(graph, sources)
+        findings.extend(kb_leaks)
+        leaks = leak_report(static_leaks, runtime_leak_obs)
 
     # suppression pragmas (flagged line or the comment line above it)
     by_rel = {ms.relpath: ms for ms in graph.modules.values()}
@@ -1156,8 +1201,9 @@ def analyze(graph: ProjectGraph,
         "publish_immutable_fields": len(immutable),
         "field_access_sites": sum(len(v) for v in table.values()),
     })
+    stats.update(leak_stats)
     return DeepResult(findings=kept, stats=stats, lock_graph=lock_graph,
-                      field_guards=field_guards)
+                      field_guards=field_guards, leaks=leaks)
 
 
 def _async_reachable(graph: ProjectGraph) -> set[str]:

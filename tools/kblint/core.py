@@ -267,9 +267,10 @@ DEEP_ROOTS = ["kubebrain_tpu", "tools", "bench.py"]
 
 def deep_analyze_sources(sources: dict[str, str],
                          runtime_lock_edges: list | None = None,
-                         runtime_field_obs: list | None = None) -> Any:
+                         runtime_field_obs: list | None = None,
+                         runtime_leak_obs: list | None = None) -> Any:
     """Deep tier over in-memory {relpath: source} (the self-test entry):
-    build summaries, stitch the graph, propagate, run KB112–KB122."""
+    build summaries, stitch the graph, propagate, run KB112–KB126."""
     from .contexts import analyze
     from .graph import ProjectGraph, extract_module
     summaries = [extract_module(src, rp) for rp, src in sorted(sources.items())]
@@ -280,19 +281,24 @@ def deep_analyze_sources(sources: dict[str, str],
     edges = ([tuple(e) for e in runtime_lock_edges]
              if runtime_lock_edges is not None else None)
     return analyze(graph, runtime_lock_edges=edges,
-                   runtime_field_obs=runtime_field_obs)
+                   runtime_field_obs=runtime_field_obs,
+                   sources=dict(sources), runtime_leak_obs=runtime_leak_obs)
 
 
 def deep_analyze_paths(root: str, roots: list[str] | None = None,
                        cache: "Any | None" = None,
                        runtime_lock_edges: list | None = None,
-                       runtime_field_obs: list | None = None) -> Any:
+                       runtime_field_obs: list | None = None,
+                       runtime_leak_obs: list | None = None) -> Any:
     """Deep tier over the repo tree. Per-file extraction rides the same
-    content-hash cache as the syntactic tier (entry key "summary")."""
+    content-hash cache as the syntactic tier (entry key "summary"). The
+    sources read here are handed on to the CFG tier, which re-lowers the
+    few files hosting acquire sites (cheap next to extraction)."""
     from .contexts import analyze
     from .graph import ModuleSummary, ProjectGraph, extract_module
     t0 = time.monotonic()
     summaries: list[ModuleSummary] = []
+    sources: dict[str, str] = {}
     parsed = from_cache = 0
     for ap in iter_py_files(roots or DEEP_ROOTS, root):
         relpath = os.path.relpath(ap, root).replace("\\", "/")
@@ -301,6 +307,7 @@ def deep_analyze_paths(root: str, roots: list[str] | None = None,
                 src = f.read()
         except (OSError, UnicodeDecodeError):
             continue
+        sources[relpath] = src
         entry = cache.get(relpath, src) if cache is not None else None
         if entry is not None and "summary" in entry:
             summaries.append(ModuleSummary.from_dict(entry["summary"]))
@@ -323,7 +330,8 @@ def deep_analyze_paths(root: str, roots: list[str] | None = None,
     edges = ([tuple(e) for e in runtime_lock_edges]
              if runtime_lock_edges is not None else None)
     result = analyze(graph, runtime_lock_edges=edges,
-                     runtime_field_obs=runtime_field_obs)
+                     runtime_field_obs=runtime_field_obs,
+                     sources=sources, runtime_leak_obs=runtime_leak_obs)
     result.stats["files_parsed"] = parsed
     result.stats["files_from_cache"] = from_cache
     result.stats["elapsed_seconds"] = round(time.monotonic() - t0, 3)

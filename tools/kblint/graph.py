@@ -168,6 +168,12 @@ class FunctionSummary:
     # returned, stored, or a bound method handed out as a reference) — the
     # publish point the ownership phase keys __init__ immutability on
     self_escape_lines: list[int] = dataclasses.field(default_factory=list)
+    # local receiver types the resolver can use: var -> "ClassName" (direct
+    # construction) or "self.attr[]" (element pulled out of a typed
+    # container field — `lq = self._queues[lane]`, `for lq in
+    # self._queues.values()`). Flow-insensitive last-writer-wins is fine
+    # here: a variable rebound across types just fails class lookup.
+    local_types: dict[str, str] = dataclasses.field(default_factory=dict)
 
 
 @dataclasses.dataclass
@@ -213,7 +219,8 @@ class ModuleSummary:
                 params=fd["params"],
                 attr_accesses=[AttrAccess(**a)
                                for a in fd.get("attr_accesses", [])],
-                self_escape_lines=fd.get("self_escape_lines", []))
+                self_escape_lines=fd.get("self_escape_lines", []),
+                local_types=fd.get("local_types", {}))
             ms.functions[qn] = fs
         return ms
 
@@ -298,6 +305,42 @@ def _lock_expr_id(expr: ast.expr, module: str, cls: str | None,
     return None
 
 
+def _ctor_of(expr: ast.expr) -> str | None:
+    """Dotted constructor name if ``expr`` is ``SomeClass(...)``."""
+    if not isinstance(expr, ast.Call):
+        return None
+    name = dotted_name(expr.func)
+    if name and name.split(".")[-1].lstrip("_")[:1].isupper():
+        return name
+    return None
+
+
+def _attr_type_lookup(cinfo: dict, key: str) -> str | None:
+    """attr_types lookup with ``attr[]`` keys routed to the container
+    element-type table (attr_elem_types)."""
+    if key.endswith("[]"):
+        return cinfo.get("attr_elem_types", {}).get(key[:-2])
+    return cinfo["attr_types"].get(key)
+
+
+def _elem_ctor(value: ast.expr) -> str | None:
+    """Homogeneous element-constructor type of a container expression:
+    ``{k: C() for ...}`` / ``[C() for ...]`` / ``{k1: C(), k2: C()}`` /
+    ``[C(), C()]`` all yield ``C``. Mixed or empty containers yield None —
+    the element type must be total to be trusted."""
+    if isinstance(value, ast.DictComp):
+        return _ctor_of(value.value)
+    if isinstance(value, (ast.ListComp, ast.SetComp)):
+        return _ctor_of(value.elt)
+    if isinstance(value, ast.Dict) and value.values:
+        ctors = {_ctor_of(v) for v in value.values}
+        return ctors.pop() if len(ctors) == 1 else None
+    if isinstance(value, (ast.List, ast.Set)) and value.elts:
+        ctors = {_ctor_of(e) for e in value.elts}
+        return ctors.pop() if len(ctors) == 1 else None
+    return None
+
+
 class _Extractor(ast.NodeVisitor):
     """One pass over a module AST building the ModuleSummary."""
 
@@ -341,6 +384,7 @@ class _Extractor(ast.NodeVisitor):
                 bases = [dotted_name(b) for b in node.bases if dotted_name(b)]
                 info: dict[str, Any] = {"bases": bases, "methods": {},
                                         "attr_types": {}, "line": node.lineno,
+                                        "attr_elem_types": {},
                                         "lock_attrs": [], "lock_aliases": {}}
                 self.ms.classes[node.name] = info
                 self._prescan_locks(node, info)
@@ -404,8 +448,21 @@ class _Extractor(ast.NodeVisitor):
                         and rname.split(".")[-1].lstrip("_")[:1].isupper()):
                     ret_types[sub.name] = rname
         for node in ast.walk(cnode):
-            if not (isinstance(node, ast.Assign)
-                    and isinstance(node.value, ast.Call)):
+            if not isinstance(node, ast.Assign):
+                continue
+            # container-of-project-objects fields: every element the same
+            # constructor makes the field's ELEMENT type known, so
+            # `self._queues[lane].pop()` resolves through the subscript
+            # (self.x = {k: C() for ...} / [C() for ...] / literal forms)
+            elem = _elem_ctor(node.value)
+            if elem is not None:
+                for tgt in node.targets:
+                    if (isinstance(tgt, ast.Attribute)
+                            and isinstance(tgt.value, ast.Name)
+                            and tgt.value.id == "self"):
+                        info["attr_elem_types"].setdefault(tgt.attr, elem)
+                continue
+            if not isinstance(node.value, ast.Call):
                 continue
             ctor = dotted_name(node.value.func)
             if not ctor:
@@ -478,6 +535,7 @@ class _Extractor(ast.NodeVisitor):
                         lock_id = f"{self.ms.module}::{cls}.{tgt.attr}"
                         self.ms.lock_sites[lock_id] = [self.ms.relpath,
                                                        sub.lineno]
+        self._infer_local_types(node, fs)
         self._extract_body(node.body, fs, cls, locks=[])
         if cls is not None:
             self._compute_self_escapes(node, fs)
@@ -486,6 +544,53 @@ class _Extractor(ast.NodeVisitor):
         for sub in node.body:
             self._extract_nested(sub, cls, f"{prefix}{node.name}.<locals>."
                                  if not cls else f"{cls}.{node.name}.<locals>.")
+
+    def _infer_local_types(self, node: ast.FunctionDef | ast.AsyncFunctionDef,
+                           fs: FunctionSummary) -> None:
+        """Pre-pass filling ``fs.local_types`` before call extraction, so a
+        call through a typed local (``lq = self._queues[lane]; lq.push(r)``)
+        resolves regardless of statement order. Nested defs are their own
+        scopes and are skipped."""
+        def self_attr(expr: ast.expr) -> str | None:
+            if (isinstance(expr, ast.Attribute)
+                    and isinstance(expr.value, ast.Name)
+                    and expr.value.id == "self"):
+                return expr.attr
+            return None
+
+        def visit(body: list[ast.stmt]) -> None:
+            for stmt in body:
+                if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                     ast.ClassDef)):
+                    continue
+                if (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1
+                        and isinstance(stmt.targets[0], ast.Name)):
+                    tgt = stmt.targets[0].id
+                    ctor = _ctor_of(stmt.value)
+                    if ctor is not None:
+                        fs.local_types[tgt] = ctor
+                    elif isinstance(stmt.value, ast.Subscript):
+                        attr = self_attr(stmt.value.value)
+                        if attr is not None:
+                            fs.local_types[tgt] = f"self.{attr}[]"
+                elif (isinstance(stmt, (ast.For, ast.AsyncFor))
+                      and isinstance(stmt.target, ast.Name)):
+                    it = stmt.iter
+                    attr = self_attr(it)
+                    if (attr is None and isinstance(it, ast.Call)
+                            and isinstance(it.func, ast.Attribute)
+                            and it.func.attr == "values" and not it.args):
+                        attr = self_attr(it.func.value)
+                    if attr is not None:
+                        fs.local_types[stmt.target.id] = f"self.{attr}[]"
+                for field in ("body", "orelse", "finalbody"):
+                    sub = getattr(stmt, field, None)
+                    if sub:
+                        visit(sub)
+                for h in getattr(stmt, "handlers", []):
+                    visit(h.body)
+
+        visit(node.body)
 
     def _compute_self_escapes(self, node: ast.FunctionDef
                               | ast.AsyncFunctionDef,
@@ -688,8 +793,17 @@ class _Extractor(ast.NodeVisitor):
                 continue
             name = dotted_name(node.func)
             if isinstance(node.func, ast.Attribute) and not name:
-                # chained receiver we cannot express as a dotted name
-                name = f"?.{node.func.attr}"
+                recv = node.func.value
+                if (isinstance(recv, ast.Subscript)
+                        and isinstance(recv.value, ast.Attribute)
+                        and isinstance(recv.value.value, ast.Name)
+                        and recv.value.value.id == "self"):
+                    # self.attr[key].meth(...): resolvable when the field's
+                    # element type is known (attr_elem_types)
+                    name = f"self.{recv.value.attr}[].{node.func.attr}"
+                else:
+                    # chained receiver we cannot express as a dotted name
+                    name = f"?.{node.func.attr}"
             if not name:
                 continue
             arg_atoms = {}
@@ -935,6 +1049,23 @@ class ProjectGraph:
         if head == "self" and cls is not None:
             return self._resolve_self_chain(ms, cls, parts[1:])
 
+        # typed local receiver: `lq = self._queues[lane]; lq.push(r)` or
+        # `q = Worker(); q.start_all()` — the local binding shadows module
+        # scope, so this is checked before the module-name paths (falls
+        # back to the unique-method heuristic in _resolve_call on a miss)
+        if fs is not None and head in fs.local_types and len(parts) >= 2:
+            ltype = fs.local_types[head]
+            if ltype.startswith("self.") and cls is not None:
+                return self._resolve_self_chain(
+                    ms, cls, ltype[len("self."):].split(".") + parts[1:])
+            found = self._class_info(ms, ltype)
+            if found is not None and len(parts) == 2:
+                f_ms, f_info = found
+                for cname, ci in f_ms.classes.items():
+                    if ci is f_info:
+                        return self._method_on_class(f_ms, cname, parts[1])
+            return []
+
         # plain module-scope name (local aliases are covered by the
         # fn-ref CallSites the extractor records at the aliasing call)
         if len(parts) == 1:
@@ -1016,7 +1147,7 @@ class ProjectGraph:
         if len(rest) == 1:
             return self._method_on_class(ms, cls, rest[0])
         cinfo = ms.classes.get(cls)
-        cur = cinfo["attr_types"].get(rest[0]) if cinfo else None
+        cur = _attr_type_lookup(cinfo, rest[0]) if cinfo else None
         cur_ms = ms
         for hop in rest[1:-1]:
             if cur is None:
@@ -1025,7 +1156,7 @@ class ProjectGraph:
             if not found:
                 return []
             cur_ms, cinfo2 = found
-            cur = cinfo2["attr_types"].get(hop)
+            cur = _attr_type_lookup(cinfo2, hop)
         if cur is None:
             return []
         found = self._class_info(cur_ms, cur)

@@ -1,0 +1,96 @@
+"""SARIF 2.1.0 emission for GitHub code scanning.
+
+One run, one driver ("kblint"), one result per finding. Rule metadata is
+assembled from the syntactic registry plus the deep-tier catalogue so the
+code-scanning UI shows the invariant text, not just an opaque ID.
+Baselined findings are emitted with ``"baselineState": "unchanged"`` —
+they stay visible in the scan without failing it, matching the CLI's
+exit-code behavior.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Iterable
+
+from .core import Finding
+
+_SCHEMA = ("https://raw.githubusercontent.com/oasis-tcs/sarif-spec/master/"
+           "Schemata/sarif-schema-2.1.0.json")
+
+
+def _rule_catalogue() -> dict[str, str]:
+    from .core import RULES
+    from .contexts import DEEP_RULES
+    cat = {rid: rule.summary for rid, rule in RULES.items()}
+    cat.update(DEEP_RULES)
+    cat.setdefault("KB000", "file is unreadable or does not parse")
+    return cat
+
+
+def to_sarif(findings: Iterable[Finding],
+             baselined: Iterable[Finding] = ()) -> dict[str, Any]:
+    cat = _rule_catalogue()
+    used: dict[str, int] = {}
+    results: list[dict[str, Any]] = []
+
+    def emit(f: Finding, state: str | None) -> None:
+        idx = used.setdefault(f.rule_id, len(used))
+        res: dict[str, Any] = {
+            "ruleId": f.rule_id,
+            "ruleIndex": idx,
+            "level": "error",
+            "message": {"text": f.message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {
+                        "uri": f.path.replace("\\", "/"),
+                        "uriBaseId": "SRCROOT",
+                    },
+                    "region": {
+                        "startLine": max(f.line, 1),
+                        "startColumn": max(f.col + 1, 1),
+                    },
+                }
+            }],
+        }
+        if state is not None:
+            res["baselineState"] = state
+        results.append(res)
+
+    for f in findings:
+        emit(f, None)
+    for f in baselined:
+        emit(f, "unchanged")
+
+    rules = [
+        {
+            "id": rid,
+            "shortDescription": {"text": cat.get(rid, rid)},
+            "helpUri": "docs/static_analysis.md",
+        }
+        for rid, _ in sorted(used.items(), key=lambda kv: kv[1])
+    ]
+    return {
+        "$schema": _SCHEMA,
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {
+                "driver": {
+                    "name": "kblint",
+                    "informationUri":
+                        "https://github.com/kubewharf/kubebrain",
+                    "rules": rules,
+                }
+            },
+            "originalUriBaseIds": {"SRCROOT": {"uri": "file:///"}},
+            "results": results,
+        }],
+    }
+
+
+def write_sarif(path: str, findings: Iterable[Finding],
+                baselined: Iterable[Finding] = ()) -> None:
+    with open(path, "w", encoding="utf-8") as fh:
+        json.dump(to_sarif(findings, baselined), fh, indent=1)
+        fh.write("\n")

@@ -10,7 +10,7 @@ checker ran.
 
 The typed set: storage/, ops/, server/service (since PR 1), plus the
 strict-ish per-package ratchets in mypy.ini for sched/, lease/, replica/,
-faults/, and tools/kblint (disallow_incomplete_defs +
+faults/, workload/, trace/, and tools/kblint (disallow_incomplete_defs +
 no_implicit_optional).
 """
 
@@ -33,6 +33,8 @@ TYPED_PACKAGES = [
     "kubebrain_tpu/lease",
     "kubebrain_tpu/replica",
     "kubebrain_tpu/faults",
+    "kubebrain_tpu/workload",
+    "kubebrain_tpu/trace",
     "tools/kblint",
 ]
 
