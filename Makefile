@@ -4,8 +4,8 @@
 SHELL := /bin/bash  # test-tier1 needs pipefail
 
 .PHONY: all native test bench bench-all bench-smoke bench-cluster \
-        bench-multichip bench-write bench-compact run clean protos lint \
-        typecheck check test-tier1
+        bench-multichip bench-write bench-compact bench-fanout run clean \
+        protos lint typecheck check test-tier1
 
 all: native
 
@@ -73,6 +73,12 @@ bench-smoke:
 # docs/multichip.md), e.g.: make bench-cluster N=1000 STORAGE=tpu MESH_PART=8
 # SCENARIO=churn_heavy skews the trace to pod churn + a keepalive storm
 # (write-group commit exercised + asserted; docs/writes.md).
+# SCENARIO=watch_heavy skews to multi-controller fan-in (many watchers per
+# namespace prefix, thin writes) and spawns every server — leader and
+# followers — with the block-batched device fan-out matcher; with
+# REPLICAS=2 the whole watcher population rides the followers
+# (docs/watch.md). MESH_WAT=<n> additionally shards the watcher table
+# over n (simulated) devices, any scenario.
 # FAULTS=<preset> (smoke|storage|watch|merge|full) arms chaos mode
 # (docs/faults.md): churn_heavy replayed against a fault-injected server,
 # judged by the acknowledged-write consistency check; emits CHAOS_rNN.json.
@@ -95,6 +101,7 @@ FAULTS ?= none
 FAULT_SEED ?= 0
 COMPACT_S ?= 0
 REPLICAS ?= 0
+MESH_WAT ?= 0
 bench-cluster:
 	JAX_PLATFORMS=cpu KB_BENCH_METRIC=cluster KB_BENCH_NODES=$(N) \
 	    KB_WORKLOAD_STORAGE=$(STORAGE) KB_WORKLOAD_MESH_PART=$(MESH_PART) \
@@ -102,7 +109,16 @@ bench-cluster:
 	    KB_WORKLOAD_SCENARIO=$(SCENARIO) KB_WORKLOAD_FAULTS=$(FAULTS) \
 	    KB_WORKLOAD_FAULT_SEED=$(FAULT_SEED) \
 	    KB_WORKLOAD_COMPACT_S=$(COMPACT_S) \
-	    KB_WORKLOAD_REPLICAS=$(REPLICAS) python bench.py
+	    KB_WORKLOAD_REPLICAS=$(REPLICAS) \
+	    KB_WORKLOAD_MESH_WAT=$(MESH_WAT) python bench.py
+
+# Watch fan-out bench (docs/watch.md): block-batched device matching at
+# 10k+ watchers — watch_fanout_events_per_sec, delivery masks asserted
+# byte-identical to the host segment-index oracle, batched path >= 2x the
+# per-batch device path on CPU-sim (TPU bar pending_tpu off-TPU). Emits
+# the kubebrain-fanout/v1 report to KB_FANOUT_OUT (FANOUT_rNN.json).
+bench-fanout:
+	JAX_PLATFORMS=cpu KB_BENCH_METRIC=fanout python bench.py
 
 # Multichip sharded serving curve (docs/multichip.md): the scan workload
 # served through the scheduler at mesh sizes 1..8, byte-identical across

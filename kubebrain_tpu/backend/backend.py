@@ -80,6 +80,10 @@ class Backend:
         self.tso = TSO()
         self.watch_cache = Ring(self.config.watch_cache_capacity)
         self.watcher_hub = WatcherHub(fanout_matcher=self.config.fanout_matcher)
+        # block-batched fan-out (docs/watch.md): a matcher that matches a
+        # whole drain block in one device dispatch makes EVENT_BATCH
+        # chunking pure overhead — hand the hub the full contiguous block
+        self._hub_blocks = self.watcher_hub.prefers_blocks
         self.retry = AsyncFifoRetry(self._read_rev_record, self._retry_rewrite)
         scanner_kw = dict(
             get_compact_revision=lambda _snap: self._compact_revision_cached(),
@@ -1048,7 +1052,7 @@ class Backend:
                         self.retry.append(event)
                     elif event.valid:
                         batch.append(event)
-                    if len(batch) >= EVENT_BATCH:
+                    if len(batch) >= EVENT_BATCH and not self._hub_blocks:
                         self._flush(batch)
                         batch = []
                 self._flush(batch)

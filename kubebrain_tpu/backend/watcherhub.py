@@ -123,6 +123,11 @@ class WatcherHub:
         # Optional vectorized matcher:
         # (events, [(id, start, end, min_rev)]) -> bool[E][W]
         self._fanout_matcher = fanout_matcher
+        # Block protocol (kubebrain_tpu.fanout.DeviceFanout): the matcher
+        # demuxes on its own — deliver(batch, specs, version) -> {wid: evs}
+        # — so the hub never materializes the [E, W] mask at all
+        self._matcher_delivers = callable(getattr(fanout_matcher, "deliver",
+                                                  None))
         # watcher-set version: lets the matcher cache its packed table with
         # an O(1) check instead of an O(W) spec-tuple compare per batch
         self._version = 0
@@ -142,6 +147,14 @@ class WatcherHub:
                 )
             except (TypeError, ValueError):
                 pass
+
+    @property
+    def prefers_blocks(self) -> bool:
+        """True when the matcher wants WHOLE sequencer drain blocks: the
+        backend then skips the EVENT_BATCH chunking in ``_drain`` so one
+        contiguous revision block costs one device dispatch (docs/watch.md),
+        not ceil(block / EVENT_BATCH)."""
+        return bool(getattr(self._fanout_matcher, "prefers_blocks", False))
 
     def set_metrics(self, metrics) -> None:
         """Arm watch-path lag instrumentation: ``kb.watch.lag.seconds``
@@ -340,7 +353,13 @@ class WatcherHub:
             or (index is not None and index.dense)
             or (index is None and len(subs) * len(batch) >= 4096)
         )
-        if use_device:
+        if use_device and self._matcher_delivers:
+            # block protocol: sync + one dispatch + vectorized demux inside
+            # the matcher; the hub only routes the per-watcher lists
+            watcher_specs = [(wid, *filters[wid]) for wid, _ in subs]
+            per_watcher = self._fanout_matcher.deliver(
+                batch, watcher_specs, version=version)
+        elif use_device:
             import numpy as np
 
             watcher_specs = [(wid, *filters[wid]) for wid, _ in subs]

@@ -122,7 +122,22 @@ def build_parser() -> argparse.ArgumentParser:
                         "before the read refuses (never answers stale)")
     p.add_argument("--enable-storage-metrics", action="store_true")
     p.add_argument("--tpu-fanout", action="store_true",
-                   help="vectorized watch fan-out on the device mesh")
+                   help="vectorized watch fan-out on the device mesh "
+                        "(block-batched persistent-table matcher, "
+                        "docs/watch.md)")
+    p.add_argument("--mesh-wat", type=int, default=0,
+                   help="devices on the watch fan-out mesh's `wat` axis: "
+                        "the watcher table lives sharded across them and "
+                        "each shard matches + compacts locally "
+                        "(docs/watch.md). Composes with --mesh-part — the "
+                        "two axes may share chips. Requires --tpu-fanout; "
+                        "0 = single-device table")
+    p.add_argument("--fanout-impl", choices=("block", "legacy"),
+                   default="block",
+                   help="--tpu-fanout implementation: 'block' = persistent "
+                        "sharded watcher table, one dispatch per sequencer "
+                        "drain block; 'legacy' = per-batch mask matcher "
+                        "(kept for differential runs)")
     p.add_argument("--cert-file", default="")
     p.add_argument("--key-file", default="")
     p.add_argument("--ca-file", default="")
@@ -240,6 +255,10 @@ def validate_args(args) -> None:
         raise SystemExit(
             f"--scan-partitions {scan_parts} must be a multiple of "
             f"--mesh-part {mesh_part}")
+    if getattr(args, "mesh_wat", 0) < 0:
+        raise SystemExit("--mesh-wat must be >= 0")
+    if getattr(args, "mesh_wat", 0) and not getattr(args, "tpu_fanout", False):
+        raise SystemExit("--mesh-wat requires --tpu-fanout")
     if getattr(args, "sched_depth", 1) < 0 or getattr(args, "sched_queue_limit", 1) < 1:
         raise SystemExit("--sched-depth must be >= 0 (0 = auto) and "
                          "--sched-queue-limit must be >= 1")
@@ -404,9 +423,34 @@ def build_endpoint(args):
 
     fanout = None
     if args.tpu_fanout:
-        from .ops.fanout import FanoutMatcher
+        # the fan-out mesh is independent of the scan mesh: the watcher
+        # table is the large shardable side of the (E x W) product and
+        # followers build one too (follower offload — fan-out capacity
+        # scales with replicas, docs/watch.md)
+        wat_mesh = None
+        mesh_wat = getattr(args, "mesh_wat", 0)
+        if mesh_wat:
+            import jax
 
-        fanout = FanoutMatcher()
+            from .parallel.mesh import make_mesh
+
+            avail = len(jax.devices())
+            if mesh_wat > avail:
+                raise SystemExit(
+                    f"--mesh-wat {mesh_wat} exceeds the {avail} visible "
+                    f"device(s); set XLA_FLAGS=--xla_force_host_platform_"
+                    f"device_count for CPU simulation")
+            wat_mesh = make_mesh(n_devices=mesh_wat, axes=("wat",))
+        if getattr(args, "fanout_impl", "block") == "legacy":
+            from .ops.fanout import FanoutMatcher
+
+            fanout = FanoutMatcher(mesh=wat_mesh)
+        else:
+            from .fanout import DeviceFanout
+
+            fanout = DeviceFanout(mesh=wat_mesh)
+        # kb_fanout_sharded: 1 when the table is really distributed
+        fanout.set_metrics(metrics)
 
     backend = Backend(store, BackendConfig(
         prefix=args.prefix.encode(),

@@ -1,0 +1,19 @@
+"""kubebrain_tpu.fanout — production-scale watch fan-out (docs/watch.md).
+
+The layer between the sequencer and the wire: a persistent device-resident
+watcher-spec table (:class:`WatcherTable`), the single dispatch funnel
+(:func:`fanout_dispatch`, kblint KB127), and the hub-facing matcher
+(:class:`DeviceFanout`) with its byte-identical host oracle
+(:func:`match_oracle`).
+
+One device dispatch matches a whole sequencer drain block (the contiguous
+revision block group commit hands ``Backend._notify_many``) against the
+entire watcher population, sharded over the ``wat`` mesh axis, and returns
+delivery work sized O(matched pairs) — never the [E, W] mask.
+"""
+
+from .dispatch import fanout_dispatch
+from .matcher import DeviceFanout, match_oracle
+from .table import WatcherTable
+
+__all__ = ["DeviceFanout", "WatcherTable", "fanout_dispatch", "match_oracle"]

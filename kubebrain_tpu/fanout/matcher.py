@@ -1,0 +1,196 @@
+"""Hub-facing device matcher for block-batched watch fan-out (docs/watch.md).
+
+:class:`DeviceFanout` is what the CLI hands the WatcherHub when
+``--tpu-fanout`` is armed. It exposes two protocols:
+
+- ``deliver(batch, specs, version)`` — the block path: one device dispatch
+  for the WHOLE drain block against the persistent sharded
+  :class:`~kubebrain_tpu.fanout.table.WatcherTable`, then one vectorized
+  demux of the compacted (watcher, event) pairs into per-subscriber event
+  lists. The hub prefers this when present (``prefers_blocks``).
+- ``__call__(events, specs, version)`` — the legacy mask protocol
+  (bool[E, W] in spec order), kept so the hub's per-batch fallback and the
+  differential tests run the same machinery.
+
+Dispatch sizing: the per-shard index capacity is a persistent pow2 bucket.
+When the counts transfer shows a shard overflowed it, the matcher doubles
+the bucket and re-dispatches — so the steady state is ONE launch per drain
+and the compile cache holds a handful of sizes, never one per depth.
+
+:func:`match_oracle` is the brute-force host oracle the tests hold every
+path byte-identical to (raw-bytes etcd range semantics — no packing, no
+canonicalization: the packed compare must agree with it by construction).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..ops import keys as keyops
+from ..trace import TRACER
+from .table import WatcherTable, pow2_at_least
+
+#: smallest per-shard compacted-index transfer (pow2; grows on overflow)
+MIN_IDX_SIZE = 128
+
+#: smallest E bucket, matching the legacy matcher (drain depths 1..8 share
+#: one compiled shape)
+MIN_EVENT_BUCKET = 8
+
+
+def match_oracle(events, specs) -> np.ndarray:
+    """bool[E, W] delivery mask, brute force on raw bytes in spec order.
+
+    Plain etcd watch semantics — ``start <= key`` and (unbounded or
+    ``key < end``) and ``rev >= min_rev`` — with Python bytes comparison,
+    so NUL-bearing bounds (single-key watch end = key + b"\\0") are
+    exercised unrewritten. Every device/index path must match this
+    byte-for-byte.
+    """
+    out = np.zeros((len(events), len(specs)), dtype=bool)
+    for j, (_wid, start, end, min_rev) in enumerate(specs):
+        for i, ev in enumerate(events):
+            out[i, j] = (
+                ev.key >= start
+                and (not end or ev.key < end)
+                and ev.revision >= min_rev
+            )
+    return out
+
+
+class DeviceFanout:
+    """Persistent-table device matcher with block delivery and overflow-
+    regrown compacted transfers. Thread-compat: the hub calls from its
+    single drainer thread; table sync is internally locked."""
+
+    #: hub protocol marker: hand this matcher whole drain blocks
+    prefers_blocks = True
+
+    def __init__(self, width: int | None = None, mesh=None,
+                 metrics=None):
+        # width None = auto: the table buckets the packed width to the
+        # population's longest key (half the chunk compares of the 128-byte
+        # protocol max on typical registry keys); an int pins it
+        self._table = WatcherTable(width=width, mesh=mesh)
+        # the table owns the "is this mesh real" decision; a single-device
+        # mesh must not poison the jit cache key with a dead mesh object
+        self._mesh = mesh if self._table.sharded else None
+        self._idx_size = MIN_IDX_SIZE
+        self._metrics = None
+        self.stats = {"dispatches": 0, "redispatches": 0, "pairs": 0,
+                      "blocks": 0}
+        if metrics is not None:
+            self.set_metrics(metrics)
+
+    def set_metrics(self, metrics) -> None:
+        """Arm the ``kb.fanout.sharded`` gauge (1 = watcher table sharded
+        over a multi-device wat mesh, 0 = single-device fallback) — the
+        observable for the old silent ragged-count fallback."""
+        self._metrics = metrics
+        if metrics is not None:
+            sharded = 1.0 if self._table.sharded else 0.0
+            metrics.emit_gauge("kb.fanout.sharded", sharded)
+            metrics.register_gauge_fn(
+                "kb.fanout.sharded",
+                lambda: 1.0 if self._table.sharded else 0.0)
+
+    @property
+    def table(self) -> WatcherTable:
+        return self._table
+
+    # ------------------------------------------------------------- matching
+    def _pack_events(self, batch):
+        e = len(batch)
+        epad = pow2_at_least(e, MIN_EVENT_BUCKET)
+        keys = [ev.key for ev in batch] + [b""] * (epad - e)
+        revs = [ev.revision for ev in batch] + [0] * (epad - e)
+        # event keys must fit the table's packed width (and must be packed
+        # AT that width — the kernel compares chunk-for-chunk)
+        self._table.ensure_width(max(len(k) for k in keys) + 2)
+        ek, _ = keyops.pack_keys(keys, self._table.width)
+        ehi, elo = keyops.split_revs(np.array(revs, dtype=np.uint64))
+        return ek, ehi, elo, epad
+
+    def _match(self, batch, specs, version=None):
+        """One block → (slots int64[M], eidx int64[M], wids int64[cap]):
+        compacted matched pairs in ascending (slot, event) order plus the
+        slot→wid map snapshot. Transfer is O(M) + O(cap) counts."""
+        from .dispatch import fanout_dispatch
+
+        self._table.sync(specs, version)
+        empty = (np.zeros(0, np.int64), np.zeros(0, np.int64),
+                 np.zeros(0, np.int64))
+        if not batch or not specs:
+            return empty
+        ek, ehi, elo, epad = self._pack_events(batch)
+        ws, we, wu, whi, wlo, wids, _ver = self._table.device_view()
+        cap = wids.shape[0]
+        n_sh = max(self._table.stats()["devices"], 1)
+        w_local = cap // n_sh
+        while True:
+            self.stats["dispatches"] += 1
+            with TRACER.stage("fanout_dispatch"):
+                counts, idx = fanout_dispatch(
+                    ek, ehi, elo, np.int32(len(batch)),
+                    ws, we, wu, whi, wlo,
+                    size=self._idx_size, mesh=self._mesh)
+            with TRACER.stage("fanout_copy"):
+                counts = np.asarray(counts)
+                shard_tot = counts.reshape(n_sh, w_local).sum(axis=1)
+                overflow = int(shard_tot.max(initial=0))
+                if overflow > self._idx_size:
+                    # a shard truncated its index slice: double the bucket
+                    # and re-launch (rare — the bucket is persistent, so
+                    # the steady state is one launch per drain)
+                    self._idx_size = pow2_at_least(overflow,
+                                                   self._idx_size * 2)
+                    self.stats["redispatches"] += 1
+                    continue
+                idx = np.asarray(idx)
+                break
+        slots, eidx = [], []
+        for s in range(n_sh):
+            nv = int(shard_tot[s])
+            if not nv:
+                continue
+            loc = idx[s * self._idx_size: s * self._idx_size + nv].astype(
+                np.int64)
+            slots.append(s * w_local + loc // epad)
+            eidx.append(loc % epad)
+        if not slots:
+            return (empty[0], empty[1], wids)
+        slots = np.concatenate(slots)
+        eidx = np.concatenate(eidx)
+        self.stats["pairs"] += len(slots)
+        return slots, eidx, wids
+
+    # ------------------------------------------------------------ protocols
+    def deliver(self, batch, specs, version=None) -> dict[int, list]:
+        """Block protocol: {wid: [events, batch order]} for one drain block
+        — sync, one dispatch, one vectorized demux (matched pairs arrive
+        slot-major so the per-subscriber split is diff + split, no sort)."""
+        self.stats["blocks"] += 1
+        slots, eidx, wids = self._match(batch, specs, version)
+        if not len(slots):
+            return {}
+        cuts = np.flatnonzero(np.diff(slots)) + 1
+        groups = np.split(eidx, cuts)
+        heads = slots[np.concatenate(([0], cuts))]
+        out: dict[int, list] = {}
+        for slot, evs in zip(heads, groups):
+            wid = int(wids[slot])
+            if wid < 0:
+                continue  # sentinel rows never match; belt and braces
+            out[wid] = [batch[int(i)] for i in evs]
+        return out
+
+    def __call__(self, events, watcher_specs, version=None) -> np.ndarray:
+        """Legacy mask protocol: bool[E, W] in ``watcher_specs`` order."""
+        slots, eidx, wids = self._match(events, watcher_specs, version)
+        mask = np.zeros((len(events), len(watcher_specs)), dtype=bool)
+        if len(slots):
+            col = {wid: j for j, (wid, *_r) in enumerate(watcher_specs)}
+            cols = np.array([col[int(wids[s])] for s in slots],
+                            dtype=np.int64)
+            mask[eidx, cols] = True
+        return mask

@@ -1,0 +1,311 @@
+"""Persistent device-resident watcher-spec table (docs/watch.md).
+
+The hub's watcher population, held as five device-resident columns in the
+packed-key domain the dispatch kernel compares event keys against:
+
+    start[W, C]   end[W, C]   unbounded[W]   min_rev_hi[W]   min_rev_lo[W]
+
+Lifecycle:
+
+- ``sync(specs, version)`` reconciles the table with a hub snapshot by
+  DIFF, not rebuild: only rows whose watcher changed are re-packed and
+  marked dirty, so steady-state watcher churn costs O(changed rows), not
+  O(W) packing. The O(1) fast path (version unchanged) skips the diff
+  entirely. A hub restart reuses versions from 0 — the diff is keyed on
+  watcher ids + filters, so a version REGRESSION (or an id collision with
+  different filters) rewrites exactly the rows that differ and can never
+  match against a dead population (the stale-packed-table bug the legacy
+  matcher needed an explicit regression check for).
+- ``device_view()`` publishes the columns: a full transfer on first use /
+  capacity growth, a dirty-slot scatter otherwise.
+- Capacity is a bucket (pow2 to 1024, 1024-steps beyond) rounded up to a
+  multiple of the mesh device count, so the ``wat`` sharding ALWAYS
+  applies — there is no ragged-count unsharded fallback by construction.
+- The packed width is sized to the POPULATION, not to the 128-byte
+  protocol maximum: registry keys run ~50 bytes, so packing at a pow2
+  bucket over the longest live bound (plus the canonicalization margin)
+  halves the kernel's chunk-compare work for typical populations. Width
+  only grows (pow2 steps, so at most a handful of recompiles ever), and a
+  growth is a full republish like a capacity growth. Passing an explicit
+  ``width`` pins it (pack_keys then rejects longer keys loudly).
+
+Free slots hold a never-match sentinel: a bounded EMPTY range
+(end = all-zero chunks, unbounded = False) fails the ``key < end`` test
+for every possible key, so padding and freed slots are inert regardless
+of the start column or revision filter.
+"""
+
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from ..ops import keys as keyops
+
+#: smallest table capacity — watcher counts below this pay one compile
+MIN_CAPACITY = 64
+
+#: smallest auto-sized packed width in bytes (8 uint32 chunks)
+MIN_WIDTH = 32
+
+
+def pow2_at_least(n: int, lo: int = 1) -> int:
+    b = lo
+    while b < n:
+        b *= 2
+    return b
+
+
+class WatcherTable:
+    def __init__(self, width: int | None = None, mesh=None):
+        self._auto_width = width is None
+        self._width = width if width is not None else MIN_WIDTH
+        self._chunks = self._width // 4
+        # a mesh only shards when it is actually multi-device; axis name is
+        # taken from the mesh (``wat`` from the CLI, anything in embedders)
+        self._mesh = mesh if (mesh is not None and mesh.devices.size > 1) else None
+        self._lock = threading.Lock()
+        self._specs: dict[int, tuple[bytes, bytes, int]] = {}
+        self._slot_of: dict[int, int] = {}
+        self._free: list[int] = []
+        self._version: int | None = None  # hub watcher-set version last synced
+        # widened O(1) fast-path key: (version, count, first wid, last wid).
+        # A hub restart reuses versions from 0, so a bare version-equality
+        # check could alias a DEAD population of the same version; widening
+        # with the population's cheap shape makes the skip safe, and any
+        # mismatch (including version REGRESSION) falls through to the
+        # content diff, which is exact.
+        self._sync_key: tuple | None = None
+        self._epoch = 0          # bumps on (re)allocation → full republish
+        self._dev: tuple | None = None
+        self._dev_epoch = -1
+        self._dirty: set[int] = set()
+        self._cap = 0
+        # under the lock like every other _alloc site: _alloc touches the
+        # column/free-list fields the sync path guards, and construction
+        # being single-threaded is a fact about callers, not the fields
+        with self._lock:
+            self._alloc(self._capacity_for(1))
+
+    # ---------------------------------------------------------------- layout
+    def _n_dev(self) -> int:
+        return int(self._mesh.devices.size) if self._mesh is not None else 1
+
+    def _capacity_for(self, n: int) -> int:
+        """Pow2 buckets up to 1024, then 1024-step buckets: at 10k watchers
+        a pure pow2 bucket pads to 16384 — 64% of the kernel's rows would
+        be dead sentinels. Capacity only grows, so the compile-cache shape
+        count stays bounded either way."""
+        n = max(n, 1)
+        if n <= 1024:
+            cap = pow2_at_least(n, MIN_CAPACITY)
+        else:
+            cap = ((n + 1023) // 1024) * 1024
+        nd = self._n_dev()
+        return ((cap + nd - 1) // nd) * nd
+
+    def _grow_width_locked(self, n_bytes: int) -> None:
+        """Grow the packed width so an ``n_bytes`` key (or bound) fits.
+        Auto-width mode only — an explicit width stays pinned and overlong
+        keys fail loudly in pack_keys. Growth re-packs every live row at
+        the new chunk count and bumps the epoch (full republish)."""
+        if not self._auto_width:
+            return
+        width = pow2_at_least(max(n_bytes, MIN_WIDTH))
+        if width <= self._width:
+            return
+        self._width = width
+        self._chunks = width // 4
+        cap = self._cap
+        self._cap = 0          # fresh zeroed columns at the new chunk count
+        self._free = []
+        self._alloc(cap)
+        used = set(self._slot_of.values())
+        self._free = [s for s in range(cap - 1, -1, -1) if s not in used]
+        for wid, slot in self._slot_of.items():
+            self._write_row_locked(slot, wid, self._specs[wid])
+
+    def ensure_width(self, n_bytes: int) -> None:
+        """Public width guard for the EVENT side: the matcher calls this
+        with the block's longest key before packing at ``self.width``."""
+        with self._lock:
+            self._grow_width_locked(n_bytes)
+
+    def _alloc(self, cap: int) -> None:
+        """(Re)allocate the host shadow columns at ``cap`` slots, preserving
+        live rows; every new slot is a never-match sentinel."""
+        starts = np.zeros((cap, self._chunks), dtype=np.uint32)
+        ends = np.zeros((cap, self._chunks), dtype=np.uint32)  # empty range
+        unb = np.zeros(cap, dtype=bool)
+        hi = np.zeros(cap, dtype=np.uint32)
+        lo = np.zeros(cap, dtype=np.uint32)
+        wids = np.full(cap, -1, dtype=np.int64)
+        if self._cap:
+            starts[: self._cap] = self._starts
+            ends[: self._cap] = self._ends
+            unb[: self._cap] = self._unb
+            hi[: self._cap] = self._hi
+            lo[: self._cap] = self._lo
+            wids[: self._cap] = self._wids
+        self._free.extend(range(cap - 1, self._cap - 1, -1))
+        self._starts, self._ends, self._unb = starts, ends, unb
+        self._hi, self._lo, self._wids = hi, lo, wids
+        self._cap = cap
+        self._epoch += 1
+        self._dirty.clear()  # full republish supersedes any pending scatter
+
+    def _rows_for(self, start: bytes, end: bytes, min_rev: int):
+        """Packed chunk rows for one watcher spec. NUL-bearing bounds
+        (single-key watches use end = key + b"\\0") are canonicalized the
+        same way the legacy matcher and the scan path do."""
+        srow = keyops.pack_one(keyops.canonicalize_bound(start), self._width)
+        erow = keyops.pack_one(keyops.canonicalize_bound(end), self._width)
+        hi, lo = keyops.split_revs(np.array([min_rev], dtype=np.uint64))
+        return srow, erow, (not end), hi[0], lo[0]
+
+    def _write_row_locked(self, slot: int, wid: int,
+                          spec: tuple[bytes, bytes, int] | None) -> None:
+        if spec is None:  # sentinel: bounded empty range can never match
+            self._starts[slot] = 0
+            self._ends[slot] = 0
+            self._unb[slot] = False
+            self._hi[slot] = 0
+            self._lo[slot] = 0
+            self._wids[slot] = -1
+        else:
+            s, e, u, hi, lo = self._rows_for(*spec)
+            self._starts[slot] = s
+            self._ends[slot] = e
+            self._unb[slot] = u
+            self._hi[slot] = hi
+            self._lo[slot] = lo
+            self._wids[slot] = wid
+        self._dirty.add(slot)
+
+    # ----------------------------------------------------------------- sync
+    def sync(self, specs: list[tuple[int, bytes, bytes, int]],
+             version: int | None = None) -> None:
+        """Reconcile with a hub snapshot ``[(wid, start, end, min_rev)]``.
+
+        O(1) when ``version`` matches the last sync; otherwise an O(W) dict
+        diff that re-packs only changed rows. Correct under version
+        regression / wid collision by construction (rows are compared by
+        content, not trusted by version)."""
+        key = (version, len(specs),
+               specs[0][0] if specs else None,
+               specs[-1][0] if specs else None)
+        with self._lock:
+            if version is not None and key == self._sync_key:
+                return
+            if specs:
+                # +2: canonicalize_bound may extend a NUL-bearing bound by
+                # one byte past its base
+                self._grow_width_locked(
+                    max(max(len(s), len(e)) for _, s, e, _ in specs) + 2)
+            want = {wid: (s, e, r) for wid, s, e, r in specs}
+            for wid in [w for w in self._slot_of if w not in want]:
+                slot = self._slot_of.pop(wid)
+                del self._specs[wid]
+                self._write_row_locked(slot, wid, None)
+                self._free.append(slot)
+            if len(want) > self._cap:
+                # live rows survive the realloc; the epoch bump republishes
+                # them without re-packing
+                self._alloc(self._capacity_for(len(want)))
+            for wid, spec in want.items():
+                have = self._specs.get(wid)
+                if have == spec:
+                    continue
+                slot = self._slot_of.get(wid)
+                if slot is None:
+                    slot = self._free.pop()
+                    self._slot_of[wid] = slot
+                self._specs[wid] = spec
+                self._write_row_locked(slot, wid, spec)
+            self._version = version
+            self._sync_key = key
+
+    # ----------------------------------------------------------- publication
+    def _put(self, arr):
+        import jax
+
+        if self._mesh is None:
+            return jax.device_put(arr)
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        axis = self._mesh.axis_names[0]
+        spec = PartitionSpec(axis, *(None,) * (arr.ndim - 1))
+        return jax.device_put(arr, NamedSharding(self._mesh, spec))
+
+    def device_view(self):
+        """Publish dirty rows (or the whole table on first use / growth) and
+        return ``(starts, ends, unb, hi, lo, wids, version)`` — device
+        columns plus the slot→wid host map the demux decodes with. The wids
+        array is a snapshot copy: a concurrent sync can't mutate it under a
+        caller mid-demux."""
+        with self._lock:
+            if self._dev is None or self._dev_epoch != self._epoch:
+                self._dev = tuple(
+                    self._put(a) for a in
+                    (self._starts, self._ends, self._unb, self._hi, self._lo))
+                self._dev_epoch = self._epoch
+                self._dirty.clear()
+            elif self._dirty:
+                # dirty-slot scatter, index count bucketed to a pow2 (pad
+                # repeats a real slot — same-value double write, idempotent)
+                # so churn depth doesn't grow the compile cache
+                idx = np.fromiter(self._dirty, dtype=np.int64,
+                                  count=len(self._dirty))
+                pad = pow2_at_least(len(idx), 8) - len(idx)
+                if pad:
+                    idx = np.concatenate([idx, np.full(pad, idx[0])])
+                cols = []
+                for dev, host in zip(self._dev, (self._starts, self._ends,
+                                                 self._unb, self._hi, self._lo)):
+                    updated = dev.at[idx].set(host[idx])
+                    # re-pin the sharding device-to-device (device_put on a
+                    # jax array never round-trips the host): the scatter's
+                    # output sharding is whatever GSPMD picked
+                    cols.append(self._put(updated)
+                                if self._mesh is not None else updated)
+                self._dev = tuple(cols)
+                self._dirty.clear()
+            return (*self._dev, self._wids.copy(), self._version)
+
+    # ------------------------------------------------------------- inspection
+    @property
+    def version(self) -> int | None:
+        with self._lock:
+            return self._version
+
+    @property
+    def capacity(self) -> int:
+        with self._lock:
+            return self._cap
+
+    @property
+    def width(self) -> int:
+        with self._lock:
+            return self._width
+
+    @property
+    def sharded(self) -> bool:
+        return self._mesh is not None
+
+    def spec_count(self) -> int:
+        with self._lock:
+            return len(self._specs)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "capacity": self._cap,
+                "width": self._width,
+                "watchers": len(self._specs),
+                "devices": self._n_dev(),
+                "sharded": self._mesh is not None,
+                "epoch": self._epoch,
+                "dirty": len(self._dirty),
+                "version": self._version,
+            }

@@ -17,7 +17,11 @@ Traffic model (one simulated N-node cluster):
   exponential with mean ``churn_interval_s``; the tick creates, updates,
   or deletes one of the node's pods under
   ``/registry/pods/<ns>/<name>`` with a bounded log-normal object size;
-- **controllers**: one per node. CTRL_START = initial List then Watch
+- **controllers**: ``controllers_per_node`` per node (default 1 — the
+  historical one-per-node shape; watch-heavy specs raise it so each
+  namespace prefix carries many overlapping watchers, the fan-out
+  product the device matcher is built for). CTRL_START = initial List
+  then Watch
   from the returned revision (the informer bootstrap); CTRL_LIST = a
   periodic paged List (NORMAL lane); CTRL_RELIST = an unpaged List
   (BACKGROUND lane) fired on an *aligned* cadence so relists arrive as
@@ -192,13 +196,17 @@ def generate(spec: WorkloadSpec) -> Schedule:
         wheel.push(grant_t, LEASE_GRANT, node)
         wheel.push(grant_t + ka_ms, LEASE_KEEPALIVE, node)
         wheel.push(int(rng.expovariate(1.0 / churn_ms)), "CHURN", node)
-    for w in range(spec.nodes):  # one controller per node
-        start_t = (w * watch_spread_ms) // spec.nodes
+    # controller scheduling is pure arithmetic (no rng draw), so raising
+    # controllers_per_node never perturbs the churn/lease streams — specs
+    # with the default of 1 keep their historical trace hash
+    n_controllers = spec.nodes * spec.controllers_per_node
+    for w in range(n_controllers):
+        start_t = (w * watch_spread_ms) // n_controllers
         wheel.push(start_t, CTRL_START, w)
         wheel.push(start_t + list_ms, CTRL_LIST, w)
     # aligned relist storms: every controller relists at the SAME tick —
     # the distinct-range burst that exercises query-batched scan formation
-    for w in range(spec.nodes):
+    for w in range(n_controllers):
         wheel.push(relist_ms, CTRL_RELIST, w)
     for lister in range(spec.lease_listers):
         wheel.push(lease_list_ms + lister * 97, LEASE_LIST, lister)

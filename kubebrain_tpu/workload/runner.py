@@ -549,22 +549,35 @@ class WorkloadRunner:
             args += ["--mesh-part", str(self.spec.mesh_part)]
         if self.spec.scan_partitions:
             args += ["--scan-partitions", str(self.spec.scan_partitions)]
+        if self.spec.tpu_fanout:
+            # fan-out offload: mesh_args reaches leader AND followers, so
+            # every replica carries the device matcher — the follower
+            # offload leg of docs/watch.md (watch clients already pin to
+            # followers when replicas > 0)
+            args += ["--tpu-fanout"]
+            if self.spec.mesh_wat:
+                args += ["--mesh-wat", str(self.spec.mesh_wat)]
         return args
 
     def _mesh_env(self):
         env = None
-        if self.spec.mesh_part or self.spec.scan_partitions:
+        if self.spec.mesh_part or self.spec.scan_partitions or self.spec.mesh_wat:
             # multichip sharded serving: cluster replay drives a part-
             # sharded server (docs/multichip.md)
             if self.spec.mesh_part:
                 want_dev = self.spec.mesh_part
-            else:
+            elif self.spec.scan_partitions:
                 # mesh_part=0 means "every visible device": simulate a
                 # count that DIVIDES scan_partitions, or cli's boot-time
                 # divisibility check rejects a spec that validated fine
                 want_dev = next(
                     (k for k in (8, 4, 2)
                      if self.spec.scan_partitions % k == 0), 1)
+            else:
+                want_dev = 1
+            # the wat axis needs its own device count; axes don't compose
+            # into one grid here (separate 1-D meshes), so cover the max
+            want_dev = max(want_dev, self.spec.mesh_wat)
             if os.environ.get("KB_WORKLOAD_JAX_PLATFORM", "cpu") == "cpu":
                 # simulate the mesh devices in the child (the same
                 # mechanism tests/conftest.py uses)
@@ -1519,10 +1532,21 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--smoke", action="store_true",
                     help="small-N CI smoke shape (short, every traffic kind)")
     ap.add_argument("--scenario", default="cluster",
-                    choices=["cluster", "smoke", "churn-heavy"],
-                    help="traffic preset: cluster (default), smoke, or "
+                    choices=["cluster", "smoke", "churn-heavy",
+                             "watch-heavy"],
+                    help="traffic preset: cluster (default), smoke, "
                          "churn-heavy (pod-churn + keepalive-storm write "
-                         "skew exercising group commit; docs/writes.md)")
+                         "skew exercising group commit; docs/writes.md), or "
+                         "watch-heavy (multi-controller fan-in over thin "
+                         "writes exercising block-batched watch fan-out; "
+                         "docs/watch.md)")
+    ap.add_argument("--tpu-fanout", action="store_true",
+                    help="spawn servers with the device fan-out matcher "
+                         "(implied by --scenario watch-heavy)")
+    ap.add_argument("--mesh-wat", type=int, default=0,
+                    help="shard the spawned servers' watcher table over "
+                         "this many devices (implies --tpu-fanout; "
+                         "simulated on CPU)")
     ap.add_argument("--faults", default="none",
                     help="chaos mode (docs/faults.md): arm this fault "
                          "preset on the spawned server (none, smoke, "
@@ -1537,6 +1561,9 @@ def main(argv: list[str] | None = None) -> int:
                "replicas": args.replicas,
                "max_staleness_ms": args.max_staleness_ms,
                "max_staleness_rev": args.max_staleness_rev}
+    if args.tpu_fanout or args.mesh_wat:
+        mesh_kw["tpu_fanout"] = True
+        mesh_kw["mesh_wat"] = args.mesh_wat
     chaos = args.faults and args.faults != "none"
     scenario = "smoke" if args.smoke else args.scenario
     if chaos:
@@ -1549,6 +1576,10 @@ def main(argv: list[str] | None = None) -> int:
                                       storage=args.storage, **mesh_kw)
     elif scenario == "churn-heavy":
         spec = WorkloadSpec.for_churn_heavy(
+            args.nodes, seed=args.seed, duration_s=args.duration,
+            time_scale=args.scale, storage=args.storage, **mesh_kw)
+    elif scenario == "watch-heavy":
+        spec = WorkloadSpec.for_watch_heavy(
             args.nodes, seed=args.seed, duration_s=args.duration,
             time_scale=args.scale, storage=args.storage, **mesh_kw)
     else:

@@ -72,6 +72,12 @@ class WorkloadSpec:
     lease_ttl_s: int = 40
     list_interval_s: float = 7.0         # per-controller paged list (NORMAL)
     list_limit: int = 200
+    #: controllers per node — the multi-controller fan-in knob
+    #: (docs/watch.md): every controller is an informer (List then Watch on
+    #: its namespace prefix), so raising this multiplies WATCHERS PER
+    #: PREFIX without adding writes. 1 = the historical one-controller-
+    #: per-node shape (trace-identical to specs predating the field).
+    controllers_per_node: int = 1
     relist_interval_s: float = 12.0      # aligned relist storms (BACKGROUND)
     lease_list_interval_s: float = 5.0   # node-controller lease sweeps (SYSTEM)
     lease_listers: int = 2
@@ -101,6 +107,14 @@ class WorkloadSpec:
     #: devices via xla_force_host_platform_device_count.
     mesh_part: int = 0
     scan_partitions: int = 0
+    #: watch fan-out offload (docs/watch.md): spawn every server (leader
+    #: AND followers — fan-out capacity scales with replica count) with
+    #: --tpu-fanout, i.e. the block-batched device matcher; mesh_wat > 0
+    #: additionally shards the watcher table over that many devices
+    #: (forwarded as --mesh-wat; on CPU the runner simulates the devices).
+    #: Runtime only — the generated op trace is identical either way.
+    tpu_fanout: bool = False
+    mesh_wat: int = 0
     write_shards: int = 8
     range_shards: int = 8
     watch_streams: int = 4
@@ -133,6 +147,14 @@ class WorkloadSpec:
         if min(self.write_shards, self.range_shards,
                self.watch_streams, self.lease_streams) < 1:
             raise ValueError("shard/stream counts must be >= 1")
+        if self.controllers_per_node < 1:
+            raise ValueError("controllers_per_node must be >= 1")
+        if self.mesh_wat < 0:
+            raise ValueError("mesh_wat must be >= 0")
+        if self.mesh_wat and not self.tpu_fanout:
+            # mirror cli.validate_args (--mesh-wat requires --tpu-fanout):
+            # fail here instead of spawning a server that boot-rejects it
+            raise ValueError("mesh_wat requires tpu_fanout=True")
         if self.mesh_part < 0 or self.scan_partitions < 0:
             raise ValueError("mesh_part/scan_partitions must be >= 0")
         if self.replicas < 0 or self.max_staleness_rev < 0 \
@@ -195,6 +217,41 @@ class WorkloadSpec:
             lease_list_interval_s=10.0,
             lease_listers=1,
             grant_spread_s=2.0,
+        )
+        defaults.update(overrides)
+        return cls(**defaults)
+
+    @classmethod
+    def for_watch_heavy(cls, nodes: int, **overrides: Any) -> "WorkloadSpec":
+        """Watch fan-out scenario (docs/watch.md): multi-controller fan-in
+        — several informer controllers per node, so each namespace prefix
+        carries MANY overlapping watchers — over deliberately thin writes
+        (slow churn, no keepalive storm). The traffic is then dominated by
+        the (events x watchers) fan-out product rather than by write or
+        list volume: the shape that exercises the block-batched device
+        matcher and the follower watch offload (`REPLICAS=2` pins the
+        whole watcher population to the followers). Servers spawn with
+        the device matcher armed (``tpu_fanout``); the SLO keeps the
+        queue->wire watch lag bound meaningful instead of the loose
+        default."""
+        namespaces = max(4, min(100, nodes // 10))
+        bounds = overrides.pop(
+            "bounds",
+            SLOBounds(watch_wire_lag_p99_s=5.0,
+                      min_batched_requests=0))
+        defaults = dict(
+            nodes=nodes, namespaces=namespaces, bounds=bounds,
+            controllers_per_node=4,      # ~4x watchers per prefix
+            pods_per_node=4,
+            churn_interval_s=4.0,        # thin writes: ~half cluster churn
+            keepalive_interval_s=10.0,
+            lease_ttl_s=40,
+            list_interval_s=12.0,        # thin the list load too: the watch
+            relist_interval_s=30.0,      # product, not list rows, is the work
+            lease_list_interval_s=10.0,
+            lease_listers=1,
+            watch_spread_s=6.0,
+            tpu_fanout=True,
         )
         defaults.update(overrides)
         return cls(**defaults)

@@ -717,11 +717,45 @@ def test_kb117_scoped_to_storage_tpu():
     assert ids(src, "kubebrain_tpu/storage/tpu/encode.py") == []
 
 
+# ------------------------------------------------------------------- KB127
+def test_kb127_flags_fanout_kernel_outside_funnels():
+    src = ("from kubebrain_tpu.ops.fanout import fanout_mask_range\n"
+           "def stream(self, batch, table):\n"
+           "    return fanout_mask_range(batch, *table)\n")
+    # both the import and the call site are flagged
+    assert ids(src, ANY) == ["KB127", "KB127"]
+
+
+def test_kb127_flags_attribute_reference_and_wmajor():
+    src = ("from kubebrain_tpu.ops import fanout\n"
+           "def f(self, ek, tbl):\n"
+           "    return fanout.fanout_mask_range_wmajor(ek, *tbl)\n")
+    assert ids(src, "kubebrain_tpu/fanout/matcher.py") == ["KB127"]
+
+
+def test_kb127_allows_the_dispatch_funnels():
+    src = ("from ..ops.fanout import fanout_mask_range_wmajor\n"
+           "def local(ek, ws):\n"
+           "    return fanout_mask_range_wmajor(ek, ws)\n")
+    assert ids(src, "kubebrain_tpu/fanout/dispatch.py") == []
+    assert ids(src, "kubebrain_tpu/ops/fanout.py") == []
+    assert ids(src, "kubebrain_tpu/parallel/step.py") == []
+    # and code outside kubebrain_tpu (tests, tools) is out of scope
+    assert ids(src, "tests/test_fanout_device.py") == []
+
+
+def test_kb127_quiet_on_mask_consumers():
+    src = ("def stream(self, batch, specs, version):\n"
+           "    mask = self._fanout_matcher(batch, specs, version=version)\n"
+           "    return mask.any(axis=0)\n")
+    assert ids(src, ANY) == []
+
+
 # ------------------------------------------------------------ registry/CLI
 def test_registry_has_all_rules():
     assert set(RULES) == {"KB101", "KB102", "KB103", "KB104", "KB105", "KB106",
                           "KB107", "KB108", "KB109", "KB110", "KB111",
-                          "KB116", "KB117", "KB118"}
+                          "KB116", "KB117", "KB118", "KB127"}
     for rule in RULES.values():
         assert rule.summary
 

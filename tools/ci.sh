@@ -1,6 +1,7 @@
 #!/usr/bin/env bash
 # The offline CI entry point (mirrored by .github/workflows/check.yml):
 #   1. make lint        — kblint project invariants (syntactic KB101-KB111
+#                         + the funnel-confinement rules KB116/KB117/KB127
 #                         + the --deep interprocedural tier KB112-KB122
 #                         + the CFG/typestate leak tier KB123-KB126,
 #                         zero non-baselined findings, <60s budget
@@ -55,24 +56,34 @@
 #                         duplicated events across server-side resets), and
 #                         a small FAULTS=smoke replay asserting the
 #                         acknowledged-write consistency invariant
-#  11. tier-1 pytest    — the ROADMAP.md verify command
+#  11. watch fan-out    — block-batched dispatch (docs/watch.md): device
+#                         deliver byte-identical to the brute-force and
+#                         segment-index oracles under churn, the sharded
+#                         wat-table identity on 8 simulated devices,
+#                         NUL-bound single-key exactness, overflow regrow,
+#                         version-regression rebuild, KB127 confinement
+#                         self-tests (via step 1), and bench-fanout at the
+#                         full 10k-watcher acceptance config enforcing the
+#                         >=2x block-vs-per-batch bar plus the live-hub
+#                         lag p99 bar
+#  12. tier-1 pytest    — the ROADMAP.md verify command
 # Run from anywhere; operates on the repo this script lives in.
 
 set -uo pipefail
 
 cd "$(dirname "$0")/.."
 
-echo "=== [1/11] make lint (syntactic + deep interprocedural, 60s budget)"
+echo "=== [1/12] make lint (syntactic + deep interprocedural, 60s budget)"
 make lint || exit 1
 env JAX_PLATFORMS=cpu python -m pytest tests/test_kblint.py \
     tests/test_kblint_deep.py tests/test_kblint_races.py \
     tests/test_kblint_leaks.py \
     -q -m 'not slow' -p no:cacheprovider || exit 1
 
-echo "=== [2/11] make typecheck"
+echo "=== [2/12] make typecheck"
 make typecheck || exit 1
 
-echo "=== [3/11] scheduler semantics + query-batched scan + write group commit + bench-smoke (CPU fallback)"
+echo "=== [3/12] scheduler semantics + query-batched scan + write group commit + bench-smoke (CPU fallback)"
 env JAX_PLATFORMS=cpu python -m pytest tests/test_sched.py \
     tests/test_sched_batch.py tests/test_scan_pallas.py \
     tests/test_write_batch.py -q -m 'not slow' \
@@ -86,36 +97,36 @@ env JAX_PLATFORMS=cpu KB_FIELDCHECK=1 KB_FIELDCHECK_STRICT=1 \
     -p no:cacheprovider || exit 1
 make bench-smoke || exit 1
 
-echo "=== [4/11] request tracing: span tests + live-server /debug/traces smoke"
+echo "=== [4/12] request tracing: span tests + live-server /debug/traces smoke"
 env JAX_PLATFORMS=cpu python -m pytest tests/test_trace.py -q -m 'not slow' \
     -p no:cacheprovider || exit 1
 env JAX_PLATFORMS=cpu python tools/smoke_trace.py || exit 1
 
-echo "=== [5/11] lease subsystem: TTL state machine + revision-stamped expiry"
+echo "=== [5/12] lease subsystem: TTL state machine + revision-stamped expiry"
 env JAX_PLATFORMS=cpu python -m pytest tests/test_lease.py -q -m 'not slow' \
     -p no:cacheprovider || exit 1
 
-echo "=== [6/11] workload replay: determinism + SLO schema + small-N gRPC smoke"
+echo "=== [6/12] workload replay: determinism + SLO schema + small-N gRPC smoke"
 env JAX_PLATFORMS=cpu python -m pytest tests/test_workload.py -q -m 'not slow' \
     -p no:cacheprovider || exit 1
 
-echo "=== [7/11] multichip sharded serving + encoded mirror: identity + transfer budget + served dry-run"
+echo "=== [7/12] multichip sharded serving + encoded mirror: identity + transfer budget + served dry-run"
 env JAX_PLATFORMS=cpu python -m pytest tests/test_multichip.py \
     tests/test_encode.py \
     tests/test_graft_entry.py -q -m 'not slow' -p no:cacheprovider || exit 1
 
-echo "=== [8/11] device-side compaction: stored-domain differential + victim-only decode + bench-compact smoke"
+echo "=== [8/12] device-side compaction: stored-domain differential + victim-only decode + bench-compact smoke"
 env JAX_PLATFORMS=cpu python -m pytest tests/test_compact_device.py \
     tests/test_compact_faults.py -q -m 'not slow' \
     -p no:cacheprovider || exit 1
 env JAX_PLATFORMS=cpu KB_BENCH_METRIC=compact KB_BENCH_KEYS=4000 \
     python bench.py || exit 1
 
-echo "=== [9/11] replica: fence reads + bounded staleness + watch resume + two-replica gRPC smoke"
+echo "=== [9/12] replica: fence reads + bounded staleness + watch resume + two-replica gRPC smoke"
 env JAX_PLATFORMS=cpu python -m pytest tests/test_replica.py -q -m 'not slow' \
     -p no:cacheprovider || exit 1
 
-echo "=== [10/11] chaos: fault-schedule determinism + inertness + taxonomy + FAULTS=smoke consistency gate"
+echo "=== [10/12] chaos: fault-schedule determinism + inertness + taxonomy + FAULTS=smoke consistency gate"
 env JAX_PLATFORMS=cpu python -m pytest tests/test_faults.py \
     tests/test_watch_robustness.py -q -m 'not slow' \
     -p no:cacheprovider || exit 1
@@ -127,5 +138,18 @@ env JAX_PLATFORMS=cpu KB_SANITIZE=1 KB_SANITIZE_STRICT=1 \
     python -m pytest tests/test_faults.py -q -m 'not slow' \
     -p no:cacheprovider || exit 1
 
-echo "=== [11/11] tier-1 tests (ROADMAP.md verify, one definition: make test-tier1)"
+echo "=== [11/12] watch fan-out: block-batched dispatch differentials + sharded wat table + bench-fanout bars"
+env JAX_PLATFORMS=cpu python -m pytest tests/test_fanout_device.py \
+    tests/test_fanout_integration.py -q -m 'not slow' \
+    -p no:cacheprovider || exit 1
+# bench-fanout at the full acceptance config (docs/watch.md; ~25s on one
+# CPU core — the >=2x block-vs-per-batch bar is defined at 10k watchers
+# and small-N would let fixed overheads eat it): identity vs the brute
+# and segment-index oracles, the speedup bar, the live-hub lag p99 bar;
+# the report lands in /tmp, not the repo
+env JAX_PLATFORMS=cpu KB_BENCH_METRIC=fanout \
+    KB_FANOUT_OUT=/tmp/FANOUT_ci.json \
+    python bench.py || exit 1
+
+echo "=== [12/12] tier-1 tests (ROADMAP.md verify, one definition: make test-tier1)"
 exec make test-tier1

@@ -1035,3 +1035,56 @@ class RetryLoopHygiene(Rule):
                         "sleeping a constant re-collides forever; multiply "
                         "by random.uniform(0.5, 1.5) or similar"
                     )
+
+
+#: the watch fan-out mask kernels (ops.fanout.fanout_mask* — prefix match,
+#: E-major range, W-major range). Referencing one outside the two dispatch
+#: funnels forks the packing discipline: a stray call site can silently
+#: disagree on bound canonicalization (NUL single-key bounds), packed
+#: width (the auto-grown table width), W/E padding, or the wat-mesh
+#: sharding — the same drift KB109 fences for the scan kernels.
+_FANOUT_MASK_PREFIX = "fanout_mask"
+#: modules allowed to reference them: the legacy per-batch funnel (which
+#: also defines them), the block-batched dispatch funnel, and the fused
+#: multichip data-plane step (its own assembly point — the kernel runs
+#: inside one shard_map'd step over the part x wat mesh)
+_FANOUT_MASK_ALLOWED = (
+    "kubebrain_tpu/ops/fanout.py",
+    "kubebrain_tpu/fanout/dispatch.py",
+    "kubebrain_tpu/parallel/step.py",
+)
+
+
+@register
+class FanoutMaskOnlyInDispatchFunnels(Rule):
+    """The fan-out mask kernels may only be referenced from the two
+    dispatch funnels (`ops/fanout.py`, `fanout/dispatch.py`) — everything
+    above (matcher, hub, backend) consumes masks or compacted index pairs,
+    never launches the kernel itself (docs/watch.md). Imports count: an
+    alias smuggled into another module is the same bypass as a call."""
+
+    rule_id = "KB127"
+    summary = ("fanout_mask* kernels may only be referenced from the "
+               "dispatch funnels (ops/fanout.py, fanout/dispatch.py)")
+
+    def applies(self, relpath: str) -> bool:
+        p = relpath.replace("\\", "/")
+        return p.startswith("kubebrain_tpu/") and p not in _FANOUT_MASK_ALLOWED
+
+    def check(self, tree: ast.Module, src: str) -> Iterable[tuple[ast.AST, str]]:
+        for node in ast.walk(tree):
+            name = None
+            if isinstance(node, (ast.Name, ast.Attribute)):
+                name = terminal_name(node)
+            elif isinstance(node, ast.ImportFrom):
+                for a in node.names:
+                    if a.name.startswith(_FANOUT_MASK_PREFIX):
+                        name = a.name
+                        break
+            if name and name.startswith(_FANOUT_MASK_PREFIX):
+                yield node, (
+                    f"fan-out mask kernel reference {name!r}: the kernels "
+                    "launch only from the dispatch funnels (ops/fanout.py, "
+                    "fanout/dispatch.py); consume the matcher's masks or "
+                    "compacted pairs instead"
+                )
